@@ -1,0 +1,102 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Run on the real chip (default env, JAX_PLATFORMS=axon). Metric follows
+BASELINE.json: images/sec/chip on the heaviest image model available.
+``vs_baseline`` is measured-MFU / 0.50 (the north-star MFU target); the
+reference published no absolute numbers (BASELINE.md), so the MFU target is
+the only honest denominator available.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_steps(step_fn, state, batch, *, warmup: int = 3, iters: int = 20):
+    import jax
+
+    for _ in range(warmup):
+        state, _ = step_fn(state, batch)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, _ = step_fn(state, batch)
+    jax.block_until_ready(state.params)
+    return (time.perf_counter() - t0) / iters, state
+
+
+def main() -> None:
+    import jax
+    import optax
+
+    from distributeddeeplearningspark_tpu.data.feed import put_global, stack_examples
+    from distributeddeeplearningspark_tpu.metrics import (
+        compiled_flops_per_step,
+        device_peak_flops,
+    )
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+    from distributeddeeplearningspark_tpu.parallel.sharding import REPLICATED
+    from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+
+    try:
+        from distributeddeeplearningspark_tpu.models import ResNet50  # type: ignore
+
+        model = ResNet50(num_classes=1000, dtype="bfloat16")
+        batch_size = 256
+        example = {
+            "image": np.random.default_rng(0).normal(0, 1, (224, 224, 3)).astype(np.float32),
+            "label": np.int32(1),
+        }
+        name = "resnet50_images_per_sec_per_chip"
+    except ImportError:
+        from distributeddeeplearningspark_tpu.models import LeNet5
+
+        model = LeNet5()
+        batch_size = 1024
+        example = {"image": np.zeros((28, 28, 1), np.float32), "label": np.int32(1)}
+        name = "lenet5_images_per_sec_per_chip"
+
+    mesh = MeshSpec(data=-1).build()
+    n_chips = mesh.devices.size
+    batch = stack_examples([example] * batch_size)
+    tx = optax.sgd(0.01, momentum=0.9)
+    state, shardings = step_lib.init_state(model, tx, batch, mesh, REPLICATED)
+    train_step = step_lib.jit_train_step(
+        step_lib.make_train_step(model.apply, tx, losses.softmax_xent),
+        mesh,
+        shardings,
+    )
+    gbatch = put_global(batch, mesh)
+
+    lowered = train_step.lower(state, gbatch)
+    flops = compiled_flops_per_step(lowered.compile())
+    step_time, state = bench_steps(train_step, state, gbatch)
+
+    imgs_per_sec_chip = batch_size / step_time / n_chips
+    peak = device_peak_flops()
+    mfu = (flops / step_time / n_chips / peak) if (flops and peak) else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": name,
+                "value": round(imgs_per_sec_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(mfu / 0.50, 4),
+                "extra": {
+                    "step_time_ms": round(step_time * 1e3, 3),
+                    "mfu": round(mfu, 4),
+                    "chips": n_chips,
+                    "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+                    "batch_size": batch_size,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""TPU-native distributed deep-learning framework with a Spark-shaped user model.
+
+This package re-implements the capabilities of the reference
+``chenhuims/DistributedDeepLearningSpark`` (a Spark-orchestrated, Horovod/NCCL
+data-parallel trainer — see SURVEY.md; the reference mount was empty when this
+was built, so parity is against the capability contract in BASELINE.json) as a
+from-scratch TPU-first design:
+
+- The Spark driver/executor *user model* is kept: a ``Session`` with a
+  ``builder`` (SparkSession lifecycle), ``parallelize`` producing lazy
+  partitioned datasets (RDD-shaped), executor-count knobs, and a
+  ``dlsubmit`` CLI shaped like ``spark-submit``.
+- The *engine* is SPMD JAX: one ``jax.jit``-compiled train step under GSPMD
+  sharding replaces the per-partition forward/backward/optimizer closure;
+  ``jax.lax.psum`` over the ICI/DCN device mesh replaces NCCL all-reduce;
+  replicated sharding replaces driver parameter broadcast; a device-side
+  prefetch iterator streams partitions into HBM.
+
+Public API (stable surface):
+
+    Session, PartitionedDataset, MeshSpec, Trainer, TrainState
+"""
+
+from distributeddeeplearningspark_tpu.session import Session
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+from distributeddeeplearningspark_tpu.train.state import TrainState
+from distributeddeeplearningspark_tpu.train.trainer import Trainer
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Session",
+    "PartitionedDataset",
+    "MeshSpec",
+    "TrainState",
+    "Trainer",
+    "__version__",
+]

@@ -1,0 +1,88 @@
+"""``dlsubmit`` — the spark-submit-shaped CLI entrypoint.
+
+The reference is launched as ``spark-submit train_script.py --conf k=v ...``
+(SURVEY.md §1 L7). ``dlsubmit`` keeps that surface: it parses the same launch
+flags, materializes them as session conf (so the driver script's plain
+``Session.builder.getOrCreate()`` picks them up), then runs the script
+in-process — there is no JVM to spawn; one OS process per TPU host *is* the
+executor model, provisioned outside this CLI (GKE/TPU VM tooling), and
+multi-host rendezvous is handled by ``Session.initialize_distributed`` via the
+DLS_COORDINATOR env (set per host by the launcher).
+
+Usage::
+
+    dlsubmit [--master local[2]] [--name app] [--conf k=v ...] script.py [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dlsubmit",
+        description="Launch a driver script with session conf (spark-submit-shaped).",
+    )
+    p.add_argument("--master", default=None, help="local[N] | local[*] | tpu | auto")
+    p.add_argument("--name", "--app-name", dest="name", default=None)
+    p.add_argument(
+        "--conf", action="append", default=[], metavar="KEY=VALUE",
+        help="session conf entry (repeatable); spark.* keys are mapped",
+    )
+    p.add_argument(
+        "--num-executors", type=int, default=None,
+        help="alias for --conf spark.executor.instances=N",
+    )
+    p.add_argument("script", help="driver script to run")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+#: env var prefix used to pass conf from dlsubmit to Session.builder defaults.
+CONF_ENV_PREFIX = "DLS_CONF_"
+
+
+def conf_from_env() -> dict[str, str]:
+    """Conf entries exported by dlsubmit for the in-process driver script."""
+    out = {}
+    for k, v in os.environ.items():
+        if k.startswith(CONF_ENV_PREFIX):
+            out[k[len(CONF_ENV_PREFIX):].replace("__", ".")] = v
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    conf: dict[str, str] = {}
+    for entry in args.conf:
+        if "=" not in entry:
+            raise SystemExit(f"--conf expects KEY=VALUE, got {entry!r}")
+        k, _, v = entry.partition("=")
+        conf[k] = v
+    if args.master:
+        conf["spark.master"] = args.master
+    if args.name:
+        conf["spark.app.name"] = args.name
+    if args.num_executors is not None:
+        conf["spark.executor.instances"] = str(args.num_executors)
+
+    # Hand conf to the driver script through the env so its plain
+    # Session.builder.getOrCreate() sees the launch configuration.
+    for k, v in conf.items():
+        os.environ[CONF_ENV_PREFIX + k.replace(".", "__")] = v
+
+    if not os.path.exists(args.script):
+        raise SystemExit(f"dlsubmit: script not found: {args.script}")
+
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Data plane: partition feeds, HBM prefetch, and workload dataset sources."""
+
+from distributeddeeplearningspark_tpu.data.feed import (
+    device_batches,
+    host_batches,
+    put_global,
+    stack_examples,
+)
+from distributeddeeplearningspark_tpu.data.prefetch import prefetch_to_device
+
+__all__ = [
+    "device_batches",
+    "host_batches",
+    "put_global",
+    "stack_examples",
+    "prefetch_to_device",
+]

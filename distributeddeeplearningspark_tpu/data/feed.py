@@ -1,0 +1,130 @@
+"""Partition → mesh feed: global batches laid out with batch sharding.
+
+The reference streams RDD partition iterators into each executor's GPU
+(SURVEY.md §1 L5). Here, partitions are host-side iterators of example dicts
+(``{"image": ..., "label": ...}``, numpy); this module assembles them into
+*global* batches and places them on the mesh with the leading axis sharded
+over (data, fsdp) — the GSPMD equivalent of "each executor trains on its
+partition".
+
+Two assembly modes:
+
+- **aligned** (default when ``num_partitions`` divides evenly into the data
+  shards): partition *i* feeds data shard ``i % num_shards``, preserving
+  Spark's partition↔task pairing — shard-local data stays shard-local.
+- **chained**: partitions are concatenated into one stream and dealt out in
+  order. Used when partition count and mesh shape don't line up.
+
+Multi-process placement uses ``jax.make_array_from_process_local_data`` so
+each host only materializes its addressable shard of the global batch.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterable, Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributeddeeplearningspark_tpu.parallel.mesh import BATCH_AXES, num_data_shards
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+
+def stack_examples(examples: list[dict[str, Any]]) -> dict[str, np.ndarray]:
+    keys = examples[0].keys()
+    return {k: np.stack([np.asarray(e[k]) for e in examples]) for k in keys}
+
+
+def _round_robin(iters: list[Iterator]) -> Iterator:
+    """Deal elements from iterators in turn; drained ones drop out so uneven
+    partitions lose no data (matches Spark consuming every partition fully)."""
+    active = list(iters)
+    while active:
+        still = []
+        for it in active:
+            try:
+                yield next(it)
+                still.append(it)
+            except StopIteration:
+                pass
+        active = still
+
+
+def host_batches(
+    dataset: PartitionedDataset,
+    batch_size: int,
+    *,
+    num_shards: int = 1,
+    drop_remainder: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Yield stacked global host batches from an RDD of example dicts."""
+    n_parts = dataset.num_partitions
+    aligned = n_parts % num_shards == 0 and batch_size % num_shards == 0
+    if aligned and n_parts > 1:
+        # partition i → shard (i % num_shards); lockstep draw keeps pairing.
+        per_shard = batch_size // num_shards
+        groups: list[list[Iterator]] = [[] for _ in range(num_shards)]
+        for i in range(n_parts):
+            groups[i % num_shards].append(dataset.iter_partition(i))
+        shard_streams = [_round_robin(g) if len(g) > 1 else g[0] for g in groups]
+        while True:
+            shard_chunks = []
+            short = False
+            for s in shard_streams:
+                chunk = list(itertools.islice(s, per_shard))
+                if len(chunk) < per_shard:
+                    short = True
+                shard_chunks.append(chunk)
+            if short:
+                # Partial final batch: only meaningful if it still divides
+                # evenly across shards (GSPMD needs equal shard sizes).
+                if not drop_remainder:
+                    rest = [e for chunk in shard_chunks for e in chunk]
+                    keep = len(rest) - len(rest) % num_shards
+                    if keep:
+                        yield stack_examples(rest[:keep])
+                return
+            yield stack_examples([e for chunk in shard_chunks for e in chunk])
+    else:
+        stream = itertools.chain.from_iterable(
+            dataset.iter_partition(i) for i in range(n_parts)
+        )
+        while True:
+            chunk = list(itertools.islice(stream, batch_size))
+            if len(chunk) < batch_size:
+                if chunk and not drop_remainder:
+                    yield stack_examples(chunk)
+                return
+            yield stack_examples(chunk)
+
+
+def put_global(batch: dict[str, np.ndarray], mesh: Mesh) -> dict[str, jax.Array]:
+    """Place a host batch onto the mesh with batch sharding.
+
+    Single-process: a plain sharded ``device_put`` (XLA slices per device).
+    Multi-process: each process passes its *local* rows and JAX assembles the
+    global array — the moral replacement for "each executor reads its own
+    partition" with zero driver round-trip.
+    """
+    sharding = NamedSharding(mesh, P(BATCH_AXES))
+    if jax.process_count() > 1:
+        return {
+            k: jax.make_array_from_process_local_data(sharding, v) for k, v in batch.items()
+        }
+    return jax.device_put(batch, sharding)
+
+
+def device_batches(
+    dataset: PartitionedDataset,
+    mesh: Mesh,
+    batch_size: int,
+    *,
+    drop_remainder: bool = True,
+) -> Iterator[dict[str, jax.Array]]:
+    """host_batches → sharded device arrays (no prefetch; see prefetch.py)."""
+    for hb in host_batches(
+        dataset, batch_size, num_shards=num_data_shards(mesh), drop_remainder=drop_remainder
+    ):
+        yield put_global(hb, mesh)

@@ -1,0 +1,162 @@
+"""Metrics & observability: throughput, step time, achieved MFU.
+
+The reference reports loss/accuracy per Spark round plus whatever the Spark UI
+shows per stage (SURVEY.md §5). The rebuild reports the BASELINE.json headline
+metrics directly: images/sec/chip & tokens/sec/chip, plus step time and
+achieved MFU (model FLOPs from XLA's own cost analysis of the compiled step ÷
+chip peak).
+
+Peak FLOPs table is bf16 dense peak per chip (public TPU spec sheet numbers).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any
+
+import jax
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.metrics")
+
+#: bf16 dense peak FLOPs/s per chip, by jax device_kind (public spec numbers).
+PEAK_FLOPS: dict[str, float] = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def device_peak_flops(device: jax.Device | None = None) -> float | None:
+    d = device if device is not None else jax.devices()[0]
+    return PEAK_FLOPS.get(getattr(d, "device_kind", ""), None)
+
+
+def compiled_flops_per_step(compiled) -> float | None:
+    """Total FLOPs of one compiled step from XLA cost analysis (global)."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older jax returns per-device list
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:  # cost analysis unsupported on some backends
+        return None
+
+
+class Meter:
+    """Per-step wall-clock + throughput + MFU accounting.
+
+    Usage::
+
+        meter = Meter(examples_per_step=global_batch, tokens_per_step=...)
+        meter.set_flops(compiled_flops_per_step(step_fn.lower(...).compile()))
+        meter.start()
+        for i, batch in enumerate(feed, 1):
+            state, m = step_fn(state, batch)
+            if i % log_every == 0:
+                meter.lap(log_every, jax.device_get(m))  # sync point
+    """
+
+    def __init__(
+        self,
+        *,
+        examples_per_step: int = 0,
+        tokens_per_step: int = 0,
+        num_chips: int | None = None,
+        warmup_laps: int = 1,
+    ):
+        self.examples_per_step = examples_per_step
+        self.tokens_per_step = tokens_per_step
+        self.num_chips = num_chips or jax.device_count()
+        self.warmup_laps = warmup_laps
+        self.flops_per_step: float | None = None
+        # (elapsed_seconds, num_steps) per lap; laps must be recorded at
+        # device-sync points or the timing measures async dispatch, not compute
+        self._laps: list[tuple[float, int]] = []
+        self._last: float | None = None
+        self._metrics_history: list[dict[str, float]] = []
+
+    def set_flops(self, flops: float | None) -> None:
+        self.flops_per_step = flops
+
+    def start(self) -> None:
+        self._last = time.perf_counter()
+
+    def lap(self, num_steps: int, device_metrics: dict[str, Any] | None = None) -> dict[str, float]:
+        """Record a timing lap covering ``num_steps`` steps.
+
+        Call ONLY at points where the host has just synchronized with the
+        device (e.g. right after ``device_get`` of that step's metrics) —
+        JAX dispatch is async, so unsynchronized wall-clock deltas measure
+        enqueue time and overstate throughput by up to the lap length.
+        """
+        now = time.perf_counter()
+        if self._last is not None and num_steps > 0:
+            self._laps.append((now - self._last, num_steps))
+        self._last = now
+        record: dict[str, float] = {}
+        if device_metrics is not None:
+            record = {k: float(v) for k, v in device_metrics.items()}
+            self._metrics_history.append(record)
+        return record
+
+    @property
+    def steady_laps(self) -> list[tuple[float, int]]:
+        # first lap(s) include jit compile; drop when there is anything after
+        return self._laps[self.warmup_laps:] if len(self._laps) > self.warmup_laps else self._laps
+
+    def summary(self) -> dict[str, float]:
+        laps = self.steady_laps
+        if not laps:
+            return {}
+        step_time = sum(t for t, _ in laps) / sum(n for _, n in laps)
+        out: dict[str, float] = {
+            "step_time_ms": step_time * 1e3,
+            "steps_per_sec": 1.0 / step_time,
+        }
+        if self.examples_per_step:
+            out["examples_per_sec"] = self.examples_per_step / step_time
+            out["examples_per_sec_per_chip"] = out["examples_per_sec"] / self.num_chips
+        if self.tokens_per_step:
+            out["tokens_per_sec"] = self.tokens_per_step / step_time
+            out["tokens_per_sec_per_chip"] = out["tokens_per_sec"] / self.num_chips
+        peak = device_peak_flops()
+        if self.flops_per_step and peak:
+            out["model_flops_per_sec_per_chip"] = self.flops_per_step / step_time / self.num_chips
+            out["mfu"] = out["model_flops_per_sec_per_chip"] / peak
+        if self._metrics_history:
+            out.update(self._metrics_history[-1])
+        return out
+
+
+class MetricLogger:
+    """Structured per-step logging on process 0; optional TensorBoard."""
+
+    def __init__(self, log_every: int = 10, tensorboard_dir: str | None = None):
+        self.log_every = log_every
+        self._tb = None
+        if tensorboard_dir and jax.process_index() == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception:
+                logger.warning("tensorboard writer unavailable; file logging only")
+
+    def log(self, step: int, metrics: dict[str, float]) -> None:
+        """Emit unconditionally — cadence is the caller's decision."""
+        if jax.process_index() != 0:
+            return
+        logger.info("step %d: %s", step, json.dumps({k: round(v, 6) for k, v in metrics.items()}))
+        if self._tb is not None:
+            for k, v in metrics.items():
+                self._tb.add_scalar(k, v, step)
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()

@@ -1,0 +1,5 @@
+"""Model zoo: the five contract architectures (BASELINE.json configs), in flax."""
+
+from distributeddeeplearningspark_tpu.models.lenet import LeNet5
+
+__all__ = ["LeNet5"]

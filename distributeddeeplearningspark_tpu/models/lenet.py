@@ -1,0 +1,40 @@
+"""LeNet-5 for MNIST — BASELINE.json config 1, the reference's PR1 workload.
+
+The reference trains this with 2 local Spark executors in pure-CPU data
+parallelism (SURVEY.md §3.1); it is the minimum end-to-end slice and the
+acceptance test for DP parity (SPMD psum ≡ driver treeAggregate averaging).
+
+Classic topology (LeCun et al. 1998, as commonly modernized): two 5×5 conv +
+max-pool stages, then 120/84/10 dense. Inputs NHWC ``[B, 28, 28, 1]`` —
+channels-last is the TPU-native layout (the reference's torch modules are
+NCHW; translating that layout would cost a transpose on every step).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class LeNet5(nn.Module):
+    """Input: batch dict with ``image`` [B,28,28,1] float; returns logits [B,10]."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
+        x = batch["image"].astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,7 @@
+"""Custom ops: Pallas TPU kernels and their XLA-HLO fallbacks.
+
+Kernels live here only where stock XLA lowering is insufficient on TPU
+(SURVEY.md §7 hard parts): flash/ring attention and DLRM embedding
+gather/scatter. Everything else relies on XLA fusion — hand-scheduling what
+the compiler already does well is an anti-goal.
+"""

@@ -1,0 +1,164 @@
+"""Collective ops: the NCCL/Horovod surface, rebuilt on XLA collectives.
+
+The reference's only native layer is NCCL ring all-reduce reached through
+``hvd.allreduce`` / ``dist.all_reduce`` (SURVEY.md §2 "Gradient aggregation",
+§5 "Distributed communication backend"). On TPU those calls do not translate
+one-to-one: XLA *is* the collective runtime, scheduling ``psum`` /
+``all_gather`` / ``reduce_scatter`` / ``all_to_all`` over ICI links at compile
+time. Two styles are provided:
+
+- **Implicit (preferred)**: don't call anything — jit a step whose batch is
+  sharded over (data, fsdp) and whose params are replicated; GSPMD inserts the
+  gradient all-reduce. This is the production path used by
+  :mod:`..train.step`.
+- **Explicit**: the functions below, valid inside ``shard_map``/``pmap``
+  bodies, mirroring the Horovod verb set for code that wants manual control
+  (and for tests that pin down collective semantics).
+
+Also here: ``tree_aggregate`` — a driver-side reduction that reproduces the
+reference's *round-synchronous* Spark path (``rdd.mapPartitions`` →
+``treeAggregate`` → driver update, SURVEY.md §3.1) for CPU parity tests, and
+the cross-replica desync sanitizer from SURVEY.md §5.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributeddeeplearningspark_tpu.parallel.mesh import BATCH_AXES
+
+AxisNames = str | Sequence[str]
+
+
+def all_reduce_sum(tree: Any, axis: AxisNames = BATCH_AXES) -> Any:
+    """Horovod ``allreduce(op=Sum)`` ≙ ``lax.psum`` over the mesh axis."""
+    return jax.tree.map(lambda x: lax.psum(x, axis), tree)
+
+
+def all_reduce_mean(tree: Any, axis: AxisNames = BATCH_AXES) -> Any:
+    """Horovod's default ``allreduce`` (average) ≙ ``lax.pmean``."""
+    return jax.tree.map(lambda x: lax.pmean(x, axis), tree)
+
+
+def all_gather(tree: Any, axis: AxisNames = BATCH_AXES, *, tiled: bool = True) -> Any:
+    """``hvd.allgather`` ≙ ``lax.all_gather`` (tiled: concat along dim 0)."""
+    return jax.tree.map(lambda x: lax.all_gather(x, axis, tiled=tiled), tree)
+
+
+def reduce_scatter(tree: Any, axis: AxisNames = BATCH_AXES, *, scatter_dim: int = 0) -> Any:
+    """ZeRO grad sync: ``lax.psum_scatter`` (each shard owns a slice of the sum)."""
+    return jax.tree.map(
+        lambda x: lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True),
+        tree,
+    )
+
+
+def all_to_all(x: jax.Array, axis: str, *, split_dim: int, concat_dim: int) -> jax.Array:
+    """``all_to_all`` — the sharded-embedding-lookup exchange (DLRM, config 4)."""
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True)
+
+
+def broadcast_from(tree: Any, axis: AxisNames = BATCH_AXES, *, root: int = 0) -> Any:
+    """Driver parameter broadcast ≙ select root's copy on every member.
+
+    Inside SPMD code replication normally makes this a no-op; it exists for
+    explicit-mode parity with ``sc.broadcast`` semantics (e.g. re-syncing after
+    a deliberately divergent step in the desync tests).
+    """
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+
+    def bcast(x):
+        y = x
+        for name in names:
+            y = lax.all_gather(y, name, tiled=False)[root]
+        return y
+
+    return jax.tree.map(bcast, tree)
+
+
+def ppermute_shift(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
+    """Ring shift over a mesh axis — the building block of ring attention."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+# --- driver-side (round-synchronous Spark) parity path ----------------------
+
+
+def tree_aggregate(
+    partitions: Sequence[Sequence[Any]],
+    zero: Any,
+    seq_op: Callable[[Any, Any], Any],
+    comb_op: Callable[[Any, Any], Any],
+) -> Any:
+    """Spark ``RDD.treeAggregate`` semantics on the driver.
+
+    ``partitions`` is a sequence of element sequences. Each partition is
+    folded from a fresh copy of ``zero`` with ``seq_op`` (the executor-side
+    fold); per-partition results are then combined with ``comb_op`` (the
+    driver-side merge). Tree depth only changes scheduling, not the result, so
+    the combine is flat. The reference's PR1 pure-CPU path (BASELINE.json
+    config 1) aggregates per-partition gradients this way (SURVEY.md §3.1);
+    tests use it to assert the SPMD ``psum`` step computes the *same numbers*
+    as the round-synchronous Spark loop.
+    """
+    import copy
+
+    per_part = []
+    for part in partitions:
+        acc = copy.deepcopy(zero)
+        for x in part:
+            acc = seq_op(acc, x)
+        per_part.append(acc)
+    if not per_part:
+        return zero
+    return functools.reduce(comb_op, per_part)
+
+
+def grad_average(partition_grads: Sequence[Any]) -> Any:
+    """Average per-partition gradient pytrees on the driver (parity mode)."""
+    n = len(partition_grads)
+    summed = jax.tree.map(lambda *xs: sum(xs), *partition_grads)
+    return jax.tree.map(lambda x: x / n, summed)
+
+
+# --- desync sanitizer (SURVEY.md §5 race detection) -------------------------
+
+
+def params_fingerprint(params: Any) -> jax.Array:
+    """Cheap order-independent scalar fingerprint of a param pytree."""
+    leaves = jax.tree.leaves(params)
+    acc = jnp.float32(0)
+    for leaf in leaves:
+        x = leaf.astype(jnp.float32)
+        acc = acc + jnp.sum(x * jnp.float32(1e-3)) + jnp.sum(jnp.abs(x)) * jnp.float32(1e-6)
+    return acc
+
+
+def assert_replicas_in_sync(params: Any, mesh, atol: float = 1e-5) -> None:
+    """Raise if replicas of nominally-replicated params have diverged.
+
+    The TPU analogue of the reference's silent NCCL-desync failure mode: under
+    SPMD this "cannot happen" on one slice, but host-side bugs (feeding
+    different RNGs / restoring mismatched checkpoints per process) can still
+    diverge state. Fetches each device's local copy and compares fingerprints.
+    """
+    import numpy as np
+
+    fp = jax.jit(params_fingerprint)(params)
+    shards = getattr(fp, "addressable_shards", None)
+    if not shards:
+        return
+    vals = [np.asarray(s.data) for s in shards]
+    ref = vals[0]
+    for i, v in enumerate(vals[1:], start=1):
+        if not np.allclose(ref, v, atol=atol):
+            raise RuntimeError(
+                f"replica desync: device shard {i} fingerprint {v} != shard 0 {ref}"
+            )

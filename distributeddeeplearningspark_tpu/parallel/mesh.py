@@ -1,0 +1,134 @@
+"""Device-mesh construction — the rebuild's answer to NCCL process groups.
+
+The reference (SURVEY.md §1 L3/L2) bootstraps a Horovod / ``torch.distributed``
+NCCL process group per executor and ranks GPUs into a ring. On TPU the
+equivalent object is a :class:`jax.sharding.Mesh`: a named, multi-dimensional
+arrangement of chips over which GSPMD lays out arrays and schedules XLA
+collectives on ICI (intra-slice) / DCN (inter-slice) links.
+
+Every mesh built here always carries the full set of parallelism axes, in a
+fixed order, so that :class:`jax.sharding.PartitionSpec` values written against
+axis *names* are valid on any topology (unused axes simply have size 1):
+
+- ``data``    — data parallelism (gradient psum; the reference's core mode)
+- ``fsdp``    — ZeRO/FSDP-style sharded data parallelism (BASELINE.json config 5)
+- ``tensor``  — tensor/model parallelism (Megatron-style, within attention/MLP)
+- ``seq``     — sequence/context parallelism (ring attention; reserved per
+  SURVEY.md §5 "long-context")
+- ``expert``  — expert parallelism (reserved; no MoE model in the contract)
+
+Axis ordering puts ``tensor``/``seq`` innermost so they map to the
+fastest ICI links on a real pod slice, with ``data`` outermost (crossing DCN
+on multi-slice jobs) — the standard layout from the scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+#: Fixed axis order, outermost (slowest links, DCN) → innermost (fastest ICI).
+MESH_AXES: tuple[str, ...] = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
+
+#: PartitionSpec for the leading (batch) axis of inputs: batch is split across
+#: both the pure-DP and the FSDP axes (FSDP is data parallelism with sharded
+#: parameter storage, so it consumes batch too).
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape; ``-1`` means "absorb all remaining devices".
+
+    Mirrors the knob surface the reference exposes through
+    ``spark.executor.instances`` (number of data-parallel workers): ``data=-1``
+    with everything else 1 reproduces the reference's pure data-parallel
+    layout. At most one axis may be ``-1``.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def axis_sizes(self, num_devices: int) -> tuple[int, ...]:
+        sizes = [self.data, self.fsdp, self.expert, self.seq, self.tensor]
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got spec {self}")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wild:
+            if num_devices % fixed:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes {fixed} ({self})"
+                )
+            sizes[wild[0]] = num_devices // fixed
+        if math.prod(sizes) != num_devices:
+            raise ValueError(
+                f"mesh spec {tuple(sizes)} needs {math.prod(sizes)} devices, "
+                f"got {num_devices}"
+            )
+        return tuple(sizes)
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
+        """Build a :class:`jax.sharding.Mesh` over ``devices`` (default: all)."""
+        if devices is None:
+            devices = jax.devices()
+        devices = np.asarray(devices, dtype=object)
+        sizes = self.axis_sizes(devices.size)
+        return Mesh(devices.reshape(sizes), MESH_AXES)
+
+    @property
+    def dp_degree_is_wild(self) -> bool:
+        return self.data == -1
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """A 1-chip mesh (all axes size 1) — used for dev-box smoke tests."""
+    dev = device if device is not None else jax.devices()[0]
+    return MeshSpec(data=1).build([dev])
+
+
+def batch_spec(mesh: Mesh, *, extra_rank: int = 0, seq_sharded: bool = False) -> P:
+    """PartitionSpec for an input batch: leading axis over (data, fsdp).
+
+    With ``seq_sharded=True`` the second axis (sequence) is split over the
+    ``seq`` mesh axis — the context-parallel input layout.
+    """
+    del mesh  # uniform axis names make this mesh-independent
+    tail: list = [None] * extra_rank
+    if seq_sharded:
+        tail = [AXIS_SEQ] + tail[1:] if extra_rank else [AXIS_SEQ]
+    return P(BATCH_AXES, *tail)
+
+
+def batch_sharding(mesh: Mesh, arr_ndim: int, *, seq_sharded: bool = False) -> NamedSharding:
+    """NamedSharding for a rank-``arr_ndim`` input array with batch leading."""
+    return NamedSharding(mesh, batch_spec(mesh, extra_rank=arr_ndim - 1, seq_sharded=seq_sharded))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """GSPMD-replicated sharding — the reference's driver parameter broadcast.
+
+    The reference's driver pickles weights and ``sc.broadcast``-s them to every
+    executor each round (SURVEY.md §3.1). Under GSPMD a replicated layout *is*
+    that broadcast: XLA materializes one copy per chip and keeps them in sync.
+    """
+    return NamedSharding(mesh, P())
+
+
+def num_data_shards(mesh: Mesh) -> int:
+    """How many ways the global batch is split (the 'executor count')."""
+    return mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]

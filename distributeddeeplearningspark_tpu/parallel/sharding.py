@@ -1,0 +1,151 @@
+"""Parameter-sharding rules: replicated DP, auto-FSDP, and tensor parallelism.
+
+The reference has exactly two parameter layouts (SURVEY.md §2):
+
+- replicated everywhere (driver broadcast + NCCL grad all-reduce) for
+  LeNet/ResNet/BERT/DLRM MLPs, and
+- FSDP-style sharding "across Spark executors" for Llama-2 7B (config 5).
+
+Here both are expressed as :class:`~jax.sharding.PartitionSpec` trees over the
+fixed axis names of :mod:`.mesh`, produced by a small rule engine:
+
+1. explicit regex rules (path pattern → PartitionSpec) take precedence —
+   used for tensor-parallel layouts and sharded embedding tables;
+2. an optional auto-FSDP pass then shards the largest still-unsharded,
+   divisible dimension of every large parameter over the ``fsdp`` axis
+   (the ZeRO-3 layout; gather-on-use is inserted by GSPMD, cf.
+   arXiv:2004.13336 in PAPERS.md);
+3. everything else stays replicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributeddeeplearningspark_tpu.parallel.mesh import AXIS_FSDP
+
+
+def path_str(path) -> str:
+    """Render a jax key path as 'a/b/kernel' for regex matching."""
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(k.name)
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) rules plus an auto-FSDP pass.
+
+    ``rules``: first regex (searched, not fullmatch) that matches the
+    '/'.joined param path wins.
+    ``fsdp``: if True, params with ``size >= fsdp_min_size`` get their largest
+    unsharded divisible dim sharded over the ``fsdp`` mesh axis.
+    """
+
+    rules: tuple[tuple[str, P], ...] = ()
+    fsdp: bool = False
+    fsdp_min_size: int = 2**14
+
+    def spec_for(self, path: str, shape: tuple[int, ...], mesh: Mesh) -> P:
+        spec = None
+        for pattern, s in self.rules:
+            if re.search(pattern, path):
+                spec = s
+                break
+        if spec is None:
+            spec = P(*([None] * len(shape)))
+        if self.fsdp and mesh.shape[AXIS_FSDP] > 1:
+            spec = _add_fsdp_axis(spec, shape, mesh, self.fsdp_min_size)
+        return spec
+
+    def tree_specs(self, params: Any, mesh: Mesh) -> Any:
+        """PartitionSpec tree matching ``params`` (which may be abstract)."""
+
+        def leaf_spec(path, leaf):
+            shape = tuple(getattr(leaf, "shape", ()))
+            if not shape:
+                return P()
+            return self.spec_for(path_str(path), shape, mesh)
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+    def tree_shardings(self, params: Any, mesh: Mesh) -> Any:
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            self.tree_specs(params, mesh),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+
+def _add_fsdp_axis(spec: P, shape: tuple[int, ...], mesh: Mesh, min_size: int) -> P:
+    """Shard the largest unsharded divisible dim of ``shape`` over 'fsdp'."""
+    size = 1
+    for d in shape:
+        size *= d
+    if size < min_size:
+        return spec
+    fsdp_n = mesh.shape[AXIS_FSDP]
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    if any(_mentions(e, AXIS_FSDP) for e in entries):
+        return spec
+    # Largest divisible dim not already assigned a mesh axis.
+    candidates = [
+        (shape[i], i)
+        for i in range(len(shape))
+        if entries[i] is None and shape[i] % fsdp_n == 0
+    ]
+    if not candidates:
+        return spec
+    _, dim = max(candidates)
+    entries[dim] = AXIS_FSDP
+    return P(*entries)
+
+
+def _mentions(entry, axis: str) -> bool:
+    if entry is None:
+        return False
+    if isinstance(entry, str):
+        return entry == axis
+    return axis in entry
+
+
+# --- canned rule sets -------------------------------------------------------
+
+#: Pure data parallelism: everything replicated (reference configs 1–3).
+REPLICATED = ShardingRules()
+
+#: FSDP over the `fsdp` axis for every large param (reference config 5).
+FSDP = ShardingRules(fsdp=True)
+
+
+def state_shardings(state_abstract: Any, mesh: Mesh, rules: ShardingRules) -> Any:
+    """Shardings for a full TrainState pytree.
+
+    Parameters *and* optimizer state follow the same rules — optimizer moments
+    have the same shapes as their params, so the rule engine applies unchanged
+    (this is the cross-replica weight-update sharding of arXiv:2004.13336:
+    with FSDP on, Adam moments are sharded exactly like their params). Scalars
+    (step counters, schedule counts) come out replicated because empty-shape
+    leaves always map to P().
+    """
+
+    def leaf_sharding(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if not shape:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, rules.spec_for(path_str(path), shape, mesh))
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, state_abstract)

@@ -1,0 +1,227 @@
+"""PartitionedDataset — the RDD surface, rebuilt as lazy host-side partitions.
+
+The reference's data plane (SURVEY.md §1 L5, §3.1) is Spark RDDs: immutable,
+lazy, partitioned collections transformed by ``map``/``mapPartitions`` and
+consumed by actions (``collect``, ``reduce``, ``treeAggregate``). The training
+loop itself is ``rdd.mapPartitions(train_fn)``.
+
+Here the same lazy/partitioned user model is kept, but partitions are plain
+Python thunks producing iterables on the *host*; the device never sees an
+"RDD" — terminal consumption happens through
+:mod:`distributeddeeplearningspark_tpu.data.feed`, which assembles global
+batches from partitions and lays them onto the mesh with batch sharding
+(one partition ≙ one data shard, matching Spark's partition↔task pairing).
+
+No lineage/shuffle engine is rebuilt (SURVEY.md §7 "What NOT to build"):
+transformations compose thunks; wide operations the contract needs
+(``treeAggregate``) run on the driver.
+
+Both pyspark camelCase and pythonic snake_case spellings are provided.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import random
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+PartitionFn = Callable[[], Iterable[Any]]
+
+
+class PartitionedDataset:
+    """A lazy, partitioned dataset (RDD-shaped)."""
+
+    def __init__(self, partition_fns: Sequence[PartitionFn]):
+        self._parts: tuple[PartitionFn, ...] = tuple(partition_fns)
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def parallelize(data: Sequence | Iterable, num_slices: int) -> "PartitionedDataset":
+        """Split ``data`` into ``num_slices`` partitions (Spark's slicing rule:
+        contiguous, sizes differing by at most one)."""
+        if num_slices < 1:
+            raise ValueError("num_slices must be >= 1")
+        if isinstance(data, np.ndarray):
+            chunks = np.array_split(data, num_slices)
+            return PartitionedDataset([functools.partial(lambda c: c, c) for c in chunks])
+        items = list(data)
+        n = len(items)
+        bounds = [(i * n // num_slices, (i + 1) * n // num_slices) for i in range(num_slices)]
+        return PartitionedDataset(
+            [functools.partial(lambda lo, hi: items[lo:hi], lo, hi) for lo, hi in bounds]
+        )
+
+    @staticmethod
+    def from_generators(gens: Sequence[PartitionFn]) -> "PartitionedDataset":
+        return PartitionedDataset(gens)
+
+    # -- transformations (lazy) ---------------------------------------------
+
+    def map(self, f: Callable[[Any], Any]) -> "PartitionedDataset":
+        return self.map_partitions(lambda it: map(f, it))
+
+    def filter(self, pred: Callable[[Any], bool]) -> "PartitionedDataset":
+        return self.map_partitions(lambda it: filter(pred, it))
+
+    def flat_map(self, f: Callable[[Any], Iterable[Any]]) -> "PartitionedDataset":
+        return self.map_partitions(lambda it: itertools.chain.from_iterable(map(f, it)))
+
+    def map_partitions(
+        self, f: Callable[[Iterable[Any]], Iterable[Any]]
+    ) -> "PartitionedDataset":
+        """The reference's central primitive: the per-partition trainer is a
+        ``mapPartitions`` closure (SURVEY.md §2 'Per-partition trainer')."""
+        def wrap(part: PartitionFn) -> PartitionFn:
+            return lambda: f(part())
+
+        return PartitionedDataset([wrap(p) for p in self._parts])
+
+    def map_partitions_with_index(
+        self, f: Callable[[int, Iterable[Any]], Iterable[Any]]
+    ) -> "PartitionedDataset":
+        def wrap(i: int, part: PartitionFn) -> PartitionFn:
+            return lambda: f(i, part())
+
+        return PartitionedDataset([wrap(i, p) for i, p in enumerate(self._parts)])
+
+    def batch(self, batch_size: int, *, drop_remainder: bool = True) -> "PartitionedDataset":
+        """Group elements into lists of ``batch_size`` within each partition."""
+
+        def batcher(it: Iterable[Any]) -> Iterator[list]:
+            buf: list = []
+            for x in it:
+                buf.append(x)
+                if len(buf) == batch_size:
+                    yield buf
+                    buf = []
+            if buf and not drop_remainder:
+                yield buf
+
+        return self.map_partitions(batcher)
+
+    def shuffle(self, seed: int = 0) -> "PartitionedDataset":
+        """Per-partition shuffle (narrow; no cross-partition exchange —
+        combine with interleaved partition assignment for global mixing)."""
+
+        def shuf(i: int, it: Iterable[Any]) -> Iterable[Any]:
+            items = list(it)
+            random.Random(seed + i).shuffle(items)
+            return items
+
+        return self.map_partitions_with_index(shuf)
+
+    def repeat(self, count: int | None = None) -> "PartitionedDataset":
+        """Repeat each partition ``count`` times (None = forever)."""
+
+        def rep(part: PartitionFn) -> PartitionFn:
+            def gen() -> Iterator[Any]:
+                if count is None:
+                    while True:
+                        yield from part()
+                else:
+                    for _ in range(count):
+                        yield from part()
+
+            return gen
+
+        return PartitionedDataset([rep(p) for p in self._parts])
+
+    def coalesce(self, num_partitions: int) -> "PartitionedDataset":
+        """Reduce partition count by concatenating adjacent partitions."""
+        if num_partitions >= self.num_partitions:
+            return self
+        groups = np.array_split(np.arange(self.num_partitions), num_partitions)
+        parts = self._parts
+
+        def make(idx: np.ndarray) -> PartitionFn:
+            return lambda: itertools.chain.from_iterable(parts[i]() for i in idx)
+
+        return PartitionedDataset([make(g) for g in groups])
+
+    def zip_with_index(self) -> "PartitionedDataset":
+        """(elem, global_index) pairs; forces a driver count of prior partitions."""
+        sizes = [sum(1 for _ in p()) for p in self._parts]
+        offsets = list(itertools.accumulate([0] + sizes[:-1]))
+
+        def zipper(i: int, it: Iterable[Any]) -> Iterator[tuple]:
+            return ((x, offsets[i] + j) for j, x in enumerate(it))
+
+        return self.map_partitions_with_index(zipper)
+
+    # -- actions (eager, driver-side) ---------------------------------------
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._parts)
+
+    def iter_partition(self, i: int) -> Iterator[Any]:
+        return iter(self._parts[i]())
+
+    def collect(self) -> list:
+        return [x for p in self._parts for x in p()]
+
+    def count(self) -> int:
+        return sum(sum(1 for _ in p()) for p in self._parts)
+
+    def take(self, n: int) -> list:
+        out: list = []
+        for p in self._parts:
+            for x in p():
+                out.append(x)
+                if len(out) == n:
+                    return out
+        return out
+
+    def first(self) -> Any:
+        taken = self.take(1)
+        if not taken:
+            raise ValueError("empty dataset")
+        return taken[0]
+
+    def reduce(self, f: Callable[[Any, Any], Any]) -> Any:
+        return functools.reduce(f, self.collect())
+
+    def tree_aggregate(
+        self,
+        zero: Any,
+        seq_op: Callable[[Any, Any], Any],
+        comb_op: Callable[[Any, Any], Any],
+    ) -> Any:
+        """Spark ``treeAggregate``: per-partition fold, then driver combine.
+
+        This is the reference PR1 gradient-aggregation path (SURVEY.md §3.1);
+        kept for the CPU parity mode and tests, not for the SPMD hot loop.
+        """
+        import copy
+
+        per_part = []
+        for p in self._parts:
+            acc = copy.deepcopy(zero)
+            for x in p():
+                acc = seq_op(acc, x)
+            per_part.append(acc)
+        return functools.reduce(comb_op, per_part)
+
+    def foreach_partition(self, f: Callable[[Iterable[Any]], None]) -> None:
+        for p in self._parts:
+            f(p())
+
+    # -- pyspark camelCase aliases ------------------------------------------
+
+    mapPartitions = map_partitions
+    mapPartitionsWithIndex = map_partitions_with_index
+    flatMap = flat_map
+    treeAggregate = tree_aggregate
+    zipWithIndex = zip_with_index
+    foreachPartition = foreach_partition
+
+    def getNumPartitions(self) -> int:
+        """pyspark spells this as a method; kept callable for ported code."""
+        return self.num_partitions
+
+    def __repr__(self) -> str:
+        return f"PartitionedDataset(num_partitions={self.num_partitions})"

@@ -1,0 +1,24 @@
+"""Training engine: state, losses, jitted SPMD step, optimizers, trainer."""
+
+from distributeddeeplearningspark_tpu.train import losses, optim
+from distributeddeeplearningspark_tpu.train.state import TrainState
+from distributeddeeplearningspark_tpu.train.step import (
+    init_state,
+    jit_eval_step,
+    jit_train_step,
+    make_eval_step,
+    make_train_step,
+)
+from distributeddeeplearningspark_tpu.train.trainer import Trainer
+
+__all__ = [
+    "losses",
+    "optim",
+    "TrainState",
+    "Trainer",
+    "init_state",
+    "make_train_step",
+    "make_eval_step",
+    "jit_train_step",
+    "jit_eval_step",
+]

@@ -1,0 +1,62 @@
+"""Loss functions for the five contract workloads (BASELINE.json configs).
+
+Each takes (model outputs, batch dict) and returns (scalar loss, metrics dict).
+All reductions are plain global means: under GSPMD with the batch sharded over
+(data, fsdp), a ``jnp.mean`` over the batch axis *is* the cross-replica
+average the reference obtains via NCCL all-reduce of per-GPU means.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def softmax_xent(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, dict]:
+    """Classification (LeNet-5/MNIST, ResNet-50/ImageNet): mean CE + accuracy."""
+    labels = batch["label"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    acc = (jnp.argmax(logits, -1) == labels).mean()
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+def masked_lm(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, dict]:
+    """BERT MLM: CE over masked positions only, weighted mean.
+
+    ``batch['mlm_labels']`` holds target ids, ``batch['mlm_weights']`` is 1.0
+    at masked positions / 0.0 elsewhere.
+    """
+    labels = batch["mlm_labels"]
+    weights = batch["mlm_weights"].astype(jnp.float32)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    denom = jnp.maximum(weights.sum(), 1.0)
+    loss = (per_tok * weights).sum() / denom
+    acc = ((jnp.argmax(logits, -1) == labels) * weights).sum() / denom
+    return loss, {"loss": loss, "mlm_accuracy": acc}
+
+
+def binary_xent(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, dict]:
+    """CTR prediction (Wide&Deep/DLRM on Criteo): sigmoid BCE + accuracy."""
+    labels = batch["label"].astype(jnp.float32)
+    logits = logits.reshape(labels.shape)
+    loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+    acc = ((logits > 0) == (labels > 0.5)).mean()
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+def causal_lm(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, dict]:
+    """Next-token CE (Llama-2 LoRA fine-tune); respects ``loss_mask`` if given."""
+    labels = batch["input_ids"][:, 1:]
+    logits = logits[:, :-1]
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask[:, 1:].astype(jnp.float32)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (per_tok * mask).sum() / denom
+    else:
+        loss = per_tok.mean()
+    return loss, {"loss": loss, "perplexity": jnp.exp(loss)}

@@ -1,0 +1,72 @@
+"""Optimizers & LR schedules for the contract workloads, on optax.
+
+Reference optimizer surface (SURVEY.md §2 'Optimizers'): torch SGD/momentum
+(LeNet/ResNet), AdamW + linear warmup (BERT), and per-param-group handling
+(LoRA trains adapters only). optax equivalents, plus the masking combinator
+LoRA needs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+
+
+def sgd(learning_rate: float | optax.Schedule, momentum: float = 0.9,
+        nesterov: bool = False, weight_decay: float = 0.0) -> optax.GradientTransformation:
+    tx = optax.sgd(learning_rate, momentum=momentum, nesterov=nesterov)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def adamw(learning_rate: float | optax.Schedule, *, b1: float = 0.9, b2: float = 0.999,
+          eps: float = 1e-8, weight_decay: float = 0.01) -> optax.GradientTransformation:
+    return optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+
+
+def warmup_linear(peak_lr: float, warmup_steps: int, total_steps: int,
+                  end_lr: float = 0.0) -> optax.Schedule:
+    """BERT-style linear warmup then linear decay."""
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, peak_lr, warmup_steps),
+            optax.linear_schedule(peak_lr, end_lr, max(total_steps - warmup_steps, 1)),
+        ],
+        [warmup_steps],
+    )
+
+
+def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
+                  end_factor: float = 0.0) -> optax.Schedule:
+    """ResNet/Llama-style warmup + cosine decay."""
+    return optax.warmup_cosine_decay_schedule(
+        0.0, peak_lr, warmup_steps, total_steps, peak_lr * end_factor
+    )
+
+
+def masked(tx: optax.GradientTransformation,
+           trainable: Callable[[str], bool]) -> optax.GradientTransformation:
+    """Train only params whose '/'.joined path satisfies ``trainable``.
+
+    The LoRA fine-tune path: base weights frozen (zero update, no optimizer
+    moments allocated), adapters trained — the optax equivalent of the
+    reference's per-param-group ``requires_grad`` filtering.
+    """
+    from distributeddeeplearningspark_tpu.parallel.sharding import path_str
+
+    def mask_of(params: Any) -> Any:
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: trainable(path_str(path)), params
+        )
+
+    return optax.multi_transform(
+        {True: tx, False: optax.set_to_zero()},
+        lambda params: jax.tree.map(lambda t: t, mask_of(params)),
+    )
+
+
+def with_grad_clip(tx: optax.GradientTransformation, max_norm: float) -> optax.GradientTransformation:
+    return optax.chain(optax.clip_by_global_norm(max_norm), tx)

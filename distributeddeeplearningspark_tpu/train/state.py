@@ -1,0 +1,51 @@
+"""TrainState — the pure-data pytree carried through the jitted train step.
+
+The reference keeps model weights and optimizer buffers in torch
+``nn.Module``/``Optimizer`` objects rebuilt inside each ``mapPartitions``
+closure from broadcast bytes (SURVEY.md §2 'Per-partition trainer'). TPU-first,
+the state must instead be an explicit pytree so it can be donated to the jitted
+step, sharded by GSPMD, and checkpointed by orbax as plain arrays.
+
+Statics (the model ``apply_fn``, the optax transform) live on the
+:class:`~distributeddeeplearningspark_tpu.train.trainer.Trainer`, never in the
+pytree — keeping the state trivially serializable and shardable.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    """step counter, params, optimizer state, mutable model collections, RNG.
+
+    ``mutable`` holds non-differentiated model collections (e.g. BatchNorm
+    ``batch_stats`` for ResNet-50); empty dict for purely functional models.
+    ``rng`` is the per-step key (dropout, MLM masking done on device).
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    mutable: dict[str, Any]
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, *, params: Any, opt_state: Any, mutable: dict[str, Any] | None = None,
+               rng: jax.Array | None = None) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            mutable=mutable or {},
+            rng=rng if rng is not None else jax.random.PRNGKey(0),
+        )
+
+    @property
+    def num_params(self) -> int:
+        return sum(int(x.size) for x in jax.tree.leaves(self.params))

@@ -1,0 +1,175 @@
+"""The jitted SPMD train step — the rebuild's entire hot loop.
+
+The reference's hot loop is the per-partition closure dispatched by
+``rdd.mapPartitions(train_fn)``: rebuild model from broadcast weights, then
+``for batch: forward → backward → optimizer.step → (NCCL all-reduce)``
+(SURVEY.md §3.1/§3.2). Here all of that — including gradient synchronization —
+is ONE ``jax.jit``-compiled function of ``(TrainState, batch) → (TrainState,
+metrics)``:
+
+- the batch arrives sharded over the (data, fsdp) mesh axes, so each chip
+  computes gradients on its shard;
+- params are laid out by :class:`..parallel.sharding.ShardingRules`
+  (replicated for DP ≙ driver broadcast; 'fsdp'-sharded for ZeRO);
+- GSPMD inserts the gradient all-reduce (or reduce-scatter under FSDP) that
+  the reference issues manually via Horovod/NCCL — no collective calls appear
+  in this file, by design;
+- the state is donated, so parameter memory is updated in place in HBM.
+
+No Python control flow depends on data; shapes are static; the step compiles
+once per (shapes, mesh) and is dispatched asynchronously so host-side input
+prep overlaps device compute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributeddeeplearningspark_tpu.parallel.mesh import BATCH_AXES
+from distributeddeeplearningspark_tpu.parallel.sharding import ShardingRules, state_shardings
+from distributeddeeplearningspark_tpu.train.state import TrainState
+
+LossFn = Callable[[Any, dict[str, Any]], tuple[jax.Array, dict[str, Any]]]
+
+
+def make_train_step(
+    apply_fn: Callable,
+    tx: optax.GradientTransformation,
+    loss_fn: LossFn,
+    *,
+    mutable_keys: Sequence[str] = (),
+    rng_names: Sequence[str] = ("dropout",),
+    compute_dtype: Any = None,
+) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, Any]]]:
+    """Build the (state, batch) → (state, metrics) function (un-jitted).
+
+    ``apply_fn`` is a flax ``Module.apply``-shaped callable taking
+    ``(variables, batch, train=...)``; models in
+    :mod:`distributeddeeplearningspark_tpu.models` all follow this convention.
+    ``compute_dtype`` (e.g. jnp.bfloat16) casts inputs for the forward pass —
+    params stay in their stored dtype; MXU-bound matmuls pick up bf16 via the
+    models' own ``dtype`` attributes, so this only affects raw inputs.
+    """
+    mutable_keys = tuple(mutable_keys)
+
+    def train_step(state: TrainState, batch: dict[str, Any]):
+        next_rng, step_rng = jax.random.split(jax.random.fold_in(state.rng, state.step))
+        rngs = {name: jax.random.fold_in(step_rng, i) for i, name in enumerate(rng_names)}
+
+        if compute_dtype is not None:
+            batch = jax.tree.map(
+                lambda x: x.astype(compute_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                batch,
+            )
+
+        def loss_of(params):
+            variables = {"params": params, **state.mutable}
+            if mutable_keys:
+                outputs, updated = apply_fn(
+                    variables, batch, train=True, mutable=list(mutable_keys), rngs=rngs
+                )
+            else:
+                outputs = apply_fn(variables, batch, train=True, rngs=rngs)
+                updated = {}
+            loss, metrics = loss_fn(outputs, batch)
+            return loss, (metrics, updated)
+
+        (_, (metrics, updated)), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            state.params
+        )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            mutable={**state.mutable, **updated} if mutable_keys else state.mutable,
+            rng=next_rng,
+        )
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(apply_fn: Callable, loss_fn: LossFn) -> Callable:
+    """(state, batch) → metrics, no grads, model in inference mode."""
+
+    def eval_step(state: TrainState, batch: dict[str, Any]):
+        variables = {"params": state.params, **state.mutable}
+        outputs = apply_fn(variables, batch, train=False)
+        _, metrics = loss_fn(outputs, batch)
+        return metrics
+
+    return eval_step
+
+
+def batch_shardings_like(batch: Any, mesh: Mesh) -> Any:
+    """Per-leaf NamedSharding: leading axis over (data, fsdp), rest replicated.
+
+    A PartitionSpec shorter than the array rank leaves trailing dims
+    replicated, so one spec covers every leaf rank.
+    """
+    sh = NamedSharding(mesh, P(BATCH_AXES))
+    return jax.tree.map(lambda _: sh, batch)
+
+
+def jit_train_step(
+    train_step: Callable,
+    mesh: Mesh,
+    state_sh: Any,
+) -> Callable:
+    """Compile with explicit in/out shardings and state donation."""
+    batch_sh = NamedSharding(mesh, P(BATCH_AXES))
+    metric_sh = NamedSharding(mesh, P())
+    return jax.jit(
+        train_step,
+        in_shardings=(state_sh, batch_sh),
+        out_shardings=(state_sh, metric_sh),
+        donate_argnums=(0,),
+    )
+
+
+def jit_eval_step(eval_step: Callable, mesh: Mesh, state_sh: Any) -> Callable:
+    batch_sh = NamedSharding(mesh, P(BATCH_AXES))
+    metric_sh = NamedSharding(mesh, P())
+    return jax.jit(eval_step, in_shardings=(state_sh, batch_sh), out_shardings=metric_sh)
+
+
+def init_state(
+    model,
+    tx: optax.GradientTransformation,
+    sample_batch: dict[str, Any],
+    mesh: Mesh,
+    rules: ShardingRules,
+    *,
+    seed: int = 0,
+) -> tuple[TrainState, Any]:
+    """Initialize a sharded TrainState directly on the mesh.
+
+    The init function is jitted with ``out_shardings`` derived from the rules,
+    so a 7B-param FSDP state materializes already sharded — each chip only
+    ever holds its slice (no host-side full copy, unlike the reference's
+    driver-held ``state_dict``). Returns (state, sharding pytree).
+    """
+    init_rng = jax.random.PRNGKey(seed)
+
+    def init_fn(rng):
+        model_rng, state_rng = jax.random.split(rng)
+        variables = model.init({"params": model_rng, "dropout": model_rng}, sample_batch, train=False)
+        variables = dict(variables)
+        params = variables.pop("params")
+        mutable = {k: v for k, v in variables.items()}
+        opt_state = tx.init(params)
+        return TrainState.create(params=params, opt_state=opt_state, mutable=mutable, rng=state_rng)
+
+    abstract = jax.eval_shape(init_fn, init_rng)
+    shardings = state_shardings(abstract, mesh, rules)
+    state = jax.jit(init_fn, out_shardings=shardings)(init_rng)
+    return state, shardings

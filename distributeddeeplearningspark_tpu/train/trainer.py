@@ -1,0 +1,183 @@
+"""Trainer — the driver-side loop that replaces Spark's round orchestration.
+
+The reference driver (SURVEY.md §3.1) loops: broadcast params → dispatch
+``mapPartitions(train_fn)`` tasks → aggregate grads → update. Here the loop
+body is one async-dispatched jitted SPMD step; the Python loop's only jobs are
+feeding prefetched sharded batches, periodic metrics, and checkpoint hooks.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Sequence
+
+import jax
+import optax
+
+from distributeddeeplearningspark_tpu.data.feed import host_batches, put_global, stack_examples
+from distributeddeeplearningspark_tpu.data.prefetch import prefetch_to_device
+from distributeddeeplearningspark_tpu.metrics import (
+    Meter,
+    MetricLogger,
+    compiled_flops_per_step,
+)
+from distributeddeeplearningspark_tpu.parallel.mesh import num_data_shards
+from distributeddeeplearningspark_tpu.parallel.sharding import REPLICATED, ShardingRules
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+from distributeddeeplearningspark_tpu.session import Session
+from distributeddeeplearningspark_tpu.train import step as step_lib
+from distributeddeeplearningspark_tpu.train.state import TrainState
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.trainer")
+
+
+class Trainer:
+    """Bind (session, model, loss, optimizer, sharding rules) into a train loop.
+
+    ``model`` is a flax Module whose ``__call__(batch, *, train)`` returns the
+    outputs consumed by ``loss_fn(outputs, batch) → (loss, metrics)``.
+    """
+
+    def __init__(
+        self,
+        session: Session | None,
+        model,
+        loss_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        *,
+        rules: ShardingRules = REPLICATED,
+        mutable_keys: Sequence[str] = (),
+        rng_names: Sequence[str] = ("dropout",),
+        seed: int = 0,
+        checkpointer=None,
+    ):
+        self.session = session or Session.get_or_default()
+        self.mesh = self.session.mesh
+        self.model = model
+        self.loss_fn = loss_fn
+        self.tx = optimizer
+        self.rules = rules
+        self.mutable_keys = tuple(mutable_keys)
+        self.rng_names = tuple(rng_names)
+        self.seed = seed
+        self.checkpointer = checkpointer
+
+        self.state: TrainState | None = None
+        self.state_shardings = None
+        self._train_step = None
+        self._eval_step = None
+
+    # -- setup --------------------------------------------------------------
+
+    def init(self, sample_batch: dict[str, Any]) -> TrainState:
+        """Initialize sharded state from one host example batch."""
+        self.state, self.state_shardings = step_lib.init_state(
+            self.model, self.tx, sample_batch, self.mesh, self.rules, seed=self.seed
+        )
+        if self.mutable_keys == () and self.state.mutable:
+            self.mutable_keys = tuple(self.state.mutable.keys())
+        train = step_lib.make_train_step(
+            self.model.apply, self.tx, self.loss_fn,
+            mutable_keys=self.mutable_keys, rng_names=self.rng_names,
+        )
+        self._train_step = step_lib.jit_train_step(train, self.mesh, self.state_shardings)
+        ev = step_lib.make_eval_step(self.model.apply, self.loss_fn)
+        self._eval_step = step_lib.jit_eval_step(ev, self.mesh, self.state_shardings)
+        logger.info("initialized %s params over mesh %s",
+                    f"{self.state.num_params:,}", dict(self.mesh.shape))
+        return self.state
+
+    def _feed(self, dataset: PartitionedDataset, batch_size: int):
+        hb = host_batches(dataset, batch_size, num_shards=num_data_shards(self.mesh))
+        return prefetch_to_device(hb, self.mesh)
+
+    # -- training -----------------------------------------------------------
+
+    def fit(
+        self,
+        dataset: PartitionedDataset,
+        *,
+        batch_size: int,
+        steps: int | None = None,
+        epochs: int | None = None,
+        tokens_per_example: int = 0,
+        log_every: int = 10,
+        checkpoint_every: int | None = None,
+        eval_dataset: PartitionedDataset | None = None,
+        eval_every: int | None = None,
+        callbacks: Sequence[Callable[[int, dict], None]] = (),
+    ) -> tuple[TrainState, dict[str, float]]:
+        """Train until ``steps`` (or dataset exhaustion × ``epochs``).
+
+        Returns (final state, summary metrics). The loop never blocks on the
+        device except at metric log points — steps dispatch asynchronously.
+        """
+        if self.state is None:
+            sample = self._sample_batch(dataset, batch_size)
+            self.init(sample)
+        assert self._train_step is not None
+
+        if epochs is not None:
+            dataset = dataset.repeat(epochs)
+
+        meter = Meter(
+            examples_per_step=batch_size,
+            tokens_per_step=batch_size * tokens_per_example,
+            num_chips=self.mesh.devices.size,
+        )
+        mlog = MetricLogger(log_every=log_every)
+        meter.start()
+
+        step_i = int(jax.device_get(self.state.step))
+        lap_start = step_i
+        last_metrics: dict[str, float] = {}
+        for batch in self._feed(dataset, batch_size):
+            if steps is not None and step_i >= steps:
+                break
+            self.state, metrics = self._train_step(self.state, batch)
+            step_i += 1
+            if step_i % log_every == 0 or (steps is not None and step_i >= steps):
+                # device_get blocks until this step's metrics exist, so the
+                # lap boundary is a true device-sync point — timing is honest.
+                last_metrics = meter.lap(step_i - lap_start, jax.device_get(metrics))
+                lap_start = step_i
+                mlog.log(step_i, {**last_metrics, **meter.summary()})
+            for cb in callbacks:
+                cb(step_i, last_metrics)
+            if checkpoint_every and self.checkpointer and step_i % checkpoint_every == 0:
+                self.checkpointer.save(step_i, self.state)
+            if eval_every and eval_dataset is not None and step_i % eval_every == 0:
+                emetrics = self.evaluate(eval_dataset, batch_size=batch_size)
+                mlog.log(step_i, {f"eval_{k}": v for k, v in emetrics.items()})
+
+        jax.block_until_ready(self.state.params)
+        summary = {**meter.summary(), **last_metrics}
+        if self.checkpointer and checkpoint_every:
+            self.checkpointer.save(step_i, self.state)
+            self.checkpointer.wait()
+        mlog.close()
+        return self.state, summary
+
+    def evaluate(self, dataset: PartitionedDataset, *, batch_size: int) -> dict[str, float]:
+        assert self._eval_step is not None and self.state is not None
+        totals: dict[str, float] = {}
+        n = 0
+        for batch in self._feed(dataset, batch_size):
+            m = jax.device_get(self._eval_step(self.state, batch))
+            for k, v in m.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            n += 1
+        return {k: v / max(n, 1) for k, v in totals.items()}
+
+    def compiled_cost(self, batch: dict[str, Any]) -> float | None:
+        """FLOPs per step from XLA cost analysis (for MFU reporting)."""
+        assert self._train_step is not None and self.state is not None
+        lowered = self._train_step.lower(self.state, batch)
+        return compiled_flops_per_step(lowered.compile())
+
+    def _sample_batch(self, dataset: PartitionedDataset, batch_size: int):
+        examples = dataset.take(max(2, min(batch_size, 8)))
+        sample = stack_examples(examples)
+        # init only needs shapes/dtypes; small batch keeps init cheap, but we
+        # place it like a real batch so sharding propagation sees the layout.
+        return sample

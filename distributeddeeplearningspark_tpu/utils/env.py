@@ -1,0 +1,40 @@
+"""Environment/platform plumbing.
+
+Some deployments (including this sandbox) register an accelerator PJRT plugin
+from ``sitecustomize`` *before* user code runs, which defeats the documented
+``JAX_PLATFORMS=cpu`` / ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+env workflow: by the time a driver script runs, the env vars have already been
+read (or pre-empted). ``jax.config.update`` wins regardless of import order as
+long as no backend client has been created yet, so Session creation funnels
+through here first.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def apply_env_platform_config(min_cpu_devices: int | None = None) -> None:
+    """Honor JAX_PLATFORMS / XLA_FLAGS env intent via jax.config (best effort).
+
+    No-op once backends are initialized (config.update then raises; we keep
+    the original error surface by swallowing only that case).
+    """
+    import jax
+
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    primary = plats.split(",")[0] if plats else ""
+    try:
+        if plats:
+            jax.config.update("jax_platforms", plats)
+        if primary == "cpu":
+            m = re.search(
+                r"xla_force_host_platform_device_count=(\d+)",
+                os.environ.get("XLA_FLAGS", ""),
+            )
+            n = int(m.group(1)) if m else (min_cpu_devices or 0)
+            if n > 1:
+                jax.config.update("jax_num_cpu_devices", n)
+    except RuntimeError:
+        pass  # backend already live; the caller's device checks will report

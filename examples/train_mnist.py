@@ -1,0 +1,55 @@
+"""Config-1 driver script: MNIST LeNet-5, 2 local executors, data-parallel.
+
+The reference's PR1 workload (BASELINE.json config 1). Run directly or via
+the spark-submit-shaped CLI::
+
+    dlsubmit --master local[2] examples/train_mnist.py
+    python examples/train_mnist.py --master local[2] --steps 150
+"""
+
+import argparse
+import logging
+
+import optax
+
+from distributeddeeplearningspark_tpu import Session, Trainer
+from distributeddeeplearningspark_tpu.data.sources import load_mnist_idx, synthetic_mnist
+from distributeddeeplearningspark_tpu.models import LeNet5
+from distributeddeeplearningspark_tpu.train import losses
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--master", default="local[2]")
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--data-dir", default=None, help="dir with MNIST IDX files; synthetic if unset")
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    spark = Session.builder.master(args.master).appName("mnist-lenet5").getOrCreate()
+    print(spark)
+
+    if args.data_dir:
+        train_ds = load_mnist_idx(args.data_dir, "train", num_partitions=spark.default_parallelism)
+        test_ds = load_mnist_idx(args.data_dir, "test", num_partitions=spark.default_parallelism)
+    else:
+        train_ds = synthetic_mnist(4096, num_partitions=spark.default_parallelism, seed=0)
+        test_ds = synthetic_mnist(512, num_partitions=spark.default_parallelism, seed=99)
+
+    trainer = Trainer(
+        spark, LeNet5(), losses.softmax_xent, optax.sgd(args.lr, momentum=0.9)
+    )
+    state, summary = trainer.fit(
+        train_ds.repeat(), batch_size=args.batch_size, steps=args.steps, log_every=25
+    )
+    metrics = trainer.evaluate(test_ds, batch_size=args.batch_size)
+    print(f"train summary: {summary}")
+    print(f"test metrics:  {metrics}")
+    spark.stop()
+
+
+if __name__ == "__main__":
+    main()

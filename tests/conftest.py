@@ -1,0 +1,42 @@
+"""Test harness: 8 fake CPU devices (SURVEY.md §4 "Multi-device sim").
+
+Must run before any jax import: forces the CPU backend (the sandbox default is
+the experimental `axon` TPU platform) and splits the host into 8 virtual
+devices so real Mesh/pjit/GSPMD code paths — including collectives — execute
+in unit tests exactly as they would on an 8-chip slice.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# The sandbox's sitecustomize pre-imports jax and registers the `axon` TPU
+# PJRT plugin before any conftest can run, so the env vars above may be read
+# too late; config.update wins regardless of import order.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+import pytest  # noqa: E402
+
+from distributeddeeplearningspark_tpu.session import Session  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_session():
+    """Each test gets a clean Session slate (module-level singleton)."""
+    yield
+    if Session._active is not None:
+        Session._active.stop()
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,59 @@
+"""Regression tests for feed/CLI/collectives bugs found in review."""
+
+import numpy as np
+
+from distributeddeeplearningspark_tpu.cli import build_parser
+from distributeddeeplearningspark_tpu.data.feed import host_batches
+from distributeddeeplearningspark_tpu.parallel import collectives
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+
+def _examples(n):
+    return [{"x": np.float32(i)} for i in range(n)]
+
+
+def test_host_batches_aligned_keeps_remainder():
+    # 2 partitions × 50 examples, batch 32, 2 shards → aligned path;
+    # drop_remainder=False must keep the final partial (even-sized) batch.
+    ds = PartitionedDataset.parallelize(_examples(100), 2)
+    kept = list(host_batches(ds, 32, num_shards=2, drop_remainder=False))
+    total = sum(b["x"].shape[0] for b in kept)
+    assert total == 100
+    dropped = list(host_batches(ds, 32, num_shards=2, drop_remainder=True))
+    assert sum(b["x"].shape[0] for b in dropped) == 96
+
+
+def test_host_batches_chained_remainder():
+    ds = PartitionedDataset.parallelize(_examples(10), 3)  # 3 parts, 1 shard
+    kept = list(host_batches(ds, 4, num_shards=1, drop_remainder=False))
+    assert [b["x"].shape[0] for b in kept] == [4, 4, 2]
+
+
+def test_tree_aggregate_distinct_seq_comb_ops():
+    # seq_op squares-and-sums within a partition; comb_op plain-sums across.
+    parts = [[1.0, 2.0], [3.0, 4.0]]
+    got = collectives.tree_aggregate(
+        parts, 0.0, lambda acc, x: acc + x * x, lambda a, b: a + b
+    )
+    assert got == (1 + 4) + (9 + 16)  # comb_op must NOT square again
+
+
+def test_tree_aggregate_empty():
+    assert collectives.tree_aggregate([], 5.0, lambda a, x: a + x, lambda a, b: a + b) == 5.0
+
+
+def test_rdd_getnumpartitions_is_callable():
+    ds = PartitionedDataset.parallelize(range(4), 2)
+    assert ds.getNumPartitions() == 2  # pyspark spells it as a method
+
+
+def test_cli_parser_conf_mapping():
+    args = build_parser().parse_args(
+        ["--master", "local[2]", "--name", "app", "--conf", "mesh.fsdp=2",
+         "--num-executors", "4", "script.py", "--steps", "5"]
+    )
+    assert args.master == "local[2]"
+    assert args.conf == ["mesh.fsdp=2"]
+    assert args.num_executors == 4
+    assert args.script == "script.py"
+    assert args.script_args == ["--steps", "5"]

@@ -1,0 +1,76 @@
+"""Mesh construction and sharding-rule unit tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributeddeeplearningspark_tpu.parallel import (
+    FSDP,
+    MESH_AXES,
+    MeshSpec,
+    ShardingRules,
+    batch_sharding,
+    num_data_shards,
+)
+
+
+def test_meshspec_wildcard_data(eight_devices):
+    mesh = MeshSpec().build()
+    assert mesh.shape["data"] == 8
+    assert all(mesh.shape[a] == 1 for a in MESH_AXES if a != "data")
+
+
+def test_meshspec_mixed_axes(eight_devices):
+    mesh = MeshSpec(data=2, fsdp=2, tensor=2).build()
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["tensor"] == 2
+    assert num_data_shards(mesh) == 4
+
+
+def test_meshspec_subset_of_devices(eight_devices):
+    mesh = MeshSpec(data=2).build(eight_devices[:2])
+    assert mesh.devices.size == 2
+
+
+def test_meshspec_errors(eight_devices):
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).build()  # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, fsdp=-1).build()
+
+
+def test_batch_sharding_splits_leading_axis(eight_devices):
+    mesh = MeshSpec(data=4, fsdp=2).build()
+    x = jnp.arange(16 * 3, dtype=jnp.float32).reshape(16, 3)
+    gx = jax.device_put(x, batch_sharding(mesh, x.ndim))
+    # 8 shards of 2 rows each
+    assert len(gx.addressable_shards) == 8
+    assert all(s.data.shape == (2, 3) for s in gx.addressable_shards)
+    np.testing.assert_array_equal(np.asarray(gx), np.asarray(x))
+
+
+def test_fsdp_rules_shard_largest_dim(eight_devices):
+    mesh = MeshSpec(data=2, fsdp=4).build()
+    params = {"layer": {"kernel": jnp.zeros((128, 512)), "bias": jnp.zeros((512,))}}
+    specs = FSDP.tree_specs(params, mesh)
+    assert specs["layer"]["kernel"] == P(None, "fsdp")  # 512 is largest dim
+    # bias: 512 >= min_size? 512 < 2**14 → replicated
+    assert specs["layer"]["bias"] == P(None)
+
+
+def test_explicit_rules_take_precedence(eight_devices):
+    mesh = MeshSpec(data=2, fsdp=2, tensor=2).build()
+    rules = ShardingRules(rules=(("attn/qkv/kernel", P(None, "tensor")),), fsdp=True, fsdp_min_size=1)
+    params = {"attn": {"qkv": {"kernel": jnp.zeros((64, 64))}}}
+    spec = rules.tree_specs(params, mesh)["attn"]["qkv"]["kernel"]
+    # tensor axis from explicit rule, fsdp added on the remaining dim
+    assert spec == P("fsdp", "tensor")
+
+
+def test_scalar_leaves_replicated(eight_devices):
+    mesh = MeshSpec().build()
+    specs = FSDP.tree_specs({"count": jnp.zeros(())}, mesh)
+    assert specs["count"] == P()

@@ -1,0 +1,82 @@
+"""PartitionedDataset (RDD-shaped) semantics tests."""
+
+import numpy as np
+
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+
+def test_parallelize_slicing():
+    ds = PartitionedDataset.parallelize(list(range(10)), 3)
+    assert ds.num_partitions == 3
+    parts = [list(ds.iter_partition(i)) for i in range(3)]
+    assert [len(p) for p in parts] == [3, 3, 4]
+    assert ds.collect() == list(range(10))
+
+
+def test_lazy_map_filter():
+    evals = []
+
+    def f(x):
+        evals.append(x)
+        return x * 2
+
+    ds = PartitionedDataset.parallelize(range(4), 2).map(f)
+    assert evals == []  # lazy
+    assert ds.collect() == [0, 2, 4, 6]
+    assert ds.filter(lambda x: x > 2).collect() == [4, 6]
+
+
+def test_map_partitions_with_index():
+    ds = PartitionedDataset.parallelize(range(6), 3)
+    tagged = ds.map_partitions_with_index(lambda i, it: ((i, x) for x in it))
+    assert tagged.collect() == [(0, 0), (0, 1), (1, 2), (1, 3), (2, 4), (2, 5)]
+
+
+def test_batch_and_repeat():
+    ds = PartitionedDataset.parallelize(range(10), 2).batch(2)
+    assert ds.collect() == [[0, 1], [2, 3], [5, 6], [7, 8]]  # drop remainder per partition
+    r = PartitionedDataset.parallelize(range(2), 1).repeat(3)
+    assert r.collect() == [0, 1, 0, 1, 0, 1]
+
+
+def test_shuffle_deterministic_and_partition_local():
+    ds = PartitionedDataset.parallelize(range(8), 2)
+    s1 = ds.shuffle(seed=1).collect()
+    s2 = ds.shuffle(seed=1).collect()
+    assert s1 == s2
+    assert sorted(s1[:4]) == [0, 1, 2, 3]  # partition contents preserved
+    assert sorted(s1[4:]) == [4, 5, 6, 7]
+
+
+def test_tree_aggregate_matches_sum():
+    ds = PartitionedDataset.parallelize(range(100), 4)
+    total = ds.tree_aggregate(0, lambda acc, x: acc + x, lambda a, b: a + b)
+    assert total == sum(range(100))
+
+
+def test_actions():
+    ds = PartitionedDataset.parallelize(range(7), 3)
+    assert ds.count() == 7
+    assert ds.take(3) == [0, 1, 2]
+    assert ds.first() == 0
+    assert ds.reduce(lambda a, b: a + b) == 21
+    assert ds.coalesce(2).num_partitions == 2
+    assert ds.coalesce(2).collect() == list(range(7))
+
+
+def test_zip_with_index():
+    ds = PartitionedDataset.parallelize(list("abcd"), 2)
+    assert ds.zip_with_index().collect() == [("a", 0), ("b", 1), ("c", 2), ("d", 3)]
+
+
+def test_numpy_parallelize():
+    arr = np.arange(12).reshape(6, 2)
+    ds = PartitionedDataset.parallelize(arr, 3)
+    got = np.concatenate([np.asarray(list(ds.iter_partition(i))) for i in range(3)])
+    np.testing.assert_array_equal(got.reshape(6, 2), arr)
+
+
+def test_pyspark_aliases():
+    ds = PartitionedDataset.parallelize(range(4), 2)
+    assert ds.mapPartitions(lambda it: (x + 1 for x in it)).collect() == [1, 2, 3, 4]
+    assert ds.flatMap(lambda x: [x, x]).count() == 8

@@ -1,0 +1,64 @@
+"""Session (SparkSession-shaped) lifecycle tests."""
+
+import pytest
+
+from distributeddeeplearningspark_tpu import Session
+
+
+def test_builder_local2(eight_devices):
+    spark = Session.builder.master("local[2]").appName("t").getOrCreate()
+    assert spark.app_name == "t"
+    assert spark.num_devices == 2
+    assert spark.default_parallelism == 2
+    spark.stop()
+
+
+def test_get_or_create_is_singleton(eight_devices):
+    a = Session.builder.master("local[2]").getOrCreate()
+    b = Session.builder.getOrCreate()
+    assert a is b
+    a.stop()
+    c = Session.builder.master("local[4]").getOrCreate()
+    assert c is not a
+    assert c.num_devices == 4
+
+
+def test_executor_instances_conf(eight_devices):
+    spark = (
+        Session.builder.config("spark.executor.instances", 4).getOrCreate()
+    )
+    assert spark.default_parallelism == 4
+    assert spark.num_devices == 4
+
+
+def test_mesh_conf_axes(eight_devices):
+    spark = (
+        Session.builder.master("local[2]")
+        .config("mesh.fsdp", 2)
+        .config("mesh.tensor", 2)
+        .getOrCreate()
+    )
+    assert spark.mesh.shape["data"] == 2
+    assert spark.mesh.shape["fsdp"] == 2
+    assert spark.mesh.shape["tensor"] == 2
+    assert spark.num_devices == 8
+
+
+def test_master_too_large_raises(eight_devices):
+    with pytest.raises(ValueError):
+        Session.builder.master("local[16]").getOrCreate()
+
+
+def test_parallelize_roundtrip(eight_devices):
+    spark = Session.builder.master("local[2]").getOrCreate()
+    rdd = spark.parallelize(range(10))
+    assert rdd.num_partitions == 2
+    assert rdd.collect() == list(range(10))
+    assert spark.sparkContext is spark  # context == session
+
+
+def test_context_manager(eight_devices):
+    with Session.builder.master("local[2]").getOrCreate() as spark:
+        assert spark.num_devices == 2
+    with pytest.raises(RuntimeError):
+        Session.active()
