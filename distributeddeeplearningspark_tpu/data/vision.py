@@ -1,0 +1,140 @@
+"""Image pipeline — the rebuild of the reference's Spark RDD image plane.
+
+The reference decodes/augments/batches ImageNet inside executor partitions
+(SURVEY.md §2 'Data: image pipeline'). Here the same steps are RDD-style
+``map`` transforms over a :class:`~distributeddeeplearningspark_tpu.rdd.
+PartitionedDataset`, executed on the *host* by the prefetch thread (device
+time is reserved for the MXU; host decode overlaps device compute via
+:mod:`.prefetch`).
+
+All transforms are numpy, per-example, composable with ``dataset.map``. JPEG
+decoding uses torch's bundled libjpeg when a ``.jpg`` path is given (torch CPU
+is in the image for parity tests; no TF/PIL dependency).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+import numpy as np
+
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+#: ImageNet channel statistics (the universal constants every framework bakes in).
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def normalize(image: np.ndarray, mean: np.ndarray = IMAGENET_MEAN,
+              std: np.ndarray = IMAGENET_STD) -> np.ndarray:
+    """[0,1] float or uint8 HWC → standardized float32."""
+    if image.dtype == np.uint8:
+        image = image.astype(np.float32) / 255.0
+    return (image.astype(np.float32) - mean) / std
+
+
+def resize_bilinear(image: np.ndarray, size: tuple[int, int]) -> np.ndarray:
+    """Minimal bilinear resize (numpy; avoids a PIL/TF dependency)."""
+    h, w = image.shape[:2]
+    out_h, out_w = size
+    if (h, w) == (out_h, out_w):
+        return image
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0).astype(np.float32)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0).astype(np.float32)[None, :, None]
+    img = image.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def random_resized_crop(image: np.ndarray, rng: np.random.Generator, size: int = 224,
+                        scale: tuple[float, float] = (0.08, 1.0),
+                        ratio: tuple[float, float] = (3 / 4, 4 / 3)) -> np.ndarray:
+    """Inception-style crop: random area/aspect, resized to ``size``."""
+    h, w = image.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target = area * rng.uniform(*scale)
+        aspect = np.exp(rng.uniform(np.log(ratio[0]), np.log(ratio[1])))
+        cw = int(round(np.sqrt(target * aspect)))
+        ch = int(round(np.sqrt(target / aspect)))
+        if cw <= w and ch <= h:
+            y = int(rng.integers(0, h - ch + 1))
+            x = int(rng.integers(0, w - cw + 1))
+            return resize_bilinear(image[y:y + ch, x:x + cw], (size, size))
+    return center_crop(image, size)  # fallback
+
+
+def center_crop(image: np.ndarray, size: int = 224, resize_shorter: int = 256) -> np.ndarray:
+    """Eval transform: resize shorter side then center crop."""
+    h, w = image.shape[:2]
+    scale = resize_shorter / min(h, w)
+    image = resize_bilinear(image, (int(round(h * scale)), int(round(w * scale))))
+    h, w = image.shape[:2]
+    y, x = (h - size) // 2, (w - size) // 2
+    return image[y:y + size, x:x + size]
+
+
+def random_flip(image: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+    return image[:, ::-1] if rng.random() < 0.5 else image
+
+
+def decode_jpeg(path_or_bytes) -> np.ndarray:
+    """JPEG → uint8 HWC via torch's bundled libjpeg (torchvision-free)."""
+    import torch  # cpu torch is in the image (SURVEY.md §7 environment)
+
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = torch.frombuffer(bytearray(path_or_bytes), dtype=torch.uint8)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = torch.frombuffer(bytearray(f.read()), dtype=torch.uint8)
+    try:
+        from torchvision.io import decode_jpeg as tv_decode  # optional
+
+        return tv_decode(data).permute(1, 2, 0).numpy()
+    except Exception as e:  # pragma: no cover - environment-dependent
+        raise RuntimeError("no JPEG decoder available (torchvision absent)") from e
+
+
+def train_transform(size: int = 224, seed: int = 0) -> Callable[[dict], dict]:
+    """Per-example ImageNet train augmentation: crop + flip + normalize.
+
+    Deterministic per example content hash + seed so multi-host pipelines
+    don't need rng plumbing through partitions.
+    """
+
+    def apply(example: dict) -> dict:
+        img = example["image"]
+        rng = np.random.default_rng(
+            (seed * 2654435761 + (hash(img.tobytes()[:64]) & 0xFFFFFFFF)) & 0xFFFFFFFF
+        )
+        img = random_resized_crop(img, rng, size) if img.shape[0] != size else random_flip(img, rng)
+        img = random_flip(img, rng)
+        return {**example, "image": normalize(img) if img.dtype == np.uint8 else img.astype(np.float32)}
+
+    return apply
+
+
+def eval_transform(size: int = 224) -> Callable[[dict], dict]:
+    def apply(example: dict) -> dict:
+        img = example["image"]
+        if img.shape[0] != size or img.shape[1] != size:
+            img = center_crop(img, size)
+        return {**example, "image": normalize(img) if img.dtype == np.uint8 else img.astype(np.float32)}
+
+    return apply
+
+
+def imagenet_train(dataset: PartitionedDataset, *, size: int = 224, seed: int = 0) -> PartitionedDataset:
+    """RDD-shaped pipeline: shuffle → augment, per partition on the host."""
+    return dataset.shuffle(seed).map(train_transform(size, seed))
+
+
+def imagenet_eval(dataset: PartitionedDataset, *, size: int = 224) -> PartitionedDataset:
+    return dataset.map(eval_transform(size))

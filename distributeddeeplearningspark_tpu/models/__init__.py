@@ -1,5 +1,21 @@
 """Model zoo: the five contract architectures (BASELINE.json configs), in flax."""
 
 from distributeddeeplearningspark_tpu.models.lenet import LeNet5
+from distributeddeeplearningspark_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
 
-__all__ = ["LeNet5"]
+__all__ = [
+    "LeNet5",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+]

@@ -1,0 +1,139 @@
+"""ResNet family for ImageNet — BASELINE.json config 2.
+
+The reference trains ResNet-50/ImageNet-1k through a Spark RDD image pipeline
+on CUDA (SURVEY.md §2 'Models: ResNet-50'); its headline metric is
+images/sec/chip and the north star is ≥50% MFU on a v4-32 pod.
+
+TPU-first design decisions (vs. a torch translation):
+
+- **NHWC layout** end to end — channels-last is what XLA:TPU tiles onto the
+  MXU without relayout transposes (torch is NCHW).
+- **bfloat16 compute, float32 state**: conv/matmul inputs and activations in
+  bf16 feed the MXU at full rate; params, BN statistics and the final logits
+  stay f32 for stable training. This is the standard TPU mixed-precision
+  recipe — no loss-scaling machinery needed (unlike fp16 on GPU).
+- **v1.5 stride placement** (stride on the 3×3, not the 1×1) — the variant
+  every published ResNet-50 benchmark uses.
+- **Distributed BN for free**: under GSPMD the batch axis is sharded over the
+  (data, fsdp) mesh axes, so BatchNorm's batch-mean lowers to a per-chip
+  partial sum + an XLA all-reduce — the cross-replica sync-BN the reference
+  would need explicit hooks for is just how the compiler partitions the mean.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 → 3×3 → 1×1 bottleneck with projection shortcut when needed."""
+
+    filters: int  # bottleneck width; output channels = 4 * filters
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool) -> jax.Array:
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(4 * self.filters, (1, 1))(y)
+        # zero-init gamma on the last BN: each block starts as identity,
+        # the standard large-batch trick (Goyal et al.) — free accuracy.
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(4 * self.filters, (1, 1), strides=(self.strides, self.strides),
+                            name="shortcut_conv")(residual)
+            residual = norm(name="shortcut_bn")(residual)
+        return nn.relu(residual + y.astype(residual.dtype))
+
+
+class BasicBlock(nn.Module):
+    """3×3 → 3×3 block (ResNet-18/34)."""
+
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool) -> jax.Array:
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=jnp.float32,
+        )
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), strides=(self.strides, self.strides),
+                            name="shortcut_conv")(residual)
+            residual = norm(name="shortcut_bn")(residual)
+        return nn.relu(residual + y.astype(residual.dtype))
+
+
+class ResNet(nn.Module):
+    """Input: batch dict with ``image`` [B,H,W,3] float; returns logits f32.
+
+    ``stage_sizes`` counts blocks per stage; stage widths are the classic
+    64/128/256/512.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: type = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
+        x = batch["image"].astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=jnp.float32, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                x = self.block_cls(
+                    filters=self.width * 2**stage,
+                    strides=2 if stage > 0 and block == 0 else 1,
+                    dtype=self.dtype,
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def ResNet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock, **kw)
+
+
+def ResNet34(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock, **kw)
+
+
+def ResNet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock, **kw)
+
+
+def ResNet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock, **kw)
+
+
+def ResNet152(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), block_cls=BottleneckBlock, **kw)

@@ -1,0 +1,60 @@
+"""Config-2 driver script: ResNet-50 / ImageNet-1k, RDD image pipeline → TPU.
+
+The reference streams ImageNet RDD partitions into GPUs under NCCL DP
+(BASELINE.json config 2). Here the same driver-script shape runs the jitted
+SPMD step on the mesh::
+
+    dlsubmit --master tpu examples/train_resnet.py -- --steps 100
+    python examples/train_resnet.py --variant resnet18 --image-size 64
+"""
+
+import argparse
+import logging
+
+from distributeddeeplearningspark_tpu import Session, Trainer
+from distributeddeeplearningspark_tpu.data import vision
+from distributeddeeplearningspark_tpu.data.sources import synthetic_images
+from distributeddeeplearningspark_tpu.models import ResNet18, ResNet50
+from distributeddeeplearningspark_tpu.train import losses, optim
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--master", default=None)
+    p.add_argument("--variant", default="resnet50", choices=["resnet18", "resnet50"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    spark = Session.builder.master(args.master or "auto").appName("resnet-imagenet").getOrCreate()
+    print(spark)
+
+    ds = synthetic_images(
+        args.batch_size * max(args.steps, 1),
+        image_size=args.image_size,
+        num_classes=args.num_classes,
+        num_partitions=max(spark.default_parallelism, 1),
+    )
+    ds = vision.imagenet_train(ds, size=args.image_size)
+
+    model = (ResNet50 if args.variant == "resnet50" else ResNet18)(num_classes=args.num_classes)
+    schedule = optim.warmup_cosine(args.lr, warmup_steps=min(args.steps // 10, 500),
+                                   total_steps=args.steps)
+    trainer = Trainer(
+        spark, model, losses.softmax_xent,
+        optim.sgd(schedule, momentum=0.9, weight_decay=1e-4),
+    )
+    state, summary = trainer.fit(
+        ds.repeat(), batch_size=args.batch_size, steps=args.steps, log_every=10
+    )
+    print(f"train summary: {summary}")
+    spark.stop()
+
+
+if __name__ == "__main__":
+    main()

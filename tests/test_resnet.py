@@ -1,0 +1,124 @@
+"""ResNet family + image pipeline tests (config 2, SURVEY.md §4).
+
+Small variants / tiny images keep CPU compile time bounded; the full
+ResNet-50 shape is exercised by bench.py on the real chip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearningspark_tpu.data import vision
+from distributeddeeplearningspark_tpu.data.feed import put_global, stack_examples
+from distributeddeeplearningspark_tpu.data.sources import synthetic_images
+from distributeddeeplearningspark_tpu.models import ResNet18, ResNet50
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+from distributeddeeplearningspark_tpu.parallel.sharding import REPLICATED
+from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+
+
+def tiny_batch(n=8, size=32, classes=10):
+    rng = np.random.default_rng(0)
+    return {
+        "image": rng.normal(0, 1, (n, size, size, 3)).astype(np.float32),
+        "label": rng.integers(0, classes, (n,)).astype(np.int32),
+    }
+
+
+def test_resnet18_forward_shapes_and_dtypes():
+    model = ResNet18(num_classes=10)
+    batch = tiny_batch()
+    variables = model.init(jax.random.PRNGKey(0), batch, train=False)
+    logits = model.apply(variables, batch, train=False)
+    assert logits.shape == (8, 10)
+    assert logits.dtype == jnp.float32  # head stays f32 even with bf16 compute
+    assert "batch_stats" in variables  # BN state present
+
+
+def test_resnet50_param_count():
+    # ResNet-50/ImageNet-1k is famously 25.56M params — structural check.
+    model = ResNet50(num_classes=1000)
+    batch = {"image": np.zeros((1, 64, 64, 3), np.float32), "label": np.zeros((1,), np.int32)}
+    abstract = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), batch, train=False))
+    n = sum(int(np.prod(v.shape)) for v in jax.tree.leaves(abstract["params"]))
+    assert abs(n - 25_557_032) / 25_557_032 < 0.01, n
+
+
+def test_batch_stats_update_in_train_step(eight_devices):
+    mesh = MeshSpec(data=8).build(eight_devices)
+    model = ResNet18(num_classes=10, dtype=jnp.float32)
+    batch = tiny_batch(n=16)
+    tx = optax.sgd(0.1, momentum=0.9)
+    state, shardings = step_lib.init_state(model, tx, batch, mesh, REPLICATED)
+    assert "batch_stats" in state.mutable
+    before = jax.device_get(jax.tree.leaves(state.mutable["batch_stats"])[0])
+
+    train_step = step_lib.jit_train_step(
+        step_lib.make_train_step(
+            model.apply, tx, losses.softmax_xent, mutable_keys=("batch_stats",)
+        ),
+        mesh, shardings,
+    )
+    state, metrics = train_step(state, put_global(batch, mesh))
+    after = jax.device_get(jax.tree.leaves(state.mutable["batch_stats"])[0])
+    assert not np.allclose(before, after)  # running stats moved
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_resnet_learns_on_fake_data(eight_devices):
+    """DP training on 8 fake chips reduces loss on the synthetic image task."""
+    mesh = MeshSpec(data=8).build(eight_devices)
+    model = ResNet18(num_classes=8, width=16, dtype=jnp.float32)
+    ds = synthetic_images(512, image_size=32, num_classes=8, num_partitions=8, seed=0)
+    tx = optax.sgd(0.05, momentum=0.9)
+
+    examples = ds.take(32)
+    batch = stack_examples(examples)
+    state, shardings = step_lib.init_state(model, tx, batch, mesh, REPLICATED)
+    train_step = step_lib.jit_train_step(
+        step_lib.make_train_step(
+            model.apply, tx, losses.softmax_xent, mutable_keys=("batch_stats",)
+        ),
+        mesh, shardings,
+    )
+    gbatch = put_global(batch, mesh)
+    state, first = train_step(state, gbatch)
+    for _ in range(20):
+        state, last = train_step(state, gbatch)
+    assert float(last["loss"]) < float(first["loss"])
+
+
+class TestVisionTransforms:
+    def test_resize_bilinear_identity_and_shape(self):
+        img = np.random.default_rng(0).random((17, 23, 3)).astype(np.float32)
+        assert vision.resize_bilinear(img, (17, 23)) is img
+        out = vision.resize_bilinear(img, (8, 8))
+        assert out.shape == (8, 8, 3)
+        # constant image stays constant under bilinear interpolation
+        const = np.full((10, 10, 3), 0.5, np.float32)
+        assert np.allclose(vision.resize_bilinear(const, (7, 13)), 0.5, atol=1e-6)
+
+    def test_center_crop(self):
+        img = np.random.default_rng(0).random((300, 400, 3)).astype(np.float32)
+        out = vision.center_crop(img, 224)
+        assert out.shape == (224, 224, 3)
+
+    def test_random_resized_crop_shape(self):
+        img = np.random.default_rng(0).random((100, 80, 3)).astype(np.float32)
+        out = vision.random_resized_crop(img, np.random.default_rng(1), 64)
+        assert out.shape == (64, 64, 3)
+
+    def test_normalize_uint8(self):
+        img = np.full((4, 4, 3), 255, np.uint8)
+        out = vision.normalize(img)
+        assert out.dtype == np.float32
+        assert np.allclose(out, (1.0 - vision.IMAGENET_MEAN) / vision.IMAGENET_STD)
+
+    def test_pipeline_preserves_count_and_shape(self):
+        ds = synthetic_images(64, image_size=32, num_classes=4, num_partitions=4)
+        out = vision.imagenet_train(ds, size=32)
+        assert out.count() == 64
+        ex = out.first()
+        assert ex["image"].shape == (32, 32, 3)
