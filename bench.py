@@ -66,7 +66,10 @@ def main() -> None:
     tx = optax.sgd(0.01, momentum=0.9)
     state, shardings = step_lib.init_state(model, tx, batch, mesh, REPLICATED)
     train_step = step_lib.jit_train_step(
-        step_lib.make_train_step(model.apply, tx, losses.softmax_xent),
+        step_lib.make_train_step(
+            model.apply, tx, losses.softmax_xent,
+            mutable_keys=tuple(state.mutable.keys()),
+        ),
         mesh,
         shardings,
     )

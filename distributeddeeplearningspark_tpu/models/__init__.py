@@ -1,6 +1,13 @@
 """Model zoo: the five contract architectures (BASELINE.json configs), in flax."""
 
 from distributeddeeplearningspark_tpu.models.lenet import LeNet5
+from distributeddeeplearningspark_tpu.models.bert import (
+    BertConfig,
+    BertEncoder,
+    BertForMLM,
+    bert_base,
+    bert_tiny,
+)
 from distributeddeeplearningspark_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -11,6 +18,11 @@ from distributeddeeplearningspark_tpu.models.resnet import (
 )
 
 __all__ = [
+    "BertConfig",
+    "BertEncoder",
+    "BertForMLM",
+    "bert_base",
+    "bert_tiny",
     "LeNet5",
     "ResNet",
     "ResNet18",

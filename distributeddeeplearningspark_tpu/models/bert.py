@@ -1,0 +1,171 @@
+"""BERT for MLM pretraining — BASELINE.json config 3.
+
+The reference pretrains BERT-base MLM on Wikipedia text RDD partitions
+(SURVEY.md §2 'Models: BERT-base MLM'); headline metric is tokens/sec/chip.
+
+TPU-first decisions:
+
+- bf16 activations/matmuls, f32 LayerNorm and softmax accumulation — the MXU
+  mixed-precision recipe (no GPU-style loss scaling).
+- BSHD attention layout via :mod:`..ops.attention` so batch sharding and the
+  reserved ``seq`` mesh axis shard leading dims without transposes.
+- Tied MLM decoder: output projection reuses the token-embedding table
+  (one [vocab, hidden] matmul — MXU-friendly, halves embedding memory).
+- Tensor-parallel ready: QKV/MLP kernels are plain Dense kernels whose
+  sharding is assigned by path-regex rules
+  (:data:`distributeddeeplearningspark_tpu.parallel.sharding.ShardingRules`) —
+  the model code contains no parallelism logic.
+
+Batch dict: ``input_ids`` [B,S] int32, ``attention_mask`` [B,S] 1/0,
+optional ``token_type_ids`` [B,S]; returns MLM logits [B,S,vocab] f32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distributeddeeplearningspark_tpu.ops.attention import dot_product_attention, padding_mask
+
+
+class BertConfig:
+    """BERT-base defaults (Devlin et al.); override via kwargs."""
+
+    def __init__(
+        self,
+        vocab_size: int = 30522,
+        hidden_size: int = 768,
+        num_layers: int = 12,
+        num_heads: int = 12,
+        intermediate_size: int = 3072,
+        max_position: int = 512,
+        type_vocab_size: int = 2,
+        dropout_rate: float = 0.1,
+        dtype: Any = jnp.bfloat16,
+        attention_impl: str = "auto",
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.dropout_rate = dropout_rate
+        self.dtype = dtype
+        self.attention_impl = attention_impl
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        """4-layer/128-wide config for CPU tests."""
+        base = dict(vocab_size=1024, hidden_size=128, num_layers=4, num_heads=4,
+                    intermediate_size=512, max_position=128, dtype=jnp.float32)
+        base.update(kw)
+        return BertConfig(**base)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array, *, train: bool) -> jax.Array:
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, head_dim), dtype=cfg.dtype, name=name
+        )
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        y = dot_product_attention(q, k, v, mask=mask, impl=cfg.attention_impl)
+        y = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out")(y)
+        return nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array, *, train: bool) -> jax.Array:
+        cfg = self.cfg
+        # post-LN (original BERT): sublayer → residual → LayerNorm(f32)
+        y = SelfAttention(cfg, name="attention")(x, mask, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="attention_ln")(x + y).astype(cfg.dtype)
+        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
+        y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
+        return nn.LayerNorm(dtype=jnp.float32, name="mlp_ln")(x + y).astype(cfg.dtype)
+
+
+class BertEncoder(nn.Module):
+    """Embeddings + N encoder layers; returns hidden states [B,S,H].
+
+    ``tok_embed`` may be passed in by a head module that wants to tie the
+    decoder to the token-embedding table (flax module sharing).
+    """
+
+    cfg: BertConfig
+    tok_embed: nn.Module | None = None
+
+    @nn.compact
+    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
+        cfg = self.cfg
+        ids = batch["input_ids"]
+        if ids.shape[1] > cfg.max_position:
+            raise ValueError(
+                f"sequence length {ids.shape[1]} exceeds max_position "
+                f"{cfg.max_position} (out-of-range positions would silently "
+                f"clamp to the last embedding row)"
+            )
+        positions = jnp.arange(ids.shape[1])[None, :]
+        types = batch.get("token_type_ids", jnp.zeros_like(ids))
+
+        tok_emb = self.tok_embed or nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="token_embeddings"
+        )
+        x = tok_emb(ids)
+        x = x + nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype,
+                         name="position_embeddings")(positions)
+        x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         name="type_embeddings")(types)
+        x = nn.LayerNorm(dtype=jnp.float32, name="embeddings_ln")(x).astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
+
+        mask = padding_mask(batch.get("attention_mask", jnp.ones_like(ids)))
+        for i in range(cfg.num_layers):
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, mask, train=train)
+        return x
+
+
+class BertForMLM(nn.Module):
+    """Encoder + MLM head with tied decoder; logits [B,S,vocab] f32."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
+        cfg = self.cfg
+        tok_emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                           name="token_embeddings")
+        encoder = BertEncoder(cfg, tok_embed=tok_emb, name="encoder")
+        x = encoder(batch, train=train)
+        # MLM transform head
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_dense")(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x).astype(cfg.dtype)
+        # tied decoder: logits = x @ E^T + b (Embed.attend is the tie)
+        logits = tok_emb.attend(x).astype(jnp.float32)
+        bias = self.param("mlm_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32)
+        return logits + bias
+
+
+def bert_base(**kw) -> BertForMLM:
+    return BertForMLM(BertConfig(**kw))
+
+
+def bert_tiny(**kw) -> BertForMLM:
+    return BertForMLM(BertConfig.tiny(**kw))

@@ -1,0 +1,86 @@
+"""Attention ops: one call site, pluggable implementations.
+
+Models call :func:`dot_product_attention`; the implementation is chosen by
+``impl``:
+
+- ``"xla"`` — plain einsum softmax attention. XLA fuses the scale/mask/softmax
+  chain into the matmuls well enough for short sequences (BERT's 512).
+- ``"flash"`` — Pallas blockwise flash attention (O(seq) memory, HBM-tiled);
+  the long-sequence hot op (see :mod:`.flash_attention`).
+- ``"auto"`` — flash on TPU when the shape qualifies (seq multiple of block,
+  head_dim multiple of 128), else xla.
+
+All implementations take/return ``[batch, seq, heads, head_dim]`` (BSHD
+layout — batch and sequence leading so (data, fsdp) batch sharding and
+``seq``-axis context parallelism shard the first two dims without transposes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    bias: jax.Array | None = None,
+    mask: jax.Array | None = None,
+    causal: bool = False,
+    scale: float | None = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Softmax attention over BSHD tensors.
+
+    ``mask``: bool, True = attend, broadcastable to [B, H, Sq, Sk].
+    ``bias``: additive, broadcastable to [B, H, Sq, Sk].
+    """
+    if impl == "auto":
+        impl = _pick_impl(q, bias, mask)
+    if impl == "flash":
+        from distributeddeeplearningspark_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=scale)
+    return _xla_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=scale)
+
+
+def _pick_impl(q: jax.Array, bias, mask) -> str:
+    # Flash kernel requires TPU, block-divisible seq, lane-divisible head_dim,
+    # and no per-position bias/mask tensors (causal masking is built in).
+    if jax.default_backend() not in ("tpu", "axon"):
+        return "xla"
+    b, s, h, d = q.shape
+    if bias is not None or mask is not None:
+        return "xla"
+    if s % 512 or d % 128:
+        return "xla"
+    try:
+        from distributeddeeplearningspark_tpu.ops import flash_attention  # noqa: F401
+    except ImportError:
+        return "xla"
+    return "flash"
+
+
+def _xla_attention(q, k, v, *, bias, mask, causal, scale) -> jax.Array:
+    depth = q.shape[-1]
+    scale = scale if scale is not None else depth**-0.5
+    # accumulate logits/softmax in f32 regardless of input dtype (bf16-safe)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * jnp.float32(scale)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        logits = jnp.where(cmask, logits, jnp.float32(-1e30))
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def padding_mask(attention_mask: jax.Array) -> jax.Array:
+    """[B, S] 1/0 pad mask → [B, 1, 1, S] bool attend-mask (BERT style)."""
+    return (attention_mask > 0)[:, None, None, :]

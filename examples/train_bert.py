@@ -1,0 +1,66 @@
+"""Config-3 driver script: BERT-base MLM pretraining on Wikipedia text RDDs.
+
+Reference shape (BASELINE.json config 3): text RDD partitions → tokenize →
+mask → NCCL-DP pretraining. Here: same driver script surface, jitted SPMD
+step, tokens/sec/chip metric::
+
+    dlsubmit examples/train_bert.py -- --steps 200 --seq-len 128
+"""
+
+import argparse
+import logging
+
+from distributeddeeplearningspark_tpu import Session, Trainer
+from distributeddeeplearningspark_tpu.data import text as text_lib
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+from distributeddeeplearningspark_tpu.models import bert_base, bert_tiny
+from distributeddeeplearningspark_tpu.train import losses, optim
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--master", default=None)
+    p.add_argument("--variant", default="base", choices=["base", "tiny"])
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--warmup", type=int, default=100)
+    p.add_argument("--corpus", default=None, help="text file (one doc per line); synthetic if unset")
+    p.add_argument("--vocab", default=None, help="vocab file; trained from corpus if unset")
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    spark = Session.builder.master(args.master or "auto").appName("bert-mlm").getOrCreate()
+    print(spark)
+
+    if args.corpus:
+        with open(args.corpus) as f:
+            lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+        docs = PartitionedDataset.parallelize(lines, spark.default_parallelism)
+    else:
+        docs = text_lib.synthetic_wikipedia(2048, num_partitions=max(spark.default_parallelism, 1))
+
+    if args.vocab:
+        tok = text_lib.WordPieceTokenizer.load(args.vocab)
+    else:
+        tok = text_lib.WordPieceTokenizer.train(docs.collect(), vocab_size=8192)
+
+    ds = text_lib.mlm_dataset(docs, tok, seq_len=args.seq_len).repeat()
+
+    make = bert_base if args.variant == "base" else bert_tiny
+    model = make(vocab_size=tok.vocab_size, max_position=max(args.seq_len, 128))
+    tx = optim.with_grad_clip(
+        optim.adamw(optim.warmup_linear(args.lr, args.warmup, args.steps)), 1.0
+    )
+    trainer = Trainer(spark, model, losses.masked_lm, tx)
+    state, summary = trainer.fit(
+        ds, batch_size=args.batch_size, steps=args.steps,
+        tokens_per_example=args.seq_len, log_every=20,
+    )
+    print(f"train summary: {summary}")
+    spark.stop()
+
+
+if __name__ == "__main__":
+    main()

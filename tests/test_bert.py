@@ -1,0 +1,116 @@
+"""BERT MLM + text pipeline tests (config 3, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributeddeeplearningspark_tpu.data import text as text_lib
+from distributeddeeplearningspark_tpu.data.feed import host_batches, put_global
+from distributeddeeplearningspark_tpu.models import bert_tiny
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+from distributeddeeplearningspark_tpu.parallel.sharding import REPLICATED
+from distributeddeeplearningspark_tpu.train import losses, optim, step as step_lib
+
+
+def build_tokenizer():
+    docs = text_lib.synthetic_wikipedia(64, num_partitions=2, seed=1)
+    return text_lib.WordPieceTokenizer.train(docs.collect(), vocab_size=512)
+
+
+class TestTokenizer:
+    def test_roundtrip_known_words(self):
+        tok = build_tokenizer()
+        ids = tok.encode("the history of the city")
+        assert ids and all(i not in (tok.unk_id,) for i in ids)
+        assert tok.decode(ids) == "the history of the city"
+
+    def test_char_fallback_no_unk(self):
+        tok = build_tokenizer()
+        # unseen word decomposes into char pieces, not UNK
+        ids = tok.tokenize_word("zzzq")
+        assert tok.unk_id not in ids or len(ids) == 1
+
+    def test_save_load(self, tmp_path):
+        tok = build_tokenizer()
+        path = str(tmp_path / "vocab.txt")
+        tok.save(path)
+        tok2 = text_lib.WordPieceTokenizer.load(path)
+        assert tok2.vocab == tok.vocab
+
+
+class TestMasking:
+    def test_shapes_and_mask_rate(self):
+        tok = build_tokenizer()
+        rng = np.random.default_rng(0)
+        ids = np.array([tok.cls_id] + [10] * 126 + [tok.sep_id], np.int32)
+        ex = text_lib.mask_tokens(ids, tok, rng)
+        assert ex["input_ids"].shape == (128,)
+        assert ex["mlm_labels"].shape == (128,)
+        rate = ex["mlm_weights"].mean()
+        assert 0.05 < rate < 0.30  # ~15%
+        # specials never masked
+        assert ex["mlm_weights"][0] == 0 and ex["mlm_weights"][-1] == 0
+        # labels hold the ORIGINAL ids everywhere
+        assert (ex["mlm_labels"] == ids).all()
+
+    def test_pipeline_example_schema(self):
+        tok = build_tokenizer()
+        docs = text_lib.synthetic_wikipedia(16, num_partitions=2)
+        ds = text_lib.mlm_dataset(docs, tok, seq_len=64)
+        ex = ds.first()
+        assert set(ex) == {"input_ids", "attention_mask", "mlm_labels", "mlm_weights"}
+        assert all(v.shape == (64,) for v in ex.values())
+
+
+def test_bert_forward_shapes():
+    model = bert_tiny()
+    batch = {
+        "input_ids": np.ones((2, 32), np.int32),
+        "attention_mask": np.ones((2, 32), np.int32),
+    }
+    variables = model.init(jax.random.PRNGKey(0), batch, train=False)
+    logits = model.apply(variables, batch, train=False)
+    assert logits.shape == (2, 32, model.cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_tied_decoder_shares_embedding():
+    """The MLM decoder must reuse the token-embedding table (no second one)."""
+    model = bert_tiny()
+    batch = {"input_ids": np.ones((1, 16), np.int32)}
+    variables = model.init(jax.random.PRNGKey(0), batch, train=False)
+    flat = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+    emb_tables = [p for p, v in flat if any("embedding" in str(k) for k in p)
+                  and v.shape[-1] == model.cfg.hidden_size
+                  and v.shape[0] == model.cfg.vocab_size]
+    assert len(emb_tables) == 1  # token table exists once, not duplicated
+
+
+def test_bert_mlm_learns(eight_devices):
+    """DP MLM training on 8 fake chips: loss drops, masked acc beats chance."""
+    mesh = MeshSpec(data=8).build(eight_devices)
+    tok = build_tokenizer()
+    model = bert_tiny(vocab_size=tok.vocab_size, num_layers=2, hidden_size=64,
+                      num_heads=2, intermediate_size=128, dropout_rate=0.0)
+    docs = text_lib.synthetic_wikipedia(256, num_partitions=8)
+    ds = text_lib.mlm_dataset(docs, tok, seq_len=64).repeat()
+    feed = host_batches(ds, 32, num_shards=8)
+
+    tx = optim.adamw(optim.warmup_linear(3e-3, 10, 80))
+    batch = next(feed)
+    state, shardings = step_lib.init_state(model, tx, batch, mesh, REPLICATED)
+    train_step = step_lib.jit_train_step(
+        step_lib.make_train_step(model.apply, tx, losses.masked_lm),
+        mesh, shardings,
+    )
+    first = last = None
+    for i, hb in enumerate(feed):
+        if i >= 60:
+            break
+        state, m = train_step(state, put_global(hb, mesh))
+        if first is None:
+            first = float(m["loss"])
+        last = m
+    assert float(last["loss"]) < first * 0.8
+    assert float(last["mlm_accuracy"]) > 2.0 / tok.vocab_size
