@@ -1,5 +1,11 @@
 """Model zoo: the five contract architectures (BASELINE.json configs), in flax."""
 
+from distributeddeeplearningspark_tpu.models.dlrm import (
+    DLRM,
+    FusedEmbedding,
+    WideAndDeep,
+    dlrm_rules,
+)
 from distributeddeeplearningspark_tpu.models.lenet import LeNet5
 from distributeddeeplearningspark_tpu.models.bert import (
     BertConfig,
@@ -23,6 +29,10 @@ __all__ = [
     "BertForMLM",
     "bert_base",
     "bert_tiny",
+    "DLRM",
+    "FusedEmbedding",
+    "WideAndDeep",
+    "dlrm_rules",
     "LeNet5",
     "ResNet",
     "ResNet18",

@@ -1,0 +1,163 @@
+"""DLRM & Wide-and-Deep recommenders — BASELINE.json config 4.
+
+The reference trains a Wide&Deep / DLRM CTR model on Criteo with Spark
+DataFrame features and distributed embedding tables (SURVEY.md §2
+'Models: Wide&Deep / DLRM'; embedding-table sharding is the one non-DP
+parallelism the reference certainly has).
+
+TPU-first decisions:
+
+- **One fused table**: the 26 per-feature tables are concatenated row-wise
+  into a single ``[sum(vocab_sizes), embed_dim]`` array and each feature's
+  local index is shifted by a static offset. One big gather per step instead
+  of 26 small ones — fewer HLO ops, one collective, and a single target for
+  sharding/prefetch. (The reference keeps separate ``nn.Embedding`` modules
+  per feature, the torch idiom.)
+- **Row-sharded over the ``expert`` mesh axis**: vocab rows are distributed
+  (EP-adjacent, matching the reference's table distribution); GSPMD lowers
+  the sharded gather to an index all-gather + local take + result exchange —
+  the all-to-all lookup pattern of SURVEY.md §2, compiler-scheduled.
+- Embeddings gather in f32 (tables stay f32: tiny compute, precision-
+  sensitive), MLPs run bf16 on the MXU.
+
+Batch dict: ``dense`` [B, D_dense] f32, ``sparse`` [B, N_feat] i32 (per-
+feature local ids), ``label`` [B] {0,1}. Returns CTR logit [B] f32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from distributeddeeplearningspark_tpu.parallel.mesh import AXIS_EXPERT
+from distributeddeeplearningspark_tpu.parallel.sharding import ShardingRules
+
+#: Criteo Kaggle/Terabyte schema: 13 dense + 26 categorical.
+CRITEO_DENSE = 13
+CRITEO_SPARSE = 26
+
+
+class FusedEmbedding(nn.Module):
+    """N categorical features → one row-sharded table + static offsets.
+
+    ``vocab_sizes[i]`` rows are reserved for feature i; lookup index is
+    ``local_id + offset[i]``. The table param path matches
+    :data:`EMBEDDING_RULE` so the vocab dim shards over the ``expert`` axis.
+    """
+
+    vocab_sizes: Sequence[int]
+    embed_dim: int
+
+    @nn.compact
+    def __call__(self, sparse_ids: jax.Array) -> jax.Array:  # [B, N] → [B, N, D]
+        total = int(sum(self.vocab_sizes))
+        offsets = np.concatenate([[0], np.cumsum(self.vocab_sizes)[:-1]]).astype(np.int32)
+        table = self.param(
+            "embedding_table",
+            nn.initializers.normal(stddev=1.0 / np.sqrt(self.embed_dim)),
+            (total, self.embed_dim),
+            jnp.float32,
+        )
+        flat_ids = sparse_ids + jnp.asarray(offsets)[None, :]
+        return jnp.take(table, flat_ids, axis=0)
+
+
+class MLP(nn.Module):
+    features: Sequence[int]
+    dtype: Any = jnp.bfloat16
+    final_activation: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1 or self.final_activation:
+                x = nn.relu(x)
+        return x
+
+
+def dot_interaction(bottom: jax.Array, emb: jax.Array) -> jax.Array:
+    """DLRM pairwise-dot feature interaction.
+
+    ``bottom`` [B, D], ``emb`` [B, N, D] → lower-triangle of the Gram matrix
+    of the N+1 feature vectors, concatenated with ``bottom``.
+    One [B, N+1, D] × [B, D, N+1] batched matmul — MXU work, not gathers.
+    """
+    z = jnp.concatenate([bottom[:, None, :], emb], axis=1)  # [B, N+1, D]
+    gram = jnp.einsum("bnd,bmd->bnm", z, z)  # [B, N+1, N+1]
+    n = z.shape[1]
+    li, lj = jnp.tril_indices(n, k=-1)
+    return jnp.concatenate([bottom, gram[:, li, lj]], axis=1)
+
+
+class DLRM(nn.Module):
+    """Deep Learning Recommendation Model (Naumov et al.) for Criteo CTR."""
+
+    vocab_sizes: Sequence[int]
+    embed_dim: int = 64
+    bottom_mlp: Sequence[int] = (512, 256, 64)
+    top_mlp: Sequence[int] = (512, 256, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
+        if self.bottom_mlp[-1] != self.embed_dim:
+            raise ValueError(
+                f"bottom_mlp output {self.bottom_mlp[-1]} must equal embed_dim "
+                f"{self.embed_dim} for dot interaction"
+            )
+        # log-transform dense counters in f32 (Criteo counts reach 1e7 —
+        # bf16 before the log would quantize them), then cast for the MXU
+        dense = jnp.log1p(jnp.maximum(batch["dense"].astype(jnp.float32), 0.0))
+        bottom = MLP(self.bottom_mlp, self.dtype, name="bottom_mlp")(dense.astype(self.dtype))
+        emb = FusedEmbedding(self.vocab_sizes, self.embed_dim, name="embedding")(
+            batch["sparse"]
+        )
+        feats = dot_interaction(bottom.astype(jnp.float32), emb)
+        logit = MLP(self.top_mlp, self.dtype, final_activation=False, name="top_mlp")(
+            feats.astype(self.dtype)
+        )
+        return logit[:, 0].astype(jnp.float32)
+
+
+class WideAndDeep(nn.Module):
+    """Wide (linear over categorical ids) + Deep (embeddings → MLP) CTR model."""
+
+    vocab_sizes: Sequence[int]
+    embed_dim: int = 32
+    deep_mlp: Sequence[int] = (256, 128, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
+        dense = jnp.log1p(jnp.maximum(batch["dense"].astype(jnp.float32), 0.0))
+        # wide: per-category scalar weights == embed_dim-1 fused table
+        wide = FusedEmbedding(self.vocab_sizes, 1, name="wide_table")(batch["sparse"])
+        wide_logit = wide[..., 0].sum(-1) + nn.Dense(1, dtype=jnp.float32, name="wide_dense")(
+            dense
+        )[:, 0]
+        # deep: embeddings + dense → MLP
+        emb = FusedEmbedding(self.vocab_sizes, self.embed_dim, name="embedding")(
+            batch["sparse"]
+        )
+        deep_in = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1), dense], axis=1
+        ).astype(self.dtype)
+        deep_logit = MLP(self.deep_mlp, self.dtype, final_activation=False,
+                         name="deep_mlp")(deep_in)[:, 0]
+        return (wide_logit + deep_logit.astype(jnp.float32))
+
+
+#: Shard fused-table vocab rows over the `expert` axis; FSDP may still shard
+#: other large params when enabled.
+EMBEDDING_RULE = ("embedding_table", P(AXIS_EXPERT, None))
+
+
+def dlrm_rules(*, fsdp: bool = False) -> ShardingRules:
+    """Canned sharding for config 4: row-sharded tables (+ optional FSDP)."""
+    return ShardingRules(rules=(EMBEDDING_RULE,), fsdp=fsdp)

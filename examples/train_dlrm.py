@@ -1,0 +1,67 @@
+"""Config-4 driver script: Wide&Deep / DLRM on Criteo, sharded embeddings.
+
+Reference shape (BASELINE.json config 4): Spark DataFrame features feed a
+recommender whose embedding tables are distributed across executors. Here the
+fused table's vocab rows shard over the `expert` mesh axis::
+
+    dlsubmit examples/train_dlrm.py -- --model dlrm --steps 300
+    python examples/train_dlrm.py --expert-shards 4
+"""
+
+import argparse
+import logging
+
+from distributeddeeplearningspark_tpu import Session, Trainer
+from distributeddeeplearningspark_tpu.data.sources import synthetic_criteo
+from distributeddeeplearningspark_tpu.models.dlrm import DLRM, WideAndDeep, dlrm_rules
+from distributeddeeplearningspark_tpu.train import losses, optim
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--master", default=None)
+    p.add_argument("--model", default="dlrm", choices=["dlrm", "widedeep"])
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--embed-dim", type=int, default=64)
+    p.add_argument("--vocab-size", type=int, default=1000, help="rows per categorical feature")
+    p.add_argument("--num-sparse", type=int, default=26)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--expert-shards", type=int, default=1,
+                   help="ways to row-shard the embedding table (expert mesh axis)")
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    spark = (
+        Session.builder.master(args.master or "auto")
+        .appName("dlrm-criteo")
+        .config("mesh.expert", str(args.expert_shards))
+        .getOrCreate()
+    )
+    print(spark)
+
+    vocabs = (args.vocab_size,) * args.num_sparse
+    ds = synthetic_criteo(
+        args.batch_size * 64, vocab_sizes=vocabs,
+        num_partitions=max(spark.default_parallelism, 1),
+    ).repeat()
+
+    if args.model == "dlrm":
+        model = DLRM(vocab_sizes=vocabs, embed_dim=args.embed_dim,
+                     bottom_mlp=(512, 256, args.embed_dim))
+    else:
+        model = WideAndDeep(vocab_sizes=vocabs, embed_dim=args.embed_dim)
+
+    trainer = Trainer(
+        spark, model, losses.binary_xent, optim.adamw(args.lr, weight_decay=0.0),
+        rules=dlrm_rules(),
+    )
+    state, summary = trainer.fit(
+        ds, batch_size=args.batch_size, steps=args.steps, log_every=25
+    )
+    print(f"train summary: {summary}")
+    spark.stop()
+
+
+if __name__ == "__main__":
+    main()
