@@ -195,6 +195,79 @@ def mlm_dataset(
     return docs.map_partitions_with_index(per_partition)
 
 
+class HFTokenizerAdapter:
+    """Wrap a local Hugging Face tokenizer behind this module's interface.
+
+    Used when fine-tuning imported checkpoints (config 5): token ids must
+    index the *pretrained* embedding rows, so the checkpoint's own vocab is
+    mandatory — a corpus-trained WordPiece vocab would map text to unrelated
+    rows. Loads strictly from local files (the env has no egress).
+    """
+
+    def __init__(self, hf_tokenizer):
+        self._tok = hf_tokenizer
+        self.pad_id = hf_tokenizer.pad_token_id
+        self.sep_id = hf_tokenizer.eos_token_id
+        if self.sep_id is None:
+            raise ValueError("tokenizer must define an EOS token")
+        if self.pad_id is None:  # Llama tokenizers ship without a pad token
+            self.pad_id = self.sep_id
+
+    @staticmethod
+    def load(path: str) -> "HFTokenizerAdapter":
+        from transformers import AutoTokenizer
+
+        return HFTokenizerAdapter(AutoTokenizer.from_pretrained(path, local_files_only=True))
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids))
+
+
+def lm_dataset(
+    docs: PartitionedDataset,
+    tokenizer: WordPieceTokenizer,
+    *,
+    seq_len: int = 512,
+    eos_between_docs: bool = True,
+) -> PartitionedDataset:
+    """Text RDD → packed causal-LM blocks (config 5's fine-tune feed).
+
+    Documents are tokenized and concatenated (SEP as document separator, the
+    standard packing trick that keeps every position a real target), then cut
+    into fixed [seq_len] windows: ``{"input_ids": [S] i32, "loss_mask": [S]
+    f32}``. ``loss_mask`` zeroes padding in the final short block so
+    :func:`~distributeddeeplearningspark_tpu.train.losses.causal_lm` ignores it.
+    """
+
+    def per_partition(pidx: int, lines: Iterable[str]) -> Iterator[dict]:
+        del pidx
+        buf: list[int] = []
+        for doc in lines:
+            buf.extend(tokenizer.encode(doc))
+            if eos_between_docs:
+                buf.append(tokenizer.sep_id)
+            while len(buf) >= seq_len:
+                chunk, buf = buf[:seq_len], buf[seq_len:]
+                yield {
+                    "input_ids": np.array(chunk, np.int32),
+                    "loss_mask": np.ones(seq_len, np.float32),
+                }
+        if len(buf) > 1:
+            mask = np.zeros(seq_len, np.float32)
+            mask[: len(buf)] = 1.0
+            ids = buf + [tokenizer.pad_id] * (seq_len - len(buf))
+            yield {"input_ids": np.array(ids, np.int32), "loss_mask": mask}
+
+    return docs.map_partitions_with_index(per_partition)
+
+
 def synthetic_wikipedia(
     num_docs: int = 512, *, num_partitions: int = 4, seed: int = 0
 ) -> PartitionedDataset:

@@ -7,6 +7,14 @@ from distributeddeeplearningspark_tpu.models.dlrm import (
     dlrm_rules,
 )
 from distributeddeeplearningspark_tpu.models.lenet import LeNet5
+from distributeddeeplearningspark_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama2_7b,
+    llama_rules,
+    llama_tiny,
+    lora_trainable,
+)
 from distributeddeeplearningspark_tpu.models.bert import (
     BertConfig,
     BertEncoder,
@@ -34,6 +42,12 @@ __all__ = [
     "WideAndDeep",
     "dlrm_rules",
     "LeNet5",
+    "LlamaConfig",
+    "LlamaForCausalLM",
+    "llama2_7b",
+    "llama_rules",
+    "llama_tiny",
+    "lora_trainable",
     "ResNet",
     "ResNet18",
     "ResNet34",

@@ -1,0 +1,294 @@
+"""Llama-2 decoder + LoRA — BASELINE.json config 5.
+
+The reference fine-tunes Llama-2 7B with LoRA adapters, FSDP-style sharded
+"across Spark executors" on a v4-32 (SURVEY.md §2 'Models: Llama-2 7B + LoRA').
+Architecture per Touvron et al. 2023: pre-norm RMSNorm, rotary position
+embeddings, SwiGLU MLP, untied LM head; 7B = 32 layers x 4096 hidden,
+32 heads, 11008 intermediate. GQA (separate ``num_kv_heads``) is supported so
+the 70B-family configs load too.
+
+TPU-first decisions:
+
+- ``nn.scan`` over the layer stack (default on): one traced layer instead of
+  32 unrolled copies — compile time and HLO size stay O(1) in depth, and the
+  stacked [L, ...] params give FSDP a large, evenly divisible leading dim.
+- ``nn.remat`` per layer (default on): rematerialize activations in backward —
+  the HBM-for-FLOPs trade that makes 7B training fit (SURVEY.md 'HBM').
+- bf16 matmuls, f32 RMSNorm/softmax/rotary — the MXU mixed-precision recipe.
+- LoRA lives inside :class:`LoRADenseGeneral`: base kernel frozen via
+  ``optax`` masking (see :func:`lora_trainable`), adapters are the only
+  trained params. Adapter matmuls are rank-r — tiny — so they ride along the
+  main matmul without a fused kernel.
+- No parallelism logic in model code: FSDP/TP layouts come from
+  :func:`llama_rules` path-regex shardings (GSPMD inserts the collectives).
+
+Batch dict: ``input_ids`` [B,S] i32, optional ``attention_mask`` [B,S] 1/0,
+optional ``loss_mask`` (consumed by the loss, not the model). Returns logits
+[B,S,vocab] f32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from distributeddeeplearningspark_tpu.ops.attention import dot_product_attention
+from distributeddeeplearningspark_tpu.parallel.sharding import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32          # < num_heads → grouped-query attention
+    intermediate_size: int = 11008
+    max_position: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "auto"
+    scan_layers: bool = True
+    remat: bool = True
+    # LoRA (rank 0 = disabled → plain full-parameter model)
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Sequence[str] = ("wq", "wv")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """4-layer/128-wide config for CPU tests."""
+        base = dict(vocab_size=512, hidden_size=128, num_layers=4, num_heads=4,
+                    num_kv_heads=2, intermediate_size=256, max_position=128,
+                    dtype=jnp.float32)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def rotary_embedding(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Apply RoPE to [B,S,H,D] in f32, half-split (rotate-half) convention."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq      # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]                              # [B,S,1,D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    """Llama RMSNorm: f32 accumulation, learned scale, no bias."""
+
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        xf = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        y = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), -1, keepdims=True) + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+class LoRADenseGeneral(nn.Module):
+    """DenseGeneral with an optional rank-r LoRA delta: y = xW + (alpha/r)·xAB.
+
+    ``rank == 0`` → exactly ``nn.DenseGeneral`` (no extra params), so the same
+    model class serves pretraining and adapter fine-tuning; the base ``kernel``
+    is frozen by the optimizer mask, never by the module. A and B are stored
+    f32 (tiny) and named ``lora_a``/``lora_b`` — the path fragment both
+    :func:`lora_trainable` and :func:`llama_rules` key on. B starts at zero so
+    step 0 matches the base model (Hu et al. 2021).
+    """
+
+    features: int | Sequence[int]
+    axis: int | Sequence[int] = -1
+    rank: int = 0
+    alpha: float = 16.0
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = nn.DenseGeneral(self.features, axis=self.axis, use_bias=self.use_bias,
+                            dtype=self.dtype, name="base")(x)
+        if self.rank:
+            axes = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
+            axes = tuple(a % x.ndim for a in axes)
+            feats = (self.features,) if isinstance(self.features, int) else tuple(self.features)
+            in_dim = math.prod(x.shape[a] for a in axes)
+            batch_shape = tuple(s for i, s in enumerate(x.shape) if i not in axes)
+            a_mat = self.param("lora_a", nn.initializers.he_uniform(), (in_dim, self.rank),
+                               jnp.float32)
+            b_mat = self.param("lora_b", nn.initializers.zeros,
+                               (self.rank, math.prod(feats)), jnp.float32)
+            x2 = jnp.moveaxis(x, axes, range(x.ndim - len(axes), x.ndim))
+            x2 = x2.reshape(batch_shape + (in_dim,)).astype(self.dtype)
+            delta = (x2 @ a_mat.astype(self.dtype)) @ b_mat.astype(self.dtype)
+            delta = delta.reshape(batch_shape + feats) * (self.alpha / self.rank)
+            y = y + delta.astype(y.dtype)
+        return y
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array | None) -> jax.Array:
+        cfg = self.cfg
+        hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+
+        def proj(name, heads):
+            rank = cfg.lora_rank if name in cfg.lora_targets else 0
+            return LoRADenseGeneral((heads, hd), rank=rank, alpha=cfg.lora_alpha,
+                                    dtype=cfg.dtype, name=name)
+
+        q = proj("wq", nh)(x)                                   # [B,S,nh,hd]
+        k = proj("wk", nkv)(x)
+        v = proj("wv", nkv)(x)
+        positions = jnp.arange(x.shape[1])[None, :]
+        q = rotary_embedding(q, positions, cfg.rope_theta)
+        k = rotary_embedding(k, positions, cfg.rope_theta)
+        if nkv != nh:                                           # GQA: expand KV groups
+            k = jnp.repeat(k, nh // nkv, axis=2)
+            v = jnp.repeat(v, nh // nkv, axis=2)
+        y = dot_product_attention(q, k, v, mask=mask, causal=True,
+                                  impl=cfg.attention_impl)
+        rank = cfg.lora_rank if "wo" in cfg.lora_targets else 0
+        return LoRADenseGeneral(cfg.hidden_size, axis=(-2, -1), rank=rank,
+                                alpha=cfg.lora_alpha, dtype=cfg.dtype, name="wo")(y)
+
+
+class LlamaMLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+
+        def proj(name, feats, axis=-1):
+            rank = cfg.lora_rank if name in cfg.lora_targets else 0
+            return LoRADenseGeneral(feats, axis=axis, rank=rank, alpha=cfg.lora_alpha,
+                                    dtype=cfg.dtype, name=name)
+
+        gate = proj("gate", cfg.intermediate_size)(x)
+        up = proj("up", cfg.intermediate_size)(x)
+        return proj("down", cfg.hidden_size)(nn.silu(gate) * up)
+
+
+class DecoderLayer(nn.Module):
+    """Pre-norm block; returns (x, None) — the (carry, out) pair nn.scan wants."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array | None):
+        cfg = self.cfg
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, name="attention_norm")(x)
+        x = x + LlamaAttention(cfg, name="attention")(h, mask)
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, name="mlp_norm")(x)
+        x = x + LlamaMLP(cfg, name="mlp")(h)
+        return x, None
+
+
+class LlamaForCausalLM(nn.Module):
+    """Decoder-only LM; logits [B,S,vocab] f32 (untied head, as in Llama-2)."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
+        del train  # no dropout in Llama-2; kept for the uniform model API
+        cfg = self.cfg
+        ids = batch["input_ids"]
+        if ids.shape[1] > cfg.max_position:
+            raise ValueError(
+                f"sequence length {ids.shape[1]} exceeds max_position {cfg.max_position}"
+            )
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     name="token_embed")(ids)
+        pad = batch.get("attention_mask")
+        # causal handled inside attention; only pass an explicit mask for padding
+        mask = (pad > 0)[:, None, None, :] if pad is not None else None
+
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(layer_cls, prevent_cse=False)
+        if cfg.scan_layers:
+            stacked = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,           # mask is shared, not scanned
+                length=cfg.num_layers,
+            )(cfg, name="layers")
+            x, _ = stacked(x, mask)
+        else:
+            for i in range(cfg.num_layers):
+                x, _ = layer_cls(cfg, name=f"layers_{i}")(x, mask)
+
+        x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def llama2_7b(**kw) -> LlamaForCausalLM:
+    return LlamaForCausalLM(LlamaConfig.llama2_7b(**kw))
+
+
+def llama_tiny(**kw) -> LlamaForCausalLM:
+    return LlamaForCausalLM(LlamaConfig.tiny(**kw))
+
+
+def lora_trainable(path: str) -> bool:
+    """Optimizer mask for LoRA fine-tuning: train adapters only.
+
+    Use with :func:`distributeddeeplearningspark_tpu.train.optim.masked` — the
+    rebuild of the reference's per-param-group ``requires_grad=False`` on all
+    base weights.
+    """
+    return "lora_a" in path or "lora_b" in path
+
+
+def llama_rules(cfg: LlamaConfig, *, fsdp: bool = True,
+                fsdp_min_size: int = 2**14) -> ShardingRules:
+    """FSDP + Megatron-style tensor-parallel layout for the Llama tree.
+
+    Attention QKV shard heads over ``tensor``; the out-projection and MLP
+    down-projection shard their *input* (contracting) dim so GSPMD turns the
+    pair into a split-matmul + psum (one all-reduce per block, the Megatron
+    pattern). Embedding and LM head shard the vocab dim. LoRA adapters stay
+    replicated — rank-r factors are too small to be worth a collective. The
+    auto-FSDP pass then shards the largest remaining dim of every large
+    param over ``fsdp`` (with scanned layers that is usually the [L, ...]
+    leading dim — uniform and always divisible).
+    """
+    lead = (None,) if cfg.scan_layers else ()
+    rules = (
+        (r"lora_", P()),
+        (r"(wq|wk|wv)/base/kernel", P(*lead, None, "tensor", None)),
+        (r"wo/base/kernel", P(*lead, "tensor", None, None)),
+        (r"(gate|up)/base/kernel", P(*lead, None, "tensor")),
+        (r"down/base/kernel", P(*lead, "tensor", None)),
+        (r"token_embed/embedding", P("tensor", None)),
+        (r"lm_head/kernel", P(None, "tensor")),
+    )
+    return ShardingRules(rules=rules, fsdp=fsdp, fsdp_min_size=fsdp_min_size,
+                         fsdp_exclude=(r"lora_",))

@@ -1,0 +1,232 @@
+"""Llama-2 checkpoint interchange: HF safetensors ↔ flax param tree.
+
+The reference loads Llama-2 7B base weights from a Hugging Face checkpoint
+before attaching LoRA adapters (SURVEY.md §2 'Models: Llama-2 7B + LoRA';
+BASELINE.json config 5). Equivalent here: read HF ``*.safetensors`` shards
+into this package's :class:`~.llama.LlamaForCausalLM` param tree.
+
+Layout translation (HF torch stores Linear weights [out, in]; flax Dense
+kernels are [in, out]; attention projections additionally reshape to
+[in, heads, head_dim]):
+
+==============================================  =====================================
+HF tensor                                       flax path (per layer i)
+==============================================  =====================================
+model.embed_tokens.weight [V,H]                 token_embed/embedding [V,H]
+model.layers.i.self_attn.{q,k,v}_proj.weight    layers_i/attention/w{q,k,v}/base/kernel
+model.layers.i.self_attn.o_proj.weight [H,NH*D] layers_i/attention/wo/base/kernel [NH,D,H]
+model.layers.i.mlp.{gate,up}_proj.weight [I,H]  layers_i/mlp/{gate,up}/base/kernel [H,I]
+model.layers.i.mlp.down_proj.weight [H,I]       layers_i/mlp/down/base/kernel [I,H]
+model.layers.i.input_layernorm.weight           layers_i/attention_norm/scale
+model.layers.i.post_attention_layernorm.weight  layers_i/mlp_norm/scale
+model.norm.weight                               final_norm/scale
+lm_head.weight [V,H]                            lm_head/kernel [H,V]
+==============================================  =====================================
+
+With ``cfg.scan_layers`` the per-layer trees are stacked on a new leading axis
+(``layers/...`` [L, ...]) to match the ``nn.scan`` parameter layout. Loading
+streams one HF tensor at a time (numpy memory-map) so a 7B import never holds
+two full copies in host RAM; the caller then ``device_put``s with FSDP
+shardings so each chip receives only its slice.
+
+RoPE uses the same rotate-half convention as HF's modeling_llama, so imported
+weights reproduce HF logits bit-for-tolerance (see tests/test_llama.py parity
+test against ``transformers``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable
+
+import numpy as np
+
+from distributeddeeplearningspark_tpu.models.llama import LlamaConfig
+
+# LoRA adapters are deliberately absent: import provides the *base* model;
+# adapters are fresh (B=0) or restored from our own orbax checkpoints.
+
+
+def _layer_maps(cfg: LlamaConfig) -> list[tuple[str, str, Callable[[np.ndarray], np.ndarray]]]:
+    """(hf_suffix, flax_subpath, transform) for one decoder layer."""
+    h, nh, nkv, hd = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def qkv(heads):
+        # [heads*hd, H] torch → [H, heads, hd] flax
+        return lambda w: np.ascontiguousarray(w.T).reshape(h, heads, hd)
+
+    def o_proj(w):
+        # [H, nh*hd] torch → [nh, hd, H] flax
+        return np.ascontiguousarray(w.T).reshape(nh, hd, h)
+
+    t = lambda w: np.ascontiguousarray(w.T)  # noqa: E731
+    ident = lambda w: w  # noqa: E731
+    return [
+        ("self_attn.q_proj.weight", "attention/wq/base/kernel", qkv(nh)),
+        ("self_attn.k_proj.weight", "attention/wk/base/kernel", qkv(nkv)),
+        ("self_attn.v_proj.weight", "attention/wv/base/kernel", qkv(nkv)),
+        ("self_attn.o_proj.weight", "attention/wo/base/kernel", o_proj),
+        ("mlp.gate_proj.weight", "mlp/gate/base/kernel", t),
+        ("mlp.up_proj.weight", "mlp/up/base/kernel", t),
+        ("mlp.down_proj.weight", "mlp/down/base/kernel", t),
+        ("input_layernorm.weight", "attention_norm/scale", ident),
+        ("post_attention_layernorm.weight", "mlp_norm/scale", ident),
+    ]
+
+
+def _set_path(tree: dict, path: str, value) -> None:
+    keys = path.split("/")
+    for k in keys[:-1]:
+        tree = tree.setdefault(k, {})
+    tree[keys[-1]] = value
+
+
+def _open_shards(path: str):
+    """Yield a name→numpy loader over a file or HF shard directory."""
+    from safetensors import safe_open
+
+    if os.path.isdir(path):
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                weight_map = json.load(f)["weight_map"]
+            files = sorted(set(weight_map.values()))
+        else:
+            files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+        files = [os.path.join(path, f) for f in files]
+    else:
+        files = [path]
+
+    handles = [safe_open(f, framework="numpy") for f in files]
+    name_to_handle = {}
+    for hshard in handles:
+        for name in hshard.keys():
+            name_to_handle[name] = hshard
+
+    def load(name: str) -> np.ndarray:
+        if name not in name_to_handle:
+            raise KeyError(f"tensor {name!r} not found in {path}")
+        return name_to_handle[name].get_tensor(name)
+
+    return load, set(name_to_handle)
+
+
+def load_llama_safetensors(path: str, cfg: LlamaConfig,
+                           param_dtype: Any = np.float32) -> dict:
+    """HF Llama-2 safetensors (file or shard dir) → flax params dict."""
+    load, names = _open_shards(path)
+    cast = lambda w: np.asarray(w, dtype=param_dtype)  # noqa: E731
+
+    params: dict = {}
+    _set_path(params, "token_embed/embedding", cast(load("model.embed_tokens.weight")))
+    _set_path(params, "final_norm/scale", np.asarray(load("model.norm.weight"), np.float32))
+    if "lm_head.weight" in names:
+        head = load("lm_head.weight")
+    else:  # tied-embedding exports omit it
+        head = load("model.embed_tokens.weight")
+    _set_path(params, "lm_head/kernel", cast(np.ascontiguousarray(head.T)))
+
+    maps = _layer_maps(cfg)
+    if cfg.scan_layers:
+        for suffix, sub, tf in maps:
+            dtype = np.float32 if sub.endswith("scale") else param_dtype
+            stacked = np.stack([
+                np.asarray(tf(load(f"model.layers.{i}.{suffix}")), dtype=dtype)
+                for i in range(cfg.num_layers)
+            ])
+            _set_path(params, f"layers/{sub}", stacked)
+    else:
+        for i in range(cfg.num_layers):
+            for suffix, sub, tf in maps:
+                dtype = np.float32 if sub.endswith("scale") else param_dtype
+                w = np.asarray(tf(load(f"model.layers.{i}.{suffix}")), dtype=dtype)
+                _set_path(params, f"layers_{i}/{sub}", w)
+    return params
+
+
+def export_llama_safetensors(params: dict, cfg: LlamaConfig, path: str) -> None:
+    """flax params → one HF-layout safetensors file (inverse of the loader).
+
+    Used for interchange back to torch tooling and as the round-trip oracle in
+    tests. LoRA adapters, if present, are NOT merged or exported — fold them
+    into base kernels first if a merged export is needed (:func:`merge_lora`).
+    """
+    from safetensors.numpy import save_file
+
+    flat = _flatten(params)
+    h = cfg.hidden_size
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(flat["token_embed/embedding"])
+    out["model.norm.weight"] = np.asarray(flat["final_norm/scale"])
+    out["lm_head.weight"] = np.ascontiguousarray(np.asarray(flat["lm_head/kernel"]).T)
+
+    def un_qkv(w):  # [H, heads, hd] → [heads*hd, H]
+        return np.ascontiguousarray(w.reshape(h, -1).T)
+
+    inverse = {
+        "attention/wq/base/kernel": ("self_attn.q_proj.weight", un_qkv),
+        "attention/wk/base/kernel": ("self_attn.k_proj.weight", un_qkv),
+        "attention/wv/base/kernel": ("self_attn.v_proj.weight", un_qkv),
+        "attention/wo/base/kernel": (
+            "self_attn.o_proj.weight",
+            lambda w: np.ascontiguousarray(w.reshape(-1, h).T),
+        ),
+        "mlp/gate/base/kernel": ("mlp.gate_proj.weight", lambda w: np.ascontiguousarray(w.T)),
+        "mlp/up/base/kernel": ("mlp.up_proj.weight", lambda w: np.ascontiguousarray(w.T)),
+        "mlp/down/base/kernel": ("mlp.down_proj.weight", lambda w: np.ascontiguousarray(w.T)),
+        "attention_norm/scale": ("input_layernorm.weight", lambda w: w),
+        "mlp_norm/scale": ("post_attention_layernorm.weight", lambda w: w),
+    }
+    for key, value in flat.items():
+        m = re.match(r"layers(?:_(\d+))?/(.+)", key)
+        if not m:
+            continue
+        idx, sub = m.group(1), m.group(2)
+        if "lora_" in sub:
+            continue
+        hf_suffix, tf = inverse[sub]
+        value = np.asarray(value)
+        if idx is None:  # scanned: [L, ...] stacked
+            for i in range(cfg.num_layers):
+                out[f"model.layers.{i}.{hf_suffix}"] = tf(value[i])
+        else:
+            out[f"model.layers.{idx}.{hf_suffix}"] = tf(value)
+    save_file(out, path)
+
+
+def merge_lora(params: dict, cfg: LlamaConfig) -> dict:
+    """Fold trained LoRA adapters into base kernels: W ← W + (alpha/r)·A·B.
+
+    Returns a new tree with adapters removed — the deploy-time merge that makes
+    LoRA inference free (Hu et al. 2021 §4).
+    """
+
+    def merge_node(node):
+        if not isinstance(node, dict):
+            return node
+        if "lora_a" in node and "base" in node:
+            a, b = np.asarray(node["lora_a"]), np.asarray(node["lora_b"])
+            kernel = np.asarray(node["base"]["kernel"])
+            scale = cfg.lora_alpha / cfg.lora_rank
+            if a.ndim == 3:  # scanned: [L, in, r] @ [L, r, out]
+                delta = np.einsum("lir,lro->lio", a, b) * scale
+            else:
+                delta = (a @ b) * scale
+            merged = kernel + delta.reshape(kernel.shape).astype(kernel.dtype)
+            return {"base": {"kernel": merged}}
+        return {k: merge_node(v) for k, v in node.items()}
+
+    return merge_node(params)
+
+
+def _flatten(tree: dict, prefix: str = "") -> dict[str, Any]:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out

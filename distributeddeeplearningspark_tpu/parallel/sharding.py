@@ -53,11 +53,14 @@ class ShardingRules:
     '/'.joined param path wins.
     ``fsdp``: if True, params with ``size >= fsdp_min_size`` get their largest
     unsharded divisible dim sharded over the ``fsdp`` mesh axis.
+    ``fsdp_exclude``: path regexes whose params the auto-FSDP pass must leave
+    alone (e.g. LoRA adapters that should stay fully replicated).
     """
 
     rules: tuple[tuple[str, P], ...] = ()
     fsdp: bool = False
     fsdp_min_size: int = 2**14
+    fsdp_exclude: tuple[str, ...] = ()
 
     def spec_for(self, path: str, shape: tuple[int, ...], mesh: Mesh) -> P:
         spec = None
@@ -67,7 +70,11 @@ class ShardingRules:
                 break
         if spec is None:
             spec = P(*([None] * len(shape)))
-        if self.fsdp and mesh.shape[AXIS_FSDP] > 1:
+        if (
+            self.fsdp
+            and mesh.shape[AXIS_FSDP] > 1
+            and not any(re.search(p, path) for p in self.fsdp_exclude)
+        ):
             spec = _add_fsdp_axis(spec, shape, mesh, self.fsdp_min_size)
         return spec
 

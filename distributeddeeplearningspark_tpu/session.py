@@ -27,8 +27,9 @@ Recognized ``.config()`` keys (Spark names kept where they exist):
 
 - ``spark.executor.instances``  → data-parallel degree (mesh ``data`` axis)
 - ``spark.app.name``            → app name
-- ``mesh.fsdp`` / ``mesh.tensor`` / ``mesh.seq`` / ``mesh.expert``
-                                → remaining mesh axis sizes
+- ``mesh.data`` / ``mesh.fsdp`` / ``mesh.tensor`` / ``mesh.seq`` /
+  ``mesh.expert``               → mesh axis sizes (one may be -1 = wildcard;
+                                ``spark.executor.instances`` overrides ``mesh.data``)
 """
 
 from __future__ import annotations
@@ -219,7 +220,9 @@ def _parse_master(master: str | None, conf: dict[str, str]) -> tuple[list[jax.De
         pass
     elif _local_n(master) is not None:
         n = _local_n(master)
-        n_dev = n * fsdp * tensor * seq * expert
+        # a -1 (wildcard) axis contributes ×1 here: local[N] then means "N
+        # workers total", and the wildcard axis absorbs them in MeshSpec
+        n_dev = n * max(fsdp, 1) * max(tensor, 1) * max(seq, 1) * max(expert, 1)
         all_dev = jax.devices()
         if n_dev > len(all_dev):
             raise ValueError(
@@ -230,10 +233,14 @@ def _parse_master(master: str | None, conf: dict[str, str]) -> tuple[list[jax.De
     else:
         raise ValueError(f"unrecognized master URL: {master!r}")
 
+    if "mesh.data" in conf:
+        # explicit data-axis size; lets another axis (e.g. mesh.fsdp=-1) be
+        # the wildcard for FSDP-dominant layouts like config 5
+        data = int(conf["mesh.data"])
     if executors is not None:
         data = int(executors)
         if devices is None:
-            n_dev = data * fsdp * tensor * seq * expert
+            n_dev = data * max(fsdp, 1) * max(tensor, 1) * max(seq, 1) * max(expert, 1)
             all_dev = jax.devices()
             if n_dev > len(all_dev):
                 raise ValueError(

@@ -87,6 +87,60 @@ class Trainer:
                     f"{self.state.num_params:,}", dict(self.mesh.shape))
         return self.state
 
+    def load_pretrained(self, params, *, strict: bool = False,
+                        allow_uncovered: Sequence[str] = ("lora_",)) -> TrainState:
+        """Overlay imported weights (e.g. a HF Llama safetensors tree) on state.
+
+        The rebuild of the reference's "load base checkpoint, then attach
+        adapters" flow: leaves present in ``params`` replace the fresh-init
+        values. Staging stays host-side (numpy) until ``device_put`` with the
+        state's sharding, so each chip receives only its FSDP/TP slice and no
+        device ever holds a full unsharded tensor. Leaves absent from
+        ``params`` keep their initialized values; with ``strict``, both extra
+        overlay keys and model params NOT covered by the overlay (except paths
+        matching ``allow_uncovered``, by default LoRA adapters) raise.
+        """
+        assert self.state is not None, "call init() before load_pretrained()"
+        import re
+
+        import numpy as np
+
+        from distributeddeeplearningspark_tpu.parallel.sharding import path_str
+
+        flat_new = {path_str(p): x for p, x in
+                    jax.tree_util.tree_flatten_with_path(params)[0]}
+        seen = set()
+
+        def overlay(path, current, sharding):
+            key = path_str(path)
+            if key in flat_new:
+                seen.add(key)
+                new = flat_new[key]
+                if tuple(new.shape) != tuple(current.shape):
+                    raise ValueError(
+                        f"pretrained {key}: shape {new.shape} != model {current.shape}")
+                return jax.device_put(np.asarray(new, current.dtype), sharding)
+            return current
+
+        new_params = jax.tree_util.tree_map_with_path(
+            overlay, self.state.params, self.state_shardings.params)
+        extra = set(flat_new) - seen
+        model_keys = {path_str(p) for p, _ in
+                      jax.tree_util.tree_flatten_with_path(self.state.params)[0]}
+        uncovered = {k for k in model_keys - seen
+                     if not any(re.search(pat, k) for pat in allow_uncovered)}
+        if strict and (extra or uncovered):
+            raise ValueError(
+                f"pretrained overlay mismatch: extra keys {sorted(extra)[:4]}, "
+                f"uncovered model params {sorted(uncovered)[:4]}")
+        if extra:
+            logger.warning("ignored %d pretrained keys not in model", len(extra))
+        if uncovered:
+            logger.warning("%d model params not covered by pretrained overlay "
+                           "(e.g. %s)", len(uncovered), sorted(uncovered)[:3])
+        self.state = self.state.replace(params=new_params)
+        return self.state
+
     def _feed(self, dataset: PartitionedDataset, batch_size: int):
         hb = host_batches(dataset, batch_size, num_shards=num_data_shards(self.mesh))
         return prefetch_to_device(hb, self.mesh)

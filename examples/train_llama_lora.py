@@ -1,0 +1,108 @@
+"""Config-5 driver script: Llama-2 LoRA fine-tune, FSDP-sharded.
+
+Reference shape (BASELINE.json config 5): load Llama-2 7B base weights,
+attach LoRA adapters, FSDP-shard across Spark executors on a v4-32, train
+adapters only. Here: same driver surface — HF safetensors import, LoRA via
+the optimizer mask, FSDP(+optional TP) via GSPMD sharding rules::
+
+    dlsubmit examples/train_llama_lora.py -- --variant tiny --steps 50
+    dlsubmit examples/train_llama_lora.py -- \
+        --variant 7b --weights /data/llama-2-7b-hf --fsdp 8 --tensor 4
+"""
+
+import argparse
+import logging
+
+from distributeddeeplearningspark_tpu import Session, Trainer
+from distributeddeeplearningspark_tpu.data import text as text_lib
+from distributeddeeplearningspark_tpu.models import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama_rules,
+    lora_trainable,
+)
+from distributeddeeplearningspark_tpu.models import llama_io
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+from distributeddeeplearningspark_tpu.train import losses, optim
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--master", default=None)
+    p.add_argument("--variant", default="tiny", choices=["7b", "tiny"])
+    p.add_argument("--weights", default=None, help="HF safetensors file/dir for the base model")
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--lora-rank", type=int, default=8)
+    p.add_argument("--lora-alpha", type=float, default=16.0)
+    p.add_argument("--fsdp", type=int, default=-1, help="FSDP axis size (-1: all devices)")
+    p.add_argument("--tensor", type=int, default=1, help="tensor-parallel axis size")
+    p.add_argument("--corpus", default=None, help="text file (one doc per line); synthetic if unset")
+    p.add_argument("--tokenizer", default=None,
+                   help="HF tokenizer dir matching --weights (required with --weights: "
+                        "token ids must index the pretrained embedding rows)")
+    args = p.parse_args()
+    if args.weights and not args.tokenizer:
+        p.error("--weights requires --tokenizer (the checkpoint's own vocab); "
+                "a corpus-trained WordPiece vocab would index unrelated embedding rows")
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    # config 5 is FSDP-dominant: batch splits over (data, fsdp) so FSDP workers
+    # are the "executors"; `--tensor` peels off chips for TP within each.
+    spark = (
+        Session.builder.master(args.master or "auto").appName("llama-lora")
+        .config("mesh.data", 1).config("mesh.fsdp", args.fsdp)
+        .config("mesh.tensor", args.tensor).getOrCreate()
+    )
+    print(spark)
+
+    if args.corpus:
+        with open(args.corpus) as f:
+            lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+        docs = PartitionedDataset.parallelize(lines, spark.default_parallelism)
+    else:
+        docs = text_lib.synthetic_wikipedia(1024, num_partitions=max(spark.default_parallelism, 1))
+    if args.tokenizer:
+        tok = text_lib.HFTokenizerAdapter.load(args.tokenizer)
+    else:
+        tok = text_lib.WordPieceTokenizer.train(docs.collect(), vocab_size=2048)
+
+    if args.variant == "7b":
+        cfg = LlamaConfig.llama2_7b(lora_rank=args.lora_rank, lora_alpha=args.lora_alpha)
+        if tok.vocab_size > cfg.vocab_size:
+            # nn.Embed's take() silently clamps out-of-range ids under jit —
+            # fail loudly instead of training on a wrong embedding row
+            raise SystemExit(
+                f"tokenizer vocab ({tok.vocab_size}) exceeds model vocab "
+                f"({cfg.vocab_size}); use the checkpoint's original tokenizer")
+    else:
+        cfg = LlamaConfig.tiny(
+            vocab_size=max(tok.vocab_size, 512),
+            lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
+        )
+    model = LlamaForCausalLM(cfg)
+
+    ds = text_lib.lm_dataset(docs, tok, seq_len=args.seq_len).repeat()
+
+    tx = optim.with_grad_clip(
+        optim.masked(optim.adamw(optim.warmup_cosine(
+                         args.lr, min(10, max(args.steps // 10, 1)), args.steps)),
+                     lora_trainable),
+        1.0,
+    )
+    trainer = Trainer(spark, model, losses.causal_lm, tx, rules=llama_rules(cfg))
+    trainer.init(trainer._sample_batch(ds, args.batch_size))
+    if args.weights:
+        trainer.load_pretrained(llama_io.load_llama_safetensors(args.weights, cfg))
+    state, summary = trainer.fit(
+        ds, batch_size=args.batch_size, steps=args.steps,
+        tokens_per_example=args.seq_len, log_every=10,
+    )
+    print({k: round(float(v), 4) for k, v in summary.items()})
+    spark.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,224 @@
+"""Llama-2 + LoRA tests (config 5, SURVEY.md §4).
+
+Covers: forward/causality, scan↔loop layer-stack equivalence, LoRA freeze
+semantics, FSDP×TP sharded training on the 8-fake-device mesh, safetensors
+round-trip, and numerical parity against torch/transformers' LlamaForCausalLM
+(the §4 "numerical parity" strategy — torch CPU is the stand-in oracle for the
+unreachable reference).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearningspark_tpu.data.feed import put_global, stack_examples
+from distributeddeeplearningspark_tpu.models import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama_rules,
+    llama_tiny,
+    lora_trainable,
+)
+from distributeddeeplearningspark_tpu.models import llama_io
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+from distributeddeeplearningspark_tpu.parallel.sharding import path_str
+from distributeddeeplearningspark_tpu.train import losses, optim, step as step_lib
+
+
+def make_batch(b=2, s=16, vocab=512, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, (b, s)).astype(np.int32)}
+
+
+def test_forward_shape_and_dtype():
+    model = llama_tiny()
+    batch = make_batch()
+    variables = model.init(jax.random.PRNGKey(0), batch, train=False)
+    logits = model.apply(variables, batch, train=False)
+    assert logits.shape == (2, 16, model.cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_causality():
+    """Changing token t+k must not change the logits at position t."""
+    model = llama_tiny()
+    batch = make_batch(b=1, s=16)
+    variables = model.init(jax.random.PRNGKey(0), batch, train=False)
+    base = np.asarray(model.apply(variables, batch, train=False))
+    mutated = {"input_ids": batch["input_ids"].copy()}
+    mutated["input_ids"][0, 10:] = (mutated["input_ids"][0, 10:] + 7) % 512
+    out = np.asarray(model.apply(variables, mutated, train=False))
+    np.testing.assert_allclose(base[0, :10], out[0, :10], atol=1e-5)
+    assert np.abs(base[0, 10:] - out[0, 10:]).max() > 1e-4
+
+
+def test_scan_matches_loop():
+    """nn.scan layer stacking must be numerically identical to the python loop."""
+    cfg_scan = LlamaConfig.tiny(scan_layers=True, remat=False)
+    cfg_loop = LlamaConfig.tiny(scan_layers=False, remat=False)
+    batch = make_batch()
+    scan_model = LlamaForCausalLM(cfg_scan)
+    params = scan_model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+
+    # unstack layers/[L,...] into layers_i/... for the loop model
+    loop_params = {k: v for k, v in params.items() if k != "layers"}
+    for i in range(cfg_loop.num_layers):
+        loop_params[f"layers_{i}"] = jax.tree.map(lambda x: x[i], params["layers"])
+
+    out_scan = scan_model.apply({"params": params}, batch, train=False)
+    out_loop = LlamaForCausalLM(cfg_loop).apply({"params": loop_params}, batch, train=False)
+    np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_loop), atol=2e-5)
+
+
+class TestLoRA:
+    def test_zero_init_matches_base(self):
+        """With B=0 at init, the adapted model must equal the base model."""
+        base_cfg = LlamaConfig.tiny(remat=False)
+        lora_cfg = LlamaConfig.tiny(remat=False, lora_rank=4)
+        batch = make_batch()
+        lora_params = LlamaForCausalLM(lora_cfg).init(
+            jax.random.PRNGKey(0), batch, train=False)["params"]
+        # strip lora leaves to form the base tree
+        def strip(node):
+            if isinstance(node, dict):
+                return {k: strip(v) for k, v in node.items()
+                        if k not in ("lora_a", "lora_b")}
+            return node
+        base_params = strip(lora_params)
+        out_lora = LlamaForCausalLM(lora_cfg).apply({"params": lora_params}, batch, train=False)
+        out_base = LlamaForCausalLM(base_cfg).apply({"params": base_params}, batch, train=False)
+        np.testing.assert_allclose(np.asarray(out_lora), np.asarray(out_base), atol=1e-6)
+
+    def test_masked_optimizer_freezes_base(self):
+        """One train step: base kernels unchanged, lora_b updated, loss finite."""
+        cfg = LlamaConfig.tiny(lora_rank=4)
+        model = LlamaForCausalLM(cfg)
+        mesh = MeshSpec(data=-1).build()
+        tx = optim.masked(optax.adamw(1e-2), lora_trainable)
+        batch = stack_examples([{"input_ids": r} for r in make_batch(8, 16)["input_ids"]])
+        state, shardings = step_lib.init_state(model, tx, batch, mesh, llama_rules(cfg))
+        before = {path_str(p): np.asarray(x) for p, x in
+                  jax.tree_util.tree_flatten_with_path(state.params)[0]}
+        train = step_lib.jit_train_step(
+            step_lib.make_train_step(model.apply, tx, losses.causal_lm), mesh, shardings)
+        state, metrics = train(state, put_global(batch, mesh))
+        assert np.isfinite(float(jax.device_get(metrics["loss"])))
+        after = {path_str(p): np.asarray(x) for p, x in
+                 jax.tree_util.tree_flatten_with_path(state.params)[0]}
+        for pstr, old in before.items():
+            new = after[pstr]
+            if "lora_b" in pstr:
+                assert np.abs(new - old).max() > 0, f"{pstr} should have trained"
+            elif "lora" not in pstr:
+                np.testing.assert_array_equal(new, old, err_msg=f"{pstr} must stay frozen")
+
+    def test_merge_lora(self):
+        """merge_lora(base+adapters) must reproduce the adapted forward."""
+        cfg = LlamaConfig.tiny(remat=False, lora_rank=4)
+        model = LlamaForCausalLM(cfg)
+        batch = make_batch()
+        params = model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+        # make adapters non-trivial (B=0 at init would make the merge vacuous)
+        params = jax.tree_util.tree_map_with_path(
+            lambda p, x: x + 0.01 if "lora_b" in path_str(p) else x, params)
+        out_adapted = model.apply({"params": params}, batch, train=False)
+        merged = llama_io.merge_lora(jax.tree.map(np.asarray, params), cfg)
+        base_model = LlamaForCausalLM(LlamaConfig.tiny(remat=False))
+        out_merged = base_model.apply({"params": merged}, batch, train=False)
+        np.testing.assert_allclose(
+            np.asarray(out_adapted), np.asarray(out_merged), atol=2e-5)
+
+
+def test_fsdp_tp_sharded_train_step(eight_devices):
+    """FSDP×TP mesh: params actually sharded, step runs, grads sync (config 5)."""
+    cfg = LlamaConfig.tiny(lora_rank=4)
+    model = LlamaForCausalLM(cfg)
+    mesh = MeshSpec(data=2, fsdp=2, tensor=2).build(eight_devices)
+    rules = llama_rules(cfg, fsdp_min_size=1)
+    tx = optim.masked(optax.adamw(1e-2), lora_trainable)
+    batch = stack_examples([{"input_ids": r} for r in make_batch(8, 16)["input_ids"]])
+    state, shardings = step_lib.init_state(model, tx, batch, mesh, rules)
+
+    specs = rules.tree_specs(state.params, mesh)
+    flat = {path_str(p): s for p, s in jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))[0]}
+    wq = flat["layers/attention/wq/base/kernel"]
+    assert "tensor" in jax.tree.leaves(tuple(wq)), f"wq spec {wq} should use tensor axis"
+    assert any("fsdp" in str(s) for s in flat.values()), "no param picked up fsdp axis"
+
+    train = step_lib.jit_train_step(
+        step_lib.make_train_step(model.apply, tx, losses.causal_lm), mesh, shardings)
+    state, metrics = train(state, put_global(batch, mesh))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+class TestSafetensorsIO:
+    def test_roundtrip_loop_layout(self, tmp_path):
+        cfg = LlamaConfig.tiny(scan_layers=False, remat=False)
+        model = LlamaForCausalLM(cfg)
+        batch = make_batch()
+        params = jax.tree.map(
+            np.asarray, model.init(jax.random.PRNGKey(1), batch, train=False)["params"])
+        path = str(tmp_path / "model.safetensors")
+        llama_io.export_llama_safetensors(params, cfg, path)
+        loaded = llama_io.load_llama_safetensors(path, cfg)
+        jax.tree.map(np.testing.assert_allclose, params, loaded)
+
+    def test_hf_file_loads_into_scan_layout(self, tmp_path):
+        """Same HF file must load into scanned and loop layouts with equal logits."""
+        loop_cfg = LlamaConfig.tiny(scan_layers=False, remat=False)
+        scan_cfg = LlamaConfig.tiny(scan_layers=True, remat=False)
+        model = LlamaForCausalLM(loop_cfg)
+        batch = make_batch()
+        params = jax.tree.map(
+            np.asarray, model.init(jax.random.PRNGKey(2), batch, train=False)["params"])
+        path = str(tmp_path / "model.safetensors")
+        llama_io.export_llama_safetensors(params, loop_cfg, path)
+        scan_params = llama_io.load_llama_safetensors(path, scan_cfg)
+        out_loop = model.apply({"params": params}, batch, train=False)
+        out_scan = LlamaForCausalLM(scan_cfg).apply({"params": scan_params}, batch, train=False)
+        np.testing.assert_allclose(np.asarray(out_loop), np.asarray(out_scan), atol=2e-5)
+
+
+def test_lm_dataset_packing():
+    """Packed causal-LM blocks: fixed shapes, full loss mask except final pad."""
+    from distributeddeeplearningspark_tpu.data import text as text_lib
+
+    docs = text_lib.synthetic_wikipedia(32, num_partitions=2, seed=3)
+    tok = text_lib.WordPieceTokenizer.train(docs.collect(), vocab_size=512)
+    examples = text_lib.lm_dataset(docs, tok, seq_len=64).collect()
+    assert len(examples) > 2
+    for ex in examples:
+        assert set(ex) == {"input_ids", "loss_mask"}
+        assert ex["input_ids"].shape == (64,) and ex["loss_mask"].shape == (64,)
+    full = [ex for ex in examples if ex["loss_mask"].all()]
+    assert len(full) >= len(examples) - 2  # only trailing blocks may be padded
+
+
+def test_parity_with_transformers(tmp_path):
+    """Golden parity vs torch LlamaForCausalLM (SURVEY.md §4 'Numerical parity')."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=512, hidden_size=128, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=256,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    hf_dir = str(tmp_path / "hf")
+    hf_model.save_pretrained(hf_dir, safe_serialization=True)
+
+    cfg = LlamaConfig.tiny(remat=False)
+    params = llama_io.load_llama_safetensors(hf_dir, cfg)
+    batch = make_batch(b=2, s=16)
+    ours = np.asarray(LlamaForCausalLM(cfg).apply({"params": params}, batch, train=False))
+
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(batch["input_ids"].astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
