@@ -26,6 +26,7 @@ from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
 from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
 from distributeddeeplearningspark_tpu.train.state import TrainState
 from distributeddeeplearningspark_tpu.train.trainer import Trainer
+from distributeddeeplearningspark_tpu.checkpoint import Checkpointer
 
 __version__ = "0.1.0"
 
@@ -35,5 +36,6 @@ __all__ = [
     "MeshSpec",
     "TrainState",
     "Trainer",
+    "Checkpointer",
     "__version__",
 ]

@@ -1,0 +1,149 @@
+"""Checkpoint/resume — sharded async orbax checkpoints with reshard-on-restore.
+
+The reference checkpoints driver-side: the Spark driver holds the full
+``state_dict`` and ``torch.save``s it each round boundary; resume is load +
+re-broadcast (SURVEY.md §3.4, §5 'Checkpoint/resume'). That design cannot work
+TPU-first — a 7B FSDP state never exists whole on any host. Instead each chip
+writes exactly its own shards (orbax + tensorstore, async so the write overlaps
+the next training steps), and restore is *resharding*: the caller supplies the
+target shardings, so a checkpoint written on one topology (say a v4-32 FSDP
+mesh) restores onto any other (a single chip, a differently shaped mesh)
+without ever materializing the full state in host memory.
+
+Spark's fault-tolerance story — failed tasks re-run from lineage — has no SPMD
+equivalent (a lost host kills the gang-scheduled step), so frequent async
+checkpoints + the :mod:`.supervisor` restart loop are the rebuild's elasticity
+mechanism (SURVEY.md §5 'Failure detection').
+
+Alongside the model state a small JSON ``data_state`` rides in the same
+checkpoint step (examples seen, epoch), giving deterministic input pipelines
+enough to fast-forward on resume — the analogue of Spark re-running from a
+partition boundary rather than from scratch.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import jax
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.checkpoint")
+
+# orbax narrates every save/restore phase at INFO through the root logger;
+# keep driver-script logs readable (opt back in via the 'orbax' logger).
+for _name in ("orbax", "absl"):
+    logging.getLogger(_name).setLevel(logging.WARNING)
+
+_STATE = "state"
+_DATA = "data"
+
+
+def abstract_like(tree: Any, shardings: Any = None) -> Any:
+    """ShapeDtypeStruct tree (with target shardings attached if given)."""
+    if shardings is None:
+        return jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+    return jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        tree,
+        shardings,
+    )
+
+
+class Checkpointer:
+    """Async sharded checkpoint manager for :class:`~..train.state.TrainState`.
+
+    Parameters
+    ----------
+    directory:
+        Checkpoint root (one numbered subdir per step). Created if absent.
+    max_to_keep:
+        Retention window; older steps are garbage-collected.
+    async_save:
+        Write in a background thread so training continues during the save
+        (the TPU-first replacement for the reference's blocking driver-side
+        ``torch.save``). ``wait()`` or ``close()`` joins outstanding writes.
+    """
+
+    def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 3,
+                 async_save: bool = True):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(os.fspath(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    # -- write ---------------------------------------------------------------
+
+    def save(self, step: int, state: Any, *, data_state: dict | None = None,
+             force: bool = False) -> bool:
+        """Queue an async save of ``state`` (+ optional JSON ``data_state``)."""
+        import orbax.checkpoint as ocp
+
+        items = {_STATE: ocp.args.StandardSave(state)}
+        if data_state is not None:
+            items[_DATA] = ocp.args.JsonSave(data_state)
+        saved = self._mgr.save(int(step), args=ocp.args.Composite(**items), force=force)
+        if saved:
+            logger.info("checkpoint step %d queued → %s", step, self.directory)
+        return saved
+
+    # -- read ----------------------------------------------------------------
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, state_template: Any, *, step: int | None = None,
+                shardings: Any = None) -> tuple[Any, dict | None]:
+        """Restore ``(state, data_state)`` at ``step`` (default: latest).
+
+        ``state_template`` provides structure/shapes/dtypes (concrete arrays
+        or ``jax.eval_shape`` output both work). ``shardings`` — typically the
+        pytree returned by ``train.step.init_state`` — directs each chip to
+        read only its slice; this is what makes cross-topology restore work.
+        With ``shardings=None`` arrays restore with the layout recorded in the
+        checkpoint (same-topology resume only).
+        """
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        abstract = abstract_like(state_template, shardings)
+        items = {_STATE: ocp.args.StandardRestore(abstract)}
+        try:
+            present = set(self._mgr.item_metadata(int(step)).keys())
+        except Exception:  # metadata probing is best-effort across orbax versions
+            present = {_STATE, _DATA}
+        if _DATA in present:
+            items[_DATA] = ocp.args.JsonRestore()
+        restored = self._mgr.restore(int(step), args=ocp.args.Composite(**items))
+        data_state = restored[_DATA] if _DATA in items else None
+        logger.info("restored checkpoint step %d from %s", step, self.directory)
+        return restored[_STATE], data_state
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def wait(self) -> None:
+        """Block until queued async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

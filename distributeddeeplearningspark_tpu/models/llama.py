@@ -38,7 +38,10 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
-from distributeddeeplearningspark_tpu.ops.attention import dot_product_attention
+from distributeddeeplearningspark_tpu.ops.attention import (
+    dot_product_attention,
+    padding_mask,
+)
 from distributeddeeplearningspark_tpu.parallel.sharding import ShardingRules
 
 
@@ -225,7 +228,7 @@ class LlamaForCausalLM(nn.Module):
                      name="token_embed")(ids)
         pad = batch.get("attention_mask")
         # causal handled inside attention; only pass an explicit mask for padding
-        mask = (pad > 0)[:, None, None, :] if pad is not None else None
+        mask = padding_mask(pad) if pad is not None else None
 
         layer_cls = DecoderLayer
         if cfg.remat:

@@ -141,8 +141,32 @@ class Trainer:
         self.state = self.state.replace(params=new_params)
         return self.state
 
-    def _feed(self, dataset: PartitionedDataset, batch_size: int):
+    def restore(self, checkpointer=None, *, step: int | None = None):
+        """Restore (state, data_state) from a checkpoint onto THIS mesh.
+
+        The reference resumes by driver-side ``torch.load`` + re-broadcast
+        (SURVEY.md §3.4); here restore reshards: the checkpoint may have been
+        written on any topology, and each chip reads only its slice as
+        dictated by this trainer's shardings. Call after ``init()``.
+        """
+        ckpt = checkpointer or self.checkpointer
+        assert ckpt is not None, "no checkpointer configured"
+        assert self.state is not None, "call init() before restore()"
+        self.state, data_state = ckpt.restore(
+            self.state, step=step, shardings=self.state_shardings
+        )
+        logger.info("resumed at step %d", int(jax.device_get(self.state.step)))
+        return self.state, data_state
+
+    def _feed(self, dataset: PartitionedDataset, batch_size: int, *, skip_batches: int = 0):
         hb = host_batches(dataset, batch_size, num_shards=num_data_shards(self.mesh))
+        if skip_batches:
+            # Resume fast-forward: burn host batches (no device transfer) so a
+            # deterministic pipeline continues from where the checkpoint left
+            # off — the analogue of Spark resuming at a partition boundary.
+            import itertools
+
+            hb = itertools.islice(hb, skip_batches, None)
         return prefetch_to_device(hb, self.mesh)
 
     # -- training -----------------------------------------------------------
@@ -160,6 +184,7 @@ class Trainer:
         eval_dataset: PartitionedDataset | None = None,
         eval_every: int | None = None,
         callbacks: Sequence[Callable[[int, dict], None]] = (),
+        data_state: dict | None = None,
     ) -> tuple[TrainState, dict[str, float]]:
         """Train until ``steps`` (or dataset exhaustion × ``epochs``).
 
@@ -185,7 +210,10 @@ class Trainer:
         step_i = int(jax.device_get(self.state.step))
         lap_start = step_i
         last_metrics: dict[str, float] = {}
-        for batch in self._feed(dataset, batch_size):
+        skip = 0
+        if data_state and data_state.get("examples_seen"):
+            skip = int(data_state["examples_seen"]) // batch_size
+        for batch in self._feed(dataset, batch_size, skip_batches=skip):
             if steps is not None and step_i >= steps:
                 break
             self.state, metrics = self._train_step(self.state, batch)
@@ -199,7 +227,11 @@ class Trainer:
             for cb in callbacks:
                 cb(step_i, last_metrics)
             if checkpoint_every and self.checkpointer and step_i % checkpoint_every == 0:
-                self.checkpointer.save(step_i, self.state)
+                self.checkpointer.save(
+                    step_i, self.state,
+                    data_state={"examples_seen": step_i * batch_size,
+                                "batch_size": batch_size},
+                )
             if eval_every and eval_dataset is not None and step_i % eval_every == 0:
                 emetrics = self.evaluate(eval_dataset, batch_size=batch_size)
                 mlog.log(step_i, {f"eval_{k}": v for k, v in emetrics.items()})
@@ -207,7 +239,11 @@ class Trainer:
         jax.block_until_ready(self.state.params)
         summary = {**meter.summary(), **last_metrics}
         if self.checkpointer and checkpoint_every:
-            self.checkpointer.save(step_i, self.state)
+            self.checkpointer.save(
+                step_i, self.state,
+                data_state={"examples_seen": step_i * batch_size,
+                            "batch_size": batch_size},
+            )
             self.checkpointer.wait()
         mlog.close()
         return self.state, summary

@@ -86,11 +86,15 @@ def main() -> None:
 
     ds = text_lib.lm_dataset(docs, tok, seq_len=args.seq_len).repeat()
 
-    tx = optim.with_grad_clip(
-        optim.masked(optim.adamw(optim.warmup_cosine(
-                         args.lr, min(10, max(args.steps // 10, 1)), args.steps)),
-                     lora_trainable),
-        1.0,
+    # clip INSIDE the mask: the norm must be over adapter grads only, or the
+    # frozen base weights' grads dominate it and shrink the LoRA updates
+    tx = optim.masked(
+        optim.with_grad_clip(
+            optim.adamw(optim.warmup_cosine(
+                args.lr, min(10, max(args.steps // 10, 1)), args.steps)),
+            1.0,
+        ),
+        lora_trainable,
     )
     trainer = Trainer(spark, model, losses.causal_lm, tx, rules=llama_rules(cfg))
     trainer.init(trainer._sample_batch(ds, args.batch_size))

@@ -25,6 +25,9 @@ def main() -> None:
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--lr", type=float, default=0.01)
     p.add_argument("--data-dir", default=None, help="dir with MNIST IDX files; synthetic if unset")
+    p.add_argument("--checkpoint-dir", default=None, help="enable checkpointing to this dir")
+    p.add_argument("--checkpoint-every", type=int, default=25)
+    p.add_argument("--resume", action="store_true", help="resume from latest checkpoint")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -39,11 +42,23 @@ def main() -> None:
         train_ds = synthetic_mnist(4096, num_partitions=spark.default_parallelism, seed=0)
         test_ds = synthetic_mnist(512, num_partitions=spark.default_parallelism, seed=99)
 
+    ckpt = None
+    if args.checkpoint_dir:
+        from distributeddeeplearningspark_tpu import Checkpointer
+
+        ckpt = Checkpointer(args.checkpoint_dir)
     trainer = Trainer(
-        spark, LeNet5(), losses.softmax_xent, optax.sgd(args.lr, momentum=0.9)
+        spark, LeNet5(), losses.softmax_xent, optax.sgd(args.lr, momentum=0.9),
+        checkpointer=ckpt,
     )
+    data_state = None
+    if args.resume and ckpt and ckpt.latest_step() is not None:
+        trainer.init(trainer._sample_batch(train_ds, args.batch_size))
+        _, data_state = trainer.restore()
     state, summary = trainer.fit(
-        train_ds.repeat(), batch_size=args.batch_size, steps=args.steps, log_every=25
+        train_ds.repeat(), batch_size=args.batch_size, steps=args.steps, log_every=25,
+        checkpoint_every=args.checkpoint_every if ckpt else None,
+        data_state=data_state,
     )
     metrics = trainer.evaluate(test_ds, batch_size=args.batch_size)
     print(f"train summary: {summary}")
