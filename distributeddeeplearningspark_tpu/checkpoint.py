@@ -122,10 +122,14 @@ class Checkpointer:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         abstract = abstract_like(state_template, shardings)
         items = {_STATE: ocp.args.StandardRestore(abstract)}
-        try:
-            present = set(self._mgr.item_metadata(int(step)).keys())
-        except Exception:  # metadata probing is best-effort across orbax versions
-            present = {_STATE, _DATA}
+        step_dir = os.path.join(self.directory, str(int(step)))
+        if os.path.isdir(step_dir):
+            present = set(os.listdir(step_dir))
+        else:  # non-default step-name format; fall back to orbax metadata
+            try:
+                present = set(self._mgr.item_metadata(int(step)).keys())
+            except Exception:
+                present = {_STATE, _DATA}
         if _DATA in present:
             items[_DATA] = ocp.args.JsonRestore()
         restored = self._mgr.restore(int(step), args=ocp.args.Composite(**items))

@@ -32,6 +32,24 @@ from distributeddeeplearningspark_tpu.parallel.mesh import BATCH_AXES, num_data_
 from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
 
 
+def process_shard_range(num_shards: int) -> tuple[int, int] | None:
+    """This process's data-shard slice [lo, hi), or None when single-process.
+
+    Mesh device order is process-major (jax.devices() sorts by id, ids are
+    assigned per process), so each process's addressable batch rows are one
+    contiguous run of shards.
+    """
+    pc = jax.process_count()
+    if pc == 1:
+        return None
+    if num_shards % pc:
+        raise ValueError(
+            f"data shards ({num_shards}) must divide evenly across {pc} processes"
+        )
+    spp = num_shards // pc
+    return (jax.process_index() * spp, (jax.process_index() + 1) * spp)
+
+
 def stack_examples(examples: list[dict[str, Any]]) -> dict[str, np.ndarray]:
     keys = examples[0].keys()
     return {k: np.stack([np.asarray(e[k]) for e in examples]) for k in keys}
@@ -58,9 +76,26 @@ def host_batches(
     *,
     num_shards: int = 1,
     drop_remainder: bool = True,
+    shard_range: tuple[int, int] | None = None,
 ) -> Iterator[dict[str, np.ndarray]]:
-    """Yield stacked global host batches from an RDD of example dicts."""
+    """Yield stacked host batches from an RDD of example dicts.
+
+    ``shard_range=(lo, hi)`` restricts output to data shards [lo, hi) — the
+    multi-process mode: each host STACKS only the rows its own devices will
+    hold (``batch_size`` stays the GLOBAL batch size), as each Spark executor
+    trains only its own partitions. Every host still *advances* all shard
+    streams in lockstep so that end-of-data is decided identically everywhere
+    — uneven shards must never let one host yield a batch its peers don't,
+    or the stragglers hang in the next collective. The partition→shard mapping
+    is global (partition *i* → shard ``i % num_shards``).
+    """
     n_parts = dataset.num_partitions
+    lo, hi = shard_range if shard_range is not None else (0, num_shards)
+    if shard_range is not None and batch_size % num_shards:
+        raise ValueError(
+            f"multi-process feed needs batch_size ({batch_size}) divisible by "
+            f"num_shards ({num_shards})"
+        )
     aligned = n_parts % num_shards == 0 and batch_size % num_shards == 0
     if aligned and n_parts > 1:
         # partition i → shard (i % num_shards); lockstep draw keeps pairing.
@@ -80,23 +115,32 @@ def host_batches(
             if short:
                 # Partial final batch: only meaningful if it still divides
                 # evenly across shards (GSPMD needs equal shard sizes).
-                if not drop_remainder:
+                if not drop_remainder and shard_range is None:
                     rest = [e for chunk in shard_chunks for e in chunk]
                     keep = len(rest) - len(rest) % num_shards
                     if keep:
                         yield stack_examples(rest[:keep])
                 return
-            yield stack_examples([e for chunk in shard_chunks for e in chunk])
+            yield stack_examples(
+                [e for chunk in shard_chunks[lo:hi] for e in chunk]
+            )
     else:
+        # chained fallback: every host walks the same global stream in order
+        # and keeps only its shards' rows — correct but not bandwidth-minimal;
+        # align partitions to shards to avoid it.
+        per_shard = batch_size // num_shards if batch_size % num_shards == 0 else None
         stream = itertools.chain.from_iterable(
             dataset.iter_partition(i) for i in range(n_parts)
         )
         while True:
             chunk = list(itertools.islice(stream, batch_size))
             if len(chunk) < batch_size:
-                if chunk and not drop_remainder:
+                if chunk and not drop_remainder and shard_range is None:
                     yield stack_examples(chunk)
                 return
+            if shard_range is not None:
+                assert per_shard is not None
+                chunk = chunk[lo * per_shard:hi * per_shard]
             yield stack_examples(chunk)
 
 
@@ -124,7 +168,9 @@ def device_batches(
     drop_remainder: bool = True,
 ) -> Iterator[dict[str, jax.Array]]:
     """host_batches → sharded device arrays (no prefetch; see prefetch.py)."""
+    nshards = num_data_shards(mesh)
     for hb in host_batches(
-        dataset, batch_size, num_shards=num_data_shards(mesh), drop_remainder=drop_remainder
+        dataset, batch_size, num_shards=nshards, drop_remainder=drop_remainder,
+        shard_range=process_shard_range(nshards),
     ):
         yield put_global(hb, mesh)

@@ -1,0 +1,256 @@
+"""Elastic supervisor — gang launch, failure detection, restart-from-checkpoint.
+
+Spark's headline fault-tolerance story is task-level: a lost executor's
+partitions are recomputed from lineage and the job keeps going (SURVEY.md §5
+'Failure detection'). Gang-scheduled SPMD has no per-task retry — one lost
+process stalls every collective — so the TPU-native equivalent is job-level
+elasticity:
+
+1. train with frequent async checkpoints (:mod:`.checkpoint`),
+2. detect a dead/failed worker (process exit, or a missed heartbeat when a
+   hang never surfaces as an exit),
+3. tear the gang down and relaunch it; workers resume from the latest
+   checkpoint (their driver scripts call ``Trainer.restore``).
+
+The supervisor is deliberately dumb — launch, watch, kill, relaunch — because
+all actual state lives in checkpoints. Workers rendezvous through
+``jax.distributed`` using the ``DLS_*`` env contract that
+:class:`~.session.Session` already auto-consumes (session.py
+``_create_session``): ``DLS_COORDINATOR``, ``DLS_NUM_PROCESSES``,
+``DLS_PROCESS_ID``. The supervisor additionally exports ``DLS_RESTART`` (the
+attempt ordinal) so tests can inject faults on attempt 0 only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.supervisor")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class Attempt:
+    """Outcome of one gang launch."""
+
+    ordinal: int
+    returncodes: list[int]
+    duration_s: float
+
+    @property
+    def ok(self) -> bool:
+        return all(rc == 0 for rc in self.returncodes)
+
+
+@dataclasses.dataclass
+class SupervisorResult:
+    attempts: list[Attempt]
+
+    @property
+    def ok(self) -> bool:
+        return bool(self.attempts) and self.attempts[-1].ok
+
+    @property
+    def restarts(self) -> int:
+        return max(0, len(self.attempts) - 1)
+
+
+class Supervisor:
+    """Launch ``num_processes`` copies of a worker command as one gang.
+
+    ``argv`` is the worker command (e.g. ``[sys.executable, "train.py",
+    "--resume"]``); every process gets the rendezvous env plus its own
+    ``DLS_PROCESS_ID``. Any non-zero exit (or death by signal) fails the whole
+    attempt: survivors are terminated and, up to ``max_restarts`` times, the
+    gang is relaunched on a fresh coordinator port.
+
+    ``poll_interval`` bounds failure-detection latency; ``hang_timeout_s``
+    (optional) additionally fails an attempt whose processes are all alive but
+    have produced no progress for too long — the hang case NCCL users know as
+    the silent stuck all-reduce. Progress is observed as mtime changes under
+    ``progress_path`` (typically the checkpoint dir), the same signal a human
+    operator would watch.
+    """
+
+    def __init__(
+        self,
+        argv: list[str],
+        *,
+        num_processes: int = 1,
+        max_restarts: int = 3,
+        env: dict[str, str] | None = None,
+        poll_interval: float = 0.2,
+        restart_backoff_s: float = 0.5,
+        hang_timeout_s: float | None = None,
+        progress_path: str | None = None,
+    ):
+        self.argv = list(argv)
+        self.num_processes = num_processes
+        self.max_restarts = max_restarts
+        self.env = dict(env or {})
+        self.poll_interval = poll_interval
+        self.restart_backoff_s = restart_backoff_s
+        self.hang_timeout_s = hang_timeout_s
+        self.progress_path = progress_path
+
+    # -- one gang ------------------------------------------------------------
+
+    def _launch(self, ordinal: int) -> list[subprocess.Popen]:
+        port = free_port()
+        procs = []
+        for pid in range(self.num_processes):
+            env = {
+                **os.environ,
+                **self.env,
+                "DLS_COORDINATOR": f"localhost:{port}",
+                "DLS_NUM_PROCESSES": str(self.num_processes),
+                "DLS_PROCESS_ID": str(pid),
+                "DLS_RESTART": str(ordinal),
+            }
+            procs.append(subprocess.Popen(self.argv, env=env))
+        logger.info(
+            "attempt %d: launched %d worker(s) (coordinator :%d)",
+            ordinal, self.num_processes, port,
+        )
+        return procs
+
+    def _progress_stamp(self) -> float:
+        """Newest mtime among progress_path and its immediate children.
+
+        Deliberately shallow: an orbax step dir appears by atomic rename at
+        finalize (bumping the parent and step-dir mtimes), so one level is
+        enough — recursing into thousands of tensorstore chunk files every
+        poll would hammer the filesystem.
+        """
+        if not self.progress_path or not os.path.exists(self.progress_path):
+            return 0.0
+        latest = 0.0
+        try:
+            with os.scandir(self.progress_path) as it:
+                latest = os.stat(self.progress_path).st_mtime
+                for entry in it:
+                    try:
+                        latest = max(latest, entry.stat().st_mtime)
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return latest
+
+    def _run_attempt(self, ordinal: int) -> Attempt:
+        t0 = time.monotonic()
+        procs = self._launch(ordinal)
+        last_progress = time.monotonic()
+        stamp = self._progress_stamp()
+        try:
+            while True:
+                codes = [p.poll() for p in procs]
+                if all(c is not None for c in codes):
+                    return Attempt(ordinal, [int(c) for c in codes], time.monotonic() - t0)
+                if any(c is not None and c != 0 for c in codes):
+                    failed = [i for i, c in enumerate(codes) if c not in (None, 0)]
+                    logger.warning(
+                        "attempt %d: worker(s) %s failed (codes %s); killing gang",
+                        ordinal, failed, [codes[i] for i in failed],
+                    )
+                    self._kill(procs)
+                    codes = [p.wait() for p in procs]
+                    return Attempt(ordinal, [int(c) for c in codes], time.monotonic() - t0)
+                if self.hang_timeout_s is not None:
+                    now_stamp = self._progress_stamp()
+                    if now_stamp > stamp:
+                        stamp, last_progress = now_stamp, time.monotonic()
+                    elif time.monotonic() - last_progress > self.hang_timeout_s:
+                        logger.warning(
+                            "attempt %d: no progress for %.1fs; killing hung gang",
+                            ordinal, self.hang_timeout_s,
+                        )
+                        self._kill(procs)
+                        codes = [p.wait() for p in procs]
+                        return Attempt(ordinal, [int(c) for c in codes], time.monotonic() - t0)
+                time.sleep(self.poll_interval)
+        except BaseException:
+            self._kill(procs)
+            raise
+
+    @staticmethod
+    def _kill(procs: list[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    # -- the restart loop ----------------------------------------------------
+
+    def run(self) -> SupervisorResult:
+        attempts: list[Attempt] = []
+        for ordinal in range(self.max_restarts + 1):
+            attempt = self._run_attempt(ordinal)
+            attempts.append(attempt)
+            if attempt.ok:
+                logger.info(
+                    "attempt %d succeeded after %.1fs (%d restart(s) total)",
+                    ordinal, attempt.duration_s, ordinal,
+                )
+                return SupervisorResult(attempts)
+            if ordinal < self.max_restarts:
+                logger.warning(
+                    "attempt %d failed (codes %s); restarting from latest checkpoint",
+                    ordinal, attempt.returncodes,
+                )
+                time.sleep(self.restart_backoff_s)
+        logger.error("giving up after %d attempt(s)", len(attempts))
+        return SupervisorResult(attempts)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``dlsupervise -n N [--max-restarts K] -- worker_cmd ...``"""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="dlsupervise",
+        description="Gang-launch a training command with restart-from-checkpoint.",
+    )
+    p.add_argument("-n", "--num-processes", type=int, default=1)
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--hang-timeout", type=float, default=None)
+    p.add_argument("--progress-path", default=None,
+                   help="dir watched for mtime progress (checkpoint dir)")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="worker command (prefix with --)")
+    args = p.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        p.error("missing worker command")
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    result = Supervisor(
+        cmd,
+        num_processes=args.num_processes,
+        max_restarts=args.max_restarts,
+        hang_timeout_s=args.hang_timeout,
+        progress_path=args.progress_path,
+    ).run()
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

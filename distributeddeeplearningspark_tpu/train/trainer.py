@@ -14,7 +14,12 @@ from typing import Any, Callable, Sequence
 import jax
 import optax
 
-from distributeddeeplearningspark_tpu.data.feed import host_batches, put_global, stack_examples
+from distributeddeeplearningspark_tpu.data.feed import (
+    host_batches,
+    process_shard_range,
+    put_global,
+    stack_examples,
+)
 from distributeddeeplearningspark_tpu.data.prefetch import prefetch_to_device
 from distributeddeeplearningspark_tpu.metrics import (
     Meter,
@@ -27,6 +32,7 @@ from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
 from distributeddeeplearningspark_tpu.session import Session
 from distributeddeeplearningspark_tpu.train import step as step_lib
 from distributeddeeplearningspark_tpu.train.state import TrainState
+from distributeddeeplearningspark_tpu.utils import sanitize
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.trainer")
 
@@ -159,7 +165,11 @@ class Trainer:
         return self.state, data_state
 
     def _feed(self, dataset: PartitionedDataset, batch_size: int, *, skip_batches: int = 0):
-        hb = host_batches(dataset, batch_size, num_shards=num_data_shards(self.mesh))
+        nshards = num_data_shards(self.mesh)
+        # Multi-process: each host stacks only its own devices' rows (its
+        # "executor partitions"); put_global assembles the global batch.
+        hb = host_batches(dataset, batch_size, num_shards=nshards,
+                          shard_range=process_shard_range(nshards))
         if skip_batches:
             # Resume fast-forward: burn host batches (no device transfer) so a
             # deterministic pipeline continues from where the checkpoint left
@@ -185,6 +195,7 @@ class Trainer:
         eval_every: int | None = None,
         callbacks: Sequence[Callable[[int, dict], None]] = (),
         data_state: dict | None = None,
+        sanitize_every: int | None = None,
     ) -> tuple[TrainState, dict[str, float]]:
         """Train until ``steps`` (or dataset exhaustion × ``epochs``).
 
@@ -224,6 +235,9 @@ class Trainer:
                 last_metrics = meter.lap(step_i - lap_start, jax.device_get(metrics))
                 lap_start = step_i
                 mlog.log(step_i, {**last_metrics, **meter.summary()})
+                sanitize.assert_all_finite(last_metrics, step=step_i)
+            if sanitize_every and step_i % sanitize_every == 0:
+                sanitize.assert_replicas_in_sync(self.state.params)
             for cb in callbacks:
                 cb(step_i, last_metrics)
             if checkpoint_every and self.checkpointer and step_i % checkpoint_every == 0:

@@ -57,3 +57,34 @@ def test_cli_parser_conf_mapping():
     assert args.num_executors == 4
     assert args.script == "script.py"
     assert args.script_args == ["--steps", "5"]
+
+
+def test_shard_range_termination_agrees_on_uneven_shards():
+    """Hosts with uneven shard sizes must stop after the SAME batch count, or
+    the longer host hangs in the next collective (multi-process contract)."""
+    from distributeddeeplearningspark_tpu.data.feed import host_batches
+
+    # partitions of 50 and 46 rows → shard 0 longer than shard 1
+    examples = [{"x": np.float32(i)} for i in range(96)]
+    ds = PartitionedDataset.from_generators([
+        lambda: examples[:50], lambda: examples[50:],
+    ])
+    counts = {}
+    for lo, hi in [(0, 1), (1, 2)]:
+        batches = list(host_batches(ds, 32, num_shards=2, shard_range=(lo, hi)))
+        counts[(lo, hi)] = len(batches)
+        assert all(b["x"].shape == (16,) for b in batches)  # local rows only
+    assert counts[(0, 1)] == counts[(1, 2)] == 2  # min(50,46)//16
+
+
+def test_shard_range_rows_are_disjoint_and_ordered():
+    from distributeddeeplearningspark_tpu.data.feed import host_batches
+
+    examples = [{"x": np.float32(i)} for i in range(64)]
+    ds = PartitionedDataset.parallelize(examples, 4)
+    full = list(host_batches(ds, 16, num_shards=2))
+    left = list(host_batches(ds, 16, num_shards=2, shard_range=(0, 1)))
+    right = list(host_batches(ds, 16, num_shards=2, shard_range=(1, 2)))
+    assert len(full) == len(left) == len(right)
+    for f, l, r in zip(full, left, right):
+        np.testing.assert_array_equal(f["x"], np.concatenate([l["x"], r["x"]]))

@@ -1,0 +1,118 @@
+"""Multi-process test worker, launched by the Supervisor under jax.distributed.
+
+Modes:
+- ``train``:  MNIST-shaped training with checkpoint/resume; with
+  ``--fault-step K``, process 1 SIGKILLs itself at step K on attempt 0 only
+  (DLS_RESTART=0) — the fault-injection path of SURVEY.md §4.
+- ``desync``: constructs an intentionally desynced replicated array and
+  asserts the sanitizer catches it (and passes on a synced one).
+"""
+
+import argparse
+import os
+import signal
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build_session():
+    from distributeddeeplearningspark_tpu import Session
+
+    # DLS_COORDINATOR/DLS_NUM_PROCESSES/DLS_PROCESS_ID come from the
+    # supervisor; Session auto-runs jax.distributed.initialize from them.
+    return Session.builder.master("auto").appName("worker").getOrCreate()
+
+
+def mode_train(args) -> int:
+    import optax
+
+    from distributeddeeplearningspark_tpu import Checkpointer, PartitionedDataset, Trainer
+    from distributeddeeplearningspark_tpu.models import LeNet5
+    from distributeddeeplearningspark_tpu.train import losses
+
+    spark = build_session()
+    rng = np.random.default_rng(0)
+    examples = [
+        {"image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+         "label": np.int32(i % 10)}
+        for i in range(256)
+    ]
+    ds = PartitionedDataset.parallelize(examples, spark.default_parallelism).repeat()
+
+    ckpt = Checkpointer(args.ckpt_dir)
+    trainer = Trainer(spark, LeNet5(), losses.softmax_xent,
+                      optax.sgd(0.05, momentum=0.9), checkpointer=ckpt, seed=5)
+
+    data_state = None
+    if ckpt.latest_step() is not None:
+        trainer.init(trainer._sample_batch(ds, args.batch_size))
+        _, data_state = trainer.restore()
+
+    attempt = int(os.environ.get("DLS_RESTART", "0"))
+    fault_cbs = []
+    if args.fault_step and attempt == 0 and jax.process_index() == 1:
+        def die(step, _metrics):
+            if step >= args.fault_step:
+                os.kill(os.getpid(), signal.SIGKILL)
+        fault_cbs.append(die)
+
+    state, _ = trainer.fit(
+        ds, batch_size=args.batch_size, steps=args.steps, log_every=5,
+        checkpoint_every=args.checkpoint_every, data_state=data_state,
+        sanitize_every=5, callbacks=fault_cbs,
+    )
+    ckpt.wait()
+    final_step = int(jax.device_get(state.step))
+    if jax.process_index() == 0:
+        with open(os.path.join(args.ckpt_dir, "DONE"), "w") as f:
+            f.write(f"{final_step} {attempt}\n")
+    return 0 if final_step >= args.steps else 4
+
+
+def mode_desync(args) -> int:
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributeddeeplearningspark_tpu.utils.sanitize import (
+        DesyncError,
+        assert_replicas_in_sync,
+    )
+
+    spark = build_session()
+    mesh = spark.mesh
+    rep = NamedSharding(mesh, P())
+    ones = np.ones((16,), np.float32)
+
+    synced = jax.make_array_from_process_local_data(rep, ones)
+    assert_replicas_in_sync({"w": synced})  # must pass
+
+    skewed = jax.make_array_from_process_local_data(
+        rep, ones * (1.0 + 0.25 * jax.process_index())
+    )
+    try:
+        assert_replicas_in_sync({"w": skewed})
+    except DesyncError:
+        return 0
+    return 3  # sanitizer missed the desync
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("mode", choices=["train", "desync"])
+    p.add_argument("--ckpt-dir", default="/tmp/worker_ck")
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--checkpoint-every", type=int, default=10)
+    p.add_argument("--fault-step", type=int, default=0)
+    args = p.parse_args()
+    return mode_train(args) if args.mode == "train" else mode_desync(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
