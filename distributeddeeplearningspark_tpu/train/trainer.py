@@ -8,6 +8,7 @@ feeding prefetched sharded batches, periodic metrics, and checkpoint hooks.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from typing import Any, Callable, Sequence
 
@@ -32,7 +33,7 @@ from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
 from distributeddeeplearningspark_tpu.session import Session
 from distributeddeeplearningspark_tpu.train import step as step_lib
 from distributeddeeplearningspark_tpu.train.state import TrainState
-from distributeddeeplearningspark_tpu.utils import sanitize
+from distributeddeeplearningspark_tpu.utils import profiling, sanitize
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.trainer")
 
@@ -196,6 +197,9 @@ class Trainer:
         callbacks: Sequence[Callable[[int, dict], None]] = (),
         data_state: dict | None = None,
         sanitize_every: int | None = None,
+        profile: "profiling.ProfileSpec | None" = None,
+        measure_flops: bool = False,
+        tensorboard_dir: str | None = None,
     ) -> tuple[TrainState, dict[str, float]]:
         """Train until ``steps`` (or dataset exhaustion × ``epochs``).
 
@@ -215,40 +219,59 @@ class Trainer:
             tokens_per_step=batch_size * tokens_per_example,
             num_chips=self.mesh.devices.size,
         )
-        mlog = MetricLogger(log_every=log_every)
+        mlog = MetricLogger(log_every=log_every, tensorboard_dir=tensorboard_dir)
+        step_i = int(jax.device_get(self.state.step))
+        # trace window is relative to THIS loop's first step, and stop must
+        # sync on the live state or async dispatch truncates the capture
+        profiler = profiling.StepProfiler(
+            profile, start_offset=step_i,
+            sync=lambda: jax.block_until_ready(self.state.params),
+        )
+        flops_pending = measure_flops
         meter.start()
 
-        step_i = int(jax.device_get(self.state.step))
         lap_start = step_i
         last_metrics: dict[str, float] = {}
         skip = 0
         if data_state and data_state.get("examples_seen"):
             skip = int(data_state["examples_seen"]) // batch_size
-        for batch in self._feed(dataset, batch_size, skip_batches=skip):
-            if steps is not None and step_i >= steps:
-                break
-            self.state, metrics = self._train_step(self.state, batch)
-            step_i += 1
-            if step_i % log_every == 0 or (steps is not None and step_i >= steps):
-                # device_get blocks until this step's metrics exist, so the
-                # lap boundary is a true device-sync point — timing is honest.
-                last_metrics = meter.lap(step_i - lap_start, jax.device_get(metrics))
-                lap_start = step_i
-                mlog.log(step_i, {**last_metrics, **meter.summary()})
-                sanitize.assert_all_finite(last_metrics, step=step_i)
-            if sanitize_every and step_i % sanitize_every == 0:
-                sanitize.assert_replicas_in_sync(self.state.params)
-            for cb in callbacks:
-                cb(step_i, last_metrics)
-            if checkpoint_every and self.checkpointer and step_i % checkpoint_every == 0:
-                self.checkpointer.save(
-                    step_i, self.state,
-                    data_state={"examples_seen": step_i * batch_size,
-                                "batch_size": batch_size},
-                )
-            if eval_every and eval_dataset is not None and step_i % eval_every == 0:
-                emetrics = self.evaluate(eval_dataset, batch_size=batch_size)
-                mlog.log(step_i, {f"eval_{k}": v for k, v in emetrics.items()})
+        try:
+            for batch in self._feed(dataset, batch_size, skip_batches=skip):
+                if steps is not None and step_i >= steps:
+                    break
+                if flops_pending:
+                    meter.set_flops(self.compiled_cost(batch))
+                    flops_pending = False
+                profiler.observe(step_i)
+                with profiling.step_annotation(step_i) if profile is not None \
+                        else contextlib.nullcontext():
+                    self.state, metrics = self._train_step(self.state, batch)
+                step_i += 1
+                if step_i % log_every == 0 or (steps is not None and step_i >= steps):
+                    # device_get blocks until this step's metrics exist, so the
+                    # lap boundary is a true device-sync point — timing is honest.
+                    last_metrics = meter.lap(step_i - lap_start, jax.device_get(metrics))
+                    lap_start = step_i
+                    mlog.log(step_i, {**last_metrics, **meter.summary()})
+                    sanitize.assert_all_finite(last_metrics, step=step_i)
+                if sanitize_every and step_i % sanitize_every == 0:
+                    sanitize.assert_replicas_in_sync(self.state.params)
+                for cb in callbacks:
+                    cb(step_i, last_metrics)
+                if checkpoint_every and self.checkpointer and step_i % checkpoint_every == 0:
+                    self.checkpointer.save(
+                        step_i, self.state,
+                        data_state={"examples_seen": step_i * batch_size,
+                                    "batch_size": batch_size},
+                    )
+                if eval_every and eval_dataset is not None and step_i % eval_every == 0:
+                    emetrics = self.evaluate(eval_dataset, batch_size=batch_size)
+                    mlog.log(step_i, {f"eval_{k}": v for k, v in emetrics.items()})
+        finally:
+            # flush the trace and tensorboard even when a step/sanitizer blows
+            # up mid-window — a crashed run's trace is the one you want most
+            profiler.stop()
+            mlog.close()
 
         jax.block_until_ready(self.state.params)
         summary = {**meter.summary(), **last_metrics}
@@ -259,7 +282,6 @@ class Trainer:
                             "batch_size": batch_size},
             )
             self.checkpointer.wait()
-        mlog.close()
         return self.state, summary
 
     def evaluate(self, dataset: PartitionedDataset, *, batch_size: int) -> dict[str, float]:

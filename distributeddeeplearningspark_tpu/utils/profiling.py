@@ -1,0 +1,129 @@
+"""Tracing/profiling — the rebuild of the reference's Spark-UI/torch-profiler.
+
+The reference's observability is Spark stage timelines plus (optionally) the
+torch profiler inside the mapPartitions closure (SURVEY.md §5
+'Tracing/profiling'). TPU-first, the device timeline lives in XLA/PJRT, so the
+native story is:
+
+- ``jax.profiler`` traces (host Python + device HLO timeline) written in
+  TensorBoard 'profile' plugin format — ``ProfileSpec`` captures a window of
+  steps mid-training from the Trainer without stopping the job;
+- ``annotate(name)`` TraceAnnotations to label host phases (input pipeline,
+  checkpoint, eval) so they're attributable in the trace viewer;
+- XLA HLO dumps (``enable_xla_dump``) for compiler-level inspection of what
+  GSPMD did to the step function — set BEFORE the first compile.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import glob
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.profiling")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfileSpec:
+    """Capture ``num_steps`` steps starting at ``start_step`` into ``dir``.
+
+    ``start_step`` defaults past warmup so the window sees steady-state steps,
+    not the first compile.
+    """
+
+    dir: str
+    start_step: int = 10
+    num_steps: int = 5
+
+
+class StepProfiler:
+    """Drives a jax.profiler trace window across a training loop.
+
+    Call ``observe(step)`` once per loop iteration; the profiler starts and
+    stops itself around the configured window. Trace capture is process-local;
+    on a pod every host writes its own trace (process 0's is the one usually
+    inspected).
+    """
+
+    def __init__(self, spec: ProfileSpec | None, *, start_offset: int = 0,
+                 sync=None):
+        """``start_offset`` shifts the window to be relative to the loop's
+        first step (a job resumed at step 1000 with start_step=10 traces
+        steps 1010+, not the post-restore recompile). ``sync`` is a zero-arg
+        callable that blocks until the dispatched steps' device work is done —
+        REQUIRED for a faithful trace under async dispatch; the Trainer passes
+        one that blocks on the live train state."""
+        self.spec = spec
+        self.start_offset = start_offset
+        self._sync = sync
+        self._active = False
+        self._done = spec is None
+
+    def observe(self, step: int) -> None:
+        if self._done:
+            return
+        assert self.spec is not None
+        if not self._active and step >= self.spec.start_step + self.start_offset:
+            os.makedirs(self.spec.dir, exist_ok=True)
+            jax.profiler.start_trace(self.spec.dir)
+            self._active = True
+            self._stop_at = step + self.spec.num_steps
+            logger.info("profiler: tracing steps %d..%d → %s",
+                        step, self._stop_at, self.spec.dir)
+        elif self._active and step >= self._stop_at:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._active:
+            # block on the real step outputs so the trace includes the
+            # windowed steps' device work (async dispatch runs ahead)
+            if self._sync is not None:
+                self._sync()
+            jax.profiler.stop_trace()
+            self._active = False
+            logger.info("profiler: trace written to %s", self.spec.dir)
+        self._done = True
+
+
+def annotate(name: str):
+    """Label a host-side phase in the trace (input prep, checkpoint, eval)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_annotation(step: int):
+    """Mark one train step so the profile tool computes per-step stats."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+def enable_xla_dump(dump_dir: str) -> None:
+    """Route XLA HLO dumps (post-GSPMD, post-fusion) to ``dump_dir``.
+
+    Must run before the first jit compilation; appends to XLA_FLAGS so it
+    composes with the fake-device flag used in tests.
+    """
+    os.makedirs(dump_dir, exist_ok=True)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_dump_to" not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} --xla_dump_to={dump_dir}".strip()
+
+
+def trace_files(profile_dir: str) -> list[str]:
+    """The .xplane.pb trace files a capture produced (for tooling/tests)."""
+    return sorted(
+        glob.glob(os.path.join(profile_dir, "**", "*.xplane.pb"), recursive=True)
+    )
+
+
+@contextlib.contextmanager
+def trace(profile_dir: str):
+    """Context-manager capture: everything inside the block is traced."""
+    os.makedirs(profile_dir, exist_ok=True)
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -27,6 +27,11 @@ def main() -> None:
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace window into this dir")
+    p.add_argument("--tensorboard-dir", default=None)
+    p.add_argument("--mfu", action="store_true",
+                   help="report achieved MFU (costs one extra compile)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -49,8 +54,14 @@ def main() -> None:
         spark, model, losses.softmax_xent,
         optim.sgd(schedule, momentum=0.9, weight_decay=1e-4),
     )
+    profile = None
+    if args.profile_dir:
+        from distributeddeeplearningspark_tpu.utils.profiling import ProfileSpec
+
+        profile = ProfileSpec(args.profile_dir, start_step=min(10, args.steps // 2))
     state, summary = trainer.fit(
-        ds.repeat(), batch_size=args.batch_size, steps=args.steps, log_every=10
+        ds.repeat(), batch_size=args.batch_size, steps=args.steps, log_every=10,
+        profile=profile, measure_flops=args.mfu, tensorboard_dir=args.tensorboard_dir,
     )
     print(f"train summary: {summary}")
     spark.stop()
