@@ -144,20 +144,30 @@ def host_batches(
             yield stack_examples(chunk)
 
 
-def put_global(batch: dict[str, np.ndarray], mesh: Mesh) -> dict[str, jax.Array]:
+def put_global(
+    batch: dict[str, np.ndarray], mesh: Mesh, *, seq_sharded: bool = False
+) -> dict[str, jax.Array]:
     """Place a host batch onto the mesh with batch sharding.
 
     Single-process: a plain sharded ``device_put`` (XLA slices per device).
     Multi-process: each process passes its *local* rows and JAX assembles the
     global array — the moral replacement for "each executor reads its own
     partition" with zero driver round-trip.
+
+    ``seq_sharded`` (context parallelism): rank≥2 leaves additionally split
+    dim 1 over the ``seq`` mesh axis; rank-1 leaves stay batch-only.
     """
-    sharding = NamedSharding(mesh, P(BATCH_AXES))
+    from distributeddeeplearningspark_tpu.parallel.mesh import batch_sharding
+
+    def sharding_for(v) -> NamedSharding:
+        return batch_sharding(mesh, np.ndim(v), seq_sharded=seq_sharded)
+
     if jax.process_count() > 1:
         return {
-            k: jax.make_array_from_process_local_data(sharding, v) for k, v in batch.items()
+            k: jax.make_array_from_process_local_data(sharding_for(v), v)
+            for k, v in batch.items()
         }
-    return jax.device_put(batch, sharding)
+    return {k: jax.device_put(v, sharding_for(v)) for k, v in batch.items()}
 
 
 def device_batches(

@@ -7,6 +7,8 @@ Models call :func:`dot_product_attention`; the implementation is chosen by
   chain into the matmuls well enough for short sequences (BERT's 512).
 - ``"flash"`` — Pallas blockwise flash attention (O(seq) memory, HBM-tiled);
   the long-sequence hot op (see :mod:`.flash_attention`).
+- ``"ring"`` — context-parallel exact attention over the mesh ``seq`` axis
+  (see :mod:`.ring_attention`); use when sequences are sharded across chips.
 - ``"auto"`` — flash on TPU when the shape qualifies (seq multiple of block,
   head_dim multiple of 128), else xla.
 
@@ -43,6 +45,10 @@ def dot_product_attention(
         from distributeddeeplearningspark_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=scale)
+    if impl == "ring":
+        from distributeddeeplearningspark_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=scale)
     return _xla_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=scale)
 
 

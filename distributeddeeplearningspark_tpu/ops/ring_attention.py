@@ -1,0 +1,182 @@
+"""Ring attention — context parallelism over the mesh ``seq`` axis.
+
+The reference has no sequence parallelism (BERT-512/Llama-4096 fit one GPU;
+SURVEY.md §2 marks SP/CP "unknown — unlikely"), but long-context is first-class
+in this rebuild, so the ``seq`` mesh axis reserved in :mod:`..parallel.mesh`
+gets a real implementation: blockwise ring attention (Liu et al., "Ring
+Attention with Blockwise Transformers", arXiv:2310.01889 — PAPERS.md).
+
+Design (TPU-first):
+
+- Sequences are sharded over ``seq``: each chip holds Q/K/V blocks of
+  ``S/seq_degree`` positions (BSHD layout, so batch stays on (data, fsdp) and
+  heads on ``tensor`` — CP composes with DP/FSDP/TP).
+- Inside :func:`jax.shard_map`, K/V blocks rotate around the ring via
+  ``lax.ppermute`` (neighbor exchange rides the ICI torus; each hop overlaps
+  with the local block's attention compute in XLA's schedule).
+- The softmax is accumulated *online* (flash-style running max/denominator in
+  f32), so no chip ever materializes the full [S, S] score matrix — memory is
+  O(S/seq_degree) per chip and exact (not approximate) attention.
+- Causal masking is positional: block ``j`` of K/V against local Q block
+  ``i`` is fully attended when ``j < i``, diagonal-masked when ``j == i``,
+  and contributes zero when ``j > i`` (computed-and-masked; SPMD lockstep
+  means skipping would not save wall-clock on the critical path).
+
+``mask=None`` only: padding is expected to be handled by loss masking in CP
+training (documented limitation; the reference's own BERT pads to fixed 512
+and masks in the loss the same way).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributeddeeplearningspark_tpu.parallel.mesh import (
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    BATCH_AXES,
+)
+
+_NEG_INF = jnp.float32(-1e30)
+
+# Fallback mesh for calls that originate inside a model (which has no mesh
+# handle): models call dot_product_attention(impl="ring") → ring_attention
+# with mesh=None. Resolution order: explicit arg > active Session >
+# set_default_mesh. The mesh is a trace-time constant, so a module global is
+# safe under jit (it is read while tracing, not while executing).
+_default_mesh: Mesh | None = None
+
+
+def set_default_mesh(mesh: Mesh | None) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def _ring_attention_local(
+    q: jax.Array,  # [B, Sq_local, H, D] — this chip's query block
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool,
+    scale: float,
+) -> jax.Array:
+    """Runs per-shard inside shard_map; rotates K/V blocks around the ring."""
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qf = q.astype(jnp.float32) * jnp.float32(scale)
+
+    # receive from right neighbor: after i hops this chip holds block my+i
+    perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
+
+    def accumulate(acc, i, k_cur, v_cur):
+        """Online-softmax update of (o, l, m) with K/V block (my_idx+i)."""
+        o, l, m = acc
+        blk = (my_idx + i) % axis_size
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = my_idx * sq + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+            k_pos = blk * sk + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+            allowed = q_pos >= k_pos
+            logits = jnp.where(allowed, logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))          # [B, H, Sq]
+        p = jnp.exp(logits - m_new[..., None])               # [B, H, Sq, Sk]
+        if causal:
+            p = jnp.where(allowed, p, 0.0)
+        corr = jnp.exp(m - m_new)                            # [B, H, Sq]
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv  # [B, Sq, H, D]
+        return o_new, l_new, m_new
+
+    def block(carry, i):
+        o, l, m, k_cur, v_cur = carry
+        acc = accumulate((o, l, m), i, k_cur, v_cur)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (*acc, k_nxt, v_nxt), None
+
+    init_acc = (
+        jnp.zeros((b, sq, h, d), jnp.float32),
+        jnp.zeros((b, h, sq), jnp.float32),
+        jnp.full((b, h, sq), _NEG_INF),
+    )
+    if axis_size > 1:
+        # scan the first N-1 blocks (each ends with the neighbor exchange)...
+        carry, _ = lax.scan(block, (*init_acc, k, v), jnp.arange(axis_size - 1))
+        o, l, m, k_last, v_last = carry
+        # ...and fold in the final block WITHOUT the (discarded) last rotation
+        o, l, _ = accumulate((o, l, m), axis_size - 1, k_last, v_last)
+    else:
+        o, l, _ = accumulate(init_acc, 0, k, v)
+    # causal ⇒ every query attends at least to itself ⇒ l > 0
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh | None = None,
+    causal: bool = True,
+    scale: float | None = None,
+    mask: Any = None,
+    bias: Any = None,
+) -> jax.Array:
+    """Exact attention over sequence-sharded BSHD tensors (global view).
+
+    Call from inside a jitted step with GLOBAL (logically unsharded) arrays;
+    the shard_map below splits them [batch→(data,fsdp), seq→seq,
+    heads→tensor] and runs the ring exchange. With ``seq`` degree 1 this
+    degenerates to one local block — same math, no collectives — so models
+    can use ``impl="ring"`` unconditionally.
+
+    ``mesh=None`` resolves to the active :class:`~...session.Session`'s mesh.
+    """
+    if mask is not None or bias is not None:
+        raise NotImplementedError(
+            "ring attention handles padding via loss masking; per-position "
+            "mask/bias tensors are not supported (use impl='xla')"
+        )
+    if mesh is None:
+        from distributeddeeplearningspark_tpu.session import Session
+
+        if Session._active is not None and not Session._active._stopped:
+            mesh = Session._active.mesh
+        elif _default_mesh is not None:
+            mesh = _default_mesh
+        else:
+            raise RuntimeError(
+                "ring_attention needs a mesh: pass mesh=, create a Session, "
+                "or call ops.ring_attention.set_default_mesh(mesh)"
+            )
+    if q.shape != k.shape or k.shape != v.shape:
+        raise ValueError(
+            f"ring attention requires equal q/k/v shapes (repeat GQA KV heads "
+            f"first): {q.shape} vs {k.shape} vs {v.shape}"
+        )
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    spec = P(BATCH_AXES, AXIS_SEQ, AXIS_TENSOR, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=AXIS_SEQ, causal=causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

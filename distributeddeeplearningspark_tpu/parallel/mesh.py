@@ -105,12 +105,13 @@ def batch_spec(mesh: Mesh, *, extra_rank: int = 0, seq_sharded: bool = False) ->
     """PartitionSpec for an input batch: leading axis over (data, fsdp).
 
     With ``seq_sharded=True`` the second axis (sequence) is split over the
-    ``seq`` mesh axis — the context-parallel input layout.
+    ``seq`` mesh axis — the context-parallel input layout. Rank-1 leaves
+    (per-example labels/weights) have no sequence dim and stay batch-only.
     """
     del mesh  # uniform axis names make this mesh-independent
     tail: list = [None] * extra_rank
-    if seq_sharded:
-        tail = [AXIS_SEQ] + tail[1:] if extra_rank else [AXIS_SEQ]
+    if seq_sharded and extra_rank:
+        tail[0] = AXIS_SEQ
     return P(BATCH_AXES, *tail)
 
 

@@ -124,22 +124,34 @@ def jit_train_step(
     train_step: Callable,
     mesh: Mesh,
     state_sh: Any,
+    *,
+    seq_sharded: bool = False,
 ) -> Callable:
-    """Compile with explicit in/out shardings and state donation."""
-    batch_sh = NamedSharding(mesh, P(BATCH_AXES))
+    """Compile with explicit state shardings and state donation.
+
+    Batch shardings are inherited from the arrays themselves (``in_shardings
+    = None``): :func:`..data.feed.put_global` is the single source of truth
+    for the input layout — batch rows over (data, fsdp) and, under context
+    parallelism, sequence over ``seq`` for rank≥2 leaves only. Declaring a
+    uniform spec here instead would reject rank-1 leaves (sample weights,
+    labels) that put_global correctly leaves batch-only.
+    """
+    del seq_sharded  # layout carried by the input arrays; kept for API compat
     metric_sh = NamedSharding(mesh, P())
     return jax.jit(
         train_step,
-        in_shardings=(state_sh, batch_sh),
+        in_shardings=(state_sh, None),
         out_shardings=(state_sh, metric_sh),
         donate_argnums=(0,),
     )
 
 
-def jit_eval_step(eval_step: Callable, mesh: Mesh, state_sh: Any) -> Callable:
-    batch_sh = NamedSharding(mesh, P(BATCH_AXES))
+def jit_eval_step(
+    eval_step: Callable, mesh: Mesh, state_sh: Any, *, seq_sharded: bool = False
+) -> Callable:
+    del seq_sharded
     metric_sh = NamedSharding(mesh, P())
-    return jax.jit(eval_step, in_shardings=(state_sh, batch_sh), out_shardings=metric_sh)
+    return jax.jit(eval_step, in_shardings=(state_sh, None), out_shardings=metric_sh)
 
 
 def init_state(

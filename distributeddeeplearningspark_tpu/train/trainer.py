@@ -9,6 +9,7 @@ feeding prefetched sharded batches, periodic metrics, and checkpoint hooks.
 from __future__ import annotations
 
 import contextlib
+import functools
 import logging
 from typing import Any, Callable, Sequence
 
@@ -57,6 +58,7 @@ class Trainer:
         rng_names: Sequence[str] = ("dropout",),
         seed: int = 0,
         checkpointer=None,
+        context_parallel: bool = False,
     ):
         self.session = session or Session.get_or_default()
         self.mesh = self.session.mesh
@@ -68,6 +70,13 @@ class Trainer:
         self.rng_names = tuple(rng_names)
         self.seed = seed
         self.checkpointer = checkpointer
+        # context parallelism: shard batch dim 1 (sequence) over the mesh
+        # `seq` axis; pair with a model whose attention_impl is "ring"
+        self.context_parallel = context_parallel
+        if context_parallel:
+            from distributeddeeplearningspark_tpu.ops import ring_attention
+
+            ring_attention.set_default_mesh(self.mesh)
 
         self.state: TrainState | None = None
         self.state_shardings = None
@@ -87,9 +96,13 @@ class Trainer:
             self.model.apply, self.tx, self.loss_fn,
             mutable_keys=self.mutable_keys, rng_names=self.rng_names,
         )
-        self._train_step = step_lib.jit_train_step(train, self.mesh, self.state_shardings)
+        self._train_step = step_lib.jit_train_step(
+            train, self.mesh, self.state_shardings, seq_sharded=self.context_parallel
+        )
         ev = step_lib.make_eval_step(self.model.apply, self.loss_fn)
-        self._eval_step = step_lib.jit_eval_step(ev, self.mesh, self.state_shardings)
+        self._eval_step = step_lib.jit_eval_step(
+            ev, self.mesh, self.state_shardings, seq_sharded=self.context_parallel
+        )
         logger.info("initialized %s params over mesh %s",
                     f"{self.state.num_params:,}", dict(self.mesh.shape))
         return self.state
@@ -178,7 +191,8 @@ class Trainer:
             import itertools
 
             hb = itertools.islice(hb, skip_batches, None)
-        return prefetch_to_device(hb, self.mesh)
+        put = functools.partial(put_global, seq_sharded=self.context_parallel)
+        return prefetch_to_device(hb, self.mesh, put=put)
 
     # -- training -----------------------------------------------------------
 

@@ -39,6 +39,8 @@ def main() -> None:
     p.add_argument("--lora-alpha", type=float, default=16.0)
     p.add_argument("--fsdp", type=int, default=-1, help="FSDP axis size (-1: all devices)")
     p.add_argument("--tensor", type=int, default=1, help="tensor-parallel axis size")
+    p.add_argument("--seq-parallel", type=int, default=1,
+                   help="context-parallel axis size (ring attention shards the sequence)")
     p.add_argument("--corpus", default=None, help="text file (one doc per line); synthetic if unset")
     p.add_argument("--tokenizer", default=None,
                    help="HF tokenizer dir matching --weights (required with --weights: "
@@ -54,7 +56,8 @@ def main() -> None:
     spark = (
         Session.builder.master(args.master or "auto").appName("llama-lora")
         .config("mesh.data", 1).config("mesh.fsdp", args.fsdp)
-        .config("mesh.tensor", args.tensor).getOrCreate()
+        .config("mesh.tensor", args.tensor).config("mesh.seq", args.seq_parallel)
+        .getOrCreate()
     )
     print(spark)
 
@@ -82,6 +85,10 @@ def main() -> None:
             vocab_size=max(tok.vocab_size, 512),
             lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
         )
+    if args.seq_parallel > 1:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, attention_impl="ring")
     model = LlamaForCausalLM(cfg)
 
     ds = text_lib.lm_dataset(docs, tok, seq_len=args.seq_len).repeat()
@@ -96,7 +103,8 @@ def main() -> None:
         ),
         lora_trainable,
     )
-    trainer = Trainer(spark, model, losses.causal_lm, tx, rules=llama_rules(cfg))
+    trainer = Trainer(spark, model, losses.causal_lm, tx, rules=llama_rules(cfg),
+                      context_parallel=args.seq_parallel > 1)
     trainer.init(trainer._sample_batch(ds, args.batch_size))
     if args.weights:
         trainer.load_pretrained(llama_io.load_llama_safetensors(args.weights, cfg))

@@ -1,0 +1,113 @@
+"""Ring attention (context parallelism) vs dense attention, on a real seq mesh.
+
+Runs on 8 fake CPU devices with nontrivial (data × seq × tensor) meshes so the
+ppermute ring and the batch/head shardings are genuinely exercised.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearningspark_tpu.models import LlamaConfig, LlamaForCausalLM
+from distributeddeeplearningspark_tpu.ops.attention import _xla_attention
+from distributeddeeplearningspark_tpu.ops.ring_attention import ring_attention
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+from distributeddeeplearningspark_tpu.parallel.sharding import ShardingRules
+from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+
+
+def _qkv(b=4, s=32, h=4, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(0, 1, (b, s, h, d)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("spec", [
+    MeshSpec(data=2, seq=4),
+    MeshSpec(data=1, seq=8),
+    MeshSpec(data=2, seq=2, tensor=2),
+])
+def test_ring_matches_dense_causal(spec, eight_devices):
+    mesh = spec.build()
+    q, k, v = _qkv()
+    want = _xla_attention(q, k, v, bias=None, mask=None, causal=True, scale=None)
+    got = jax.jit(lambda a, b_, c: ring_attention(a, b_, c, mesh=mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_matches_dense_non_causal(eight_devices):
+    mesh = MeshSpec(data=2, seq=4).build()
+    q, k, v = _qkv(seed=3)
+    want = _xla_attention(q, k, v, bias=None, mask=None, causal=False, scale=None)
+    got = jax.jit(lambda a, b_, c: ring_attention(a, b_, c, mesh=mesh, causal=False))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gradients_match_dense(eight_devices):
+    mesh = MeshSpec(data=2, seq=4).build()
+    q, k, v = _qkv(b=2, s=16, h=2, d=8, seed=7)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, bias=None, mask=None,
+                                      causal=True, scale=None) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd), atol=1e-4, rtol=1e-4)
+
+
+def test_ring_rejects_mask_and_uneven_shapes(eight_devices):
+    mesh = MeshSpec(data=2, seq=4).build()
+    q, k, v = _qkv()
+    with pytest.raises(NotImplementedError):
+        ring_attention(q, k, v, mesh=mesh, mask=jnp.ones((4, 1, 1, 32), bool))
+    with pytest.raises(ValueError, match="equal q/k/v"):
+        ring_attention(q, k[:, :, :2], v, mesh=mesh)
+
+
+def test_llama_context_parallel_train_step(eight_devices):
+    """Full CP train step: Llama with ring attention over data=2 x seq=4."""
+    mesh = MeshSpec(data=2, seq=4).build()
+    import dataclasses
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), attention_impl="ring",
+                              scan_layers=False, remat=False)
+    from distributeddeeplearningspark_tpu.ops import ring_attention as ring_mod
+
+    ring_mod.set_default_mesh(mesh)
+    model = LlamaForCausalLM(cfg)
+    batch = {
+        "input_ids": np.tile(np.arange(32, dtype=np.int32)[None], (8, 1)) % cfg.vocab_size,
+        "loss_mask": np.ones((8, 32), np.float32),
+    }
+    tx = optax.adamw(1e-3)
+    state, shardings = step_lib.init_state(model, tx, batch, mesh, ShardingRules())
+    train = step_lib.make_train_step(model.apply, tx, losses.causal_lm)
+    jitted = step_lib.jit_train_step(train, mesh, shardings, seq_sharded=True)
+    from distributeddeeplearningspark_tpu.data.feed import put_global
+
+    gbatch = put_global(batch, mesh, seq_sharded=True)
+    assert "seq" in str(gbatch["input_ids"].sharding.spec)
+    state2, metrics = jitted(state, gbatch)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+    # CP loss equals the pure-DP loss on the same batch/params
+    mesh_dp = MeshSpec(data=8).build()
+    cfg_dp = dataclasses.replace(cfg, attention_impl="xla")
+    model_dp = LlamaForCausalLM(cfg_dp)
+    state_dp, sh_dp = step_lib.init_state(model_dp, tx, batch, mesh_dp, ShardingRules())
+    train_dp = step_lib.make_train_step(model_dp.apply, tx, losses.causal_lm)
+    jitted_dp = step_lib.jit_train_step(train_dp, mesh_dp, sh_dp)
+    gbatch_dp = put_global(batch, mesh_dp)
+    _, metrics_dp = jitted_dp(state_dp, gbatch_dp)
+    np.testing.assert_allclose(
+        float(jax.device_get(metrics["loss"])),
+        float(jax.device_get(metrics_dp["loss"])),
+        rtol=1e-4,
+    )
