@@ -53,6 +53,17 @@ def resize_bilinear(image: np.ndarray, size: tuple[int, int]) -> np.ndarray:
     return top * (1 - wy) + bot * wy
 
 
+def _resize(image: np.ndarray, size: tuple[int, int]) -> np.ndarray:
+    """Bilinear resize via the native (C++) kernel when built, numpy otherwise.
+
+    Identical math either way (csrc/dls_native.cc mirrors resize_bilinear);
+    the native path parallelizes across rows and releases the GIL.
+    """
+    from distributeddeeplearningspark_tpu.utils import native
+
+    return native.resize_bilinear(np.asarray(image, np.float32), size)
+
+
 def random_resized_crop(image: np.ndarray, rng: np.random.Generator, size: int = 224,
                         scale: tuple[float, float] = (0.08, 1.0),
                         ratio: tuple[float, float] = (3 / 4, 4 / 3)) -> np.ndarray:
@@ -67,7 +78,7 @@ def random_resized_crop(image: np.ndarray, rng: np.random.Generator, size: int =
         if cw <= w and ch <= h:
             y = int(rng.integers(0, h - ch + 1))
             x = int(rng.integers(0, w - cw + 1))
-            return resize_bilinear(image[y:y + ch, x:x + cw], (size, size))
+            return _resize(image[y:y + ch, x:x + cw], (size, size))
     return center_crop(image, size)  # fallback
 
 
@@ -75,7 +86,7 @@ def center_crop(image: np.ndarray, size: int = 224, resize_shorter: int = 256) -
     """Eval transform: resize shorter side then center crop."""
     h, w = image.shape[:2]
     scale = resize_shorter / min(h, w)
-    image = resize_bilinear(image, (int(round(h * scale)), int(round(w * scale))))
+    image = _resize(image, (int(round(h * scale)), int(round(w * scale))))
     h, w = image.shape[:2]
     y, x = (h - size) // 2, (w - size) // 2
     return image[y:y + size, x:x + size]
@@ -83,6 +94,16 @@ def center_crop(image: np.ndarray, size: int = 224, resize_shorter: int = 256) -
 
 def random_flip(image: np.ndarray, rng: np.random.Generator) -> np.ndarray:
     return image[:, ::-1] if rng.random() < 0.5 else image
+
+
+def _content_seed(img: np.ndarray) -> int:
+    """Process-stable 32-bit content hash (built-in hash() is siphash-salted
+    per process, which would break cross-host augmentation determinism)."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.blake2b(img.tobytes()[:64], digest_size=4).digest(), "little"
+    )
 
 
 def decode_jpeg(path_or_bytes) -> np.ndarray:
@@ -105,28 +126,54 @@ def decode_jpeg(path_or_bytes) -> np.ndarray:
 def train_transform(size: int = 224, seed: int = 0) -> Callable[[dict], dict]:
     """Per-example ImageNet train augmentation: crop + flip + normalize.
 
-    Deterministic per example content hash + seed so multi-host pipelines
-    don't need rng plumbing through partitions.
+    Contract: uint8 input is raw pixels → unit-scaled then standardized with
+    the ImageNet stats; float input is assumed already normalized → geometric
+    ops only. Deterministic per example content hash + seed so multi-host
+    pipelines don't need rng plumbing through partitions.
     """
 
     def apply(example: dict) -> dict:
         img = example["image"]
-        rng = np.random.default_rng(
-            (seed * 2654435761 + (hash(img.tobytes()[:64]) & 0xFFFFFFFF)) & 0xFFFFFFFF
-        )
-        img = random_resized_crop(img, rng, size) if img.shape[0] != size else random_flip(img, rng)
-        img = random_flip(img, rng)
-        return {**example, "image": normalize(img) if img.dtype == np.uint8 else img.astype(np.float32)}
+        rng = np.random.default_rng((seed * 2654435761 + _content_seed(img)) & 0xFFFFFFFF)
+        needs_crop = img.shape[0] != size or img.shape[1] != size
+        if img.dtype == np.uint8:
+            if not needs_crop:
+                # fused flip+normalize in one native pass (numpy fallback)
+                from distributeddeeplearningspark_tpu.utils import native
+
+                img = native.crop_flip_normalize_batch(
+                    img[None], np.zeros(1, np.int32), np.zeros(1, np.int32),
+                    np.array([rng.random() < 0.5], np.uint8), (size, size),
+                    IMAGENET_MEAN, IMAGENET_STD,
+                )[0]
+                return {**example, "image": img}
+            img = random_resized_crop(img.astype(np.float32) / 255.0, rng, size)
+            img = normalize(random_flip(img, rng))
+        else:
+            if needs_crop:
+                img = random_resized_crop(img, rng, size)
+            img = random_flip(img, rng)
+        return {**example, "image": np.ascontiguousarray(img, np.float32)}
 
     return apply
 
 
 def eval_transform(size: int = 224) -> Callable[[dict], dict]:
+    """uint8 → scale+standardize (see train_transform contract); float → crop only."""
+
     def apply(example: dict) -> dict:
         img = example["image"]
-        if img.shape[0] != size or img.shape[1] != size:
+        needs_crop = img.shape[0] != size or img.shape[1] != size
+        if img.dtype == np.uint8:
+            if not needs_crop:
+                from distributeddeeplearningspark_tpu.utils import native
+
+                return {**example, "image": native.normalize_u8_batch(
+                    img[None], IMAGENET_MEAN, IMAGENET_STD)[0]}
+            img = normalize(center_crop(img.astype(np.float32) / 255.0, size))
+        elif needs_crop:
             img = center_crop(img, size)
-        return {**example, "image": normalize(img) if img.dtype == np.uint8 else img.astype(np.float32)}
+        return {**example, "image": np.ascontiguousarray(img, np.float32)}
 
     return apply
 

@@ -122,10 +122,27 @@ def tree_aggregate(
 
 
 def grad_average(partition_grads: Sequence[Any]) -> Any:
-    """Average per-partition gradient pytrees on the driver (parity mode)."""
+    """Average per-partition gradient pytrees on the driver (parity mode).
+
+    float32 numpy leaves accumulate through the native (C++) ``sum_into``
+    kernel — the host equivalent of the reference's driver-side gradient
+    reduction, parallel and GIL-free; other leaves fall back to Python sum.
+    """
+    import numpy as np
+
+    from distributeddeeplearningspark_tpu.utils import native
+
     n = len(partition_grads)
-    summed = jax.tree.map(lambda *xs: sum(xs), *partition_grads)
-    return jax.tree.map(lambda x: x / n, summed)
+
+    def avg(*xs):
+        if all(isinstance(x, np.ndarray) and x.dtype == np.float32 for x in xs):
+            acc = np.ascontiguousarray(xs[0]).copy()
+            for x in xs[1:]:
+                native.sum_into(acc, x)
+            return acc / n
+        return sum(xs) / n
+
+    return jax.tree.map(avg, *partition_grads)
 
 
 # --- desync sanitizer (SURVEY.md §5 race detection) -------------------------

@@ -1,0 +1,193 @@
+"""ctypes loader for the native (C++) host data-plane kernels.
+
+The reference's native layer is CUDA/NCCL linked through torch/Horovod; the
+rebuild's device-side native layer is XLA:TPU itself (SURVEY.md §1 L2). This
+module owns the *host-side* native layer: csrc/dls_native.cc, compiled to a
+shared library and called through ctypes (pybind11 is not in the image; ctypes
+releases the GIL around every call, so these kernels parallelize for real
+under the prefetch thread).
+
+Loading strategy: use a prebuilt ``_dls_native*.so`` next to this package if
+present, else build one on first import with the system ``g++`` (cached under
+``~/.cache/dls_tpu``). Every entry point has a numpy fallback with identical
+semantics — :func:`available` says which path is live, and the test suite
+pins native == numpy bit-for-bit where exactness is defined.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.native")
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc", "dls_native.cc")
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def _build(src: str) -> str | None:
+    """Compile csrc → cached .so keyed by source hash; None if no compiler."""
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "dls_tpu"
+    )
+    out = os.path.join(cache_dir, f"_dls_native_{digest}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(cache_dir, exist_ok=True)
+    # unique per-builder temp name (mkstemp), atomic rename into the cache:
+    # concurrent builders each link their own file and the last rename wins
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", "-o", tmp, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native build failed (%s); using numpy fallbacks", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return out
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.dls_version.restype = ctypes.c_int
+    lib.dls_num_threads.restype = ctypes.c_int
+    lib.dls_crop_flip_normalize_batch.argtypes = [
+        _u8p, ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        _i32p, _i32p, _u8p, ctypes.c_int, ctypes.c_int, _f32p, _f32p, _f32p,
+    ]
+    lib.dls_normalize_u8_batch.argtypes = [
+        _u8p, ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        _f32p, _f32p, _f32p,
+    ]
+    lib.dls_resize_bilinear.argtypes = [
+        _f32p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, _f32p,
+    ]
+    lib.dls_sum_into_f32.argtypes = [_f32p, _f32p, ctypes.c_int64]
+    return lib
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("DLS_DISABLE_NATIVE"):
+        return None
+    try:
+        path = _build(_SRC)
+        if path is not None:
+            _LIB = _bind(ctypes.CDLL(path))
+            logger.info("native kernels loaded (%d threads): %s",
+                        _LIB.dls_num_threads(), path)
+    except Exception as e:  # any load failure → clean numpy fallback
+        logger.warning("native kernels unavailable (%s); using numpy", e)
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# Kernels (native with numpy fallback, identical semantics)
+# ---------------------------------------------------------------------------
+
+def crop_flip_normalize_batch(
+    images: np.ndarray,          # [N, H, W, C] uint8
+    ys: np.ndarray,              # [N] int32 crop origin rows
+    xs: np.ndarray,              # [N] int32 crop origin cols
+    flips: np.ndarray,           # [N] bool/uint8 horizontal flip
+    crop: tuple[int, int],
+    mean: np.ndarray,
+    std: np.ndarray,
+) -> np.ndarray:
+    """Fused random-crop + flip + (x/255 - mean)/std over a batch → float32."""
+    n, h, w, c = images.shape
+    ch, cw = crop
+    images = np.ascontiguousarray(images, np.uint8)
+    ys = np.ascontiguousarray(ys, np.int32)
+    xs = np.ascontiguousarray(xs, np.int32)
+    flips = np.ascontiguousarray(flips, np.uint8)
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    lib = _load()
+    if lib is not None:
+        out = np.empty((n, ch, cw, c), np.float32)
+        lib.dls_crop_flip_normalize_batch(
+            images, n, h, w, c, ys, xs, flips, ch, cw, mean, std, out
+        )
+        return out
+    out = np.empty((n, ch, cw, c), np.float32)
+    for i in range(n):
+        img = images[i, ys[i]:ys[i] + ch, xs[i]:xs[i] + cw]
+        if flips[i]:
+            img = img[:, ::-1]
+        out[i] = (img.astype(np.float32) / 255.0 - mean) / std
+    return out
+
+
+def normalize_u8_batch(images: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.ndarray:
+    """[N,H,W,C] uint8 → standardized float32 (no crop/flip)."""
+    n, h, w, c = images.shape
+    images = np.ascontiguousarray(images, np.uint8)
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    lib = _load()
+    if lib is not None:
+        out = np.empty((n, h, w, c), np.float32)
+        lib.dls_normalize_u8_batch(images, n, h, w, c, mean, std, out)
+        return out
+    return (images.astype(np.float32) / 255.0 - mean) / std
+
+
+def resize_bilinear(image: np.ndarray, size: tuple[int, int]) -> np.ndarray:
+    """[H,W,C] (or [H,W]) float32 → resized, half-pixel centers (vision.py math)."""
+    if image.ndim == 2:  # grayscale: process as single-channel
+        return resize_bilinear(image[..., None], size)[..., 0]
+    h, w, c = image.shape
+    oh, ow = size
+    if (h, w) == (oh, ow):
+        return np.asarray(image, np.float32)
+    image = np.ascontiguousarray(image, np.float32)
+    lib = _load()
+    if lib is not None:
+        out = np.empty((oh, ow, c), np.float32)
+        lib.dls_resize_bilinear(image, h, w, c, oh, ow, out)
+        return out
+    from distributeddeeplearningspark_tpu.data import vision
+
+    return vision.resize_bilinear(image, size)
+
+
+def sum_into(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """dst += src (float32, flattened view) — host gradient aggregation."""
+    if dst.dtype != np.float32 or not dst.flags.c_contiguous:
+        # reshape(-1) on a non-contiguous dst would COPY, and the kernel
+        # would accumulate into the discarded copy — hard error instead
+        raise ValueError("sum_into needs a C-contiguous float32 dst")
+    src = np.ascontiguousarray(src, np.float32)
+    lib = _load()
+    if lib is not None:
+        lib.dls_sum_into_f32(dst.reshape(-1), src.reshape(-1), dst.size)
+        return dst
+    dst += src
+    return dst

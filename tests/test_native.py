@@ -1,0 +1,96 @@
+"""Native (C++) host data-plane kernels: build, parity with numpy, wiring.
+
+The native library is the rebuild's host-side native layer (SURVEY.md §1 L2:
+the reference's native layer is CUDA/NCCL; ours is XLA on-device + these
+kernels on-host). Parity tests pin native == numpy so either path is safe.
+"""
+
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu.data import vision
+from distributeddeeplearningspark_tpu.utils import native
+
+
+def test_native_builds_and_loads():
+    # g++ is baked into the image; the kernels must actually build here.
+    assert native.available(), "native kernels failed to build/load"
+
+
+def _rand_u8(shape, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, shape).astype(np.uint8)
+
+
+def test_crop_flip_normalize_parity():
+    imgs = _rand_u8((4, 12, 16, 3))
+    ys = np.array([0, 1, 2, 3], np.int32)
+    xs = np.array([3, 2, 1, 0], np.int32)
+    flips = np.array([0, 1, 0, 1], np.uint8)
+    mean, std = vision.IMAGENET_MEAN, vision.IMAGENET_STD
+    got = native.crop_flip_normalize_batch(imgs, ys, xs, flips, (8, 10), mean, std)
+    assert got.shape == (4, 8, 10, 3) and got.dtype == np.float32
+    for i in range(4):
+        ref = imgs[i, ys[i]:ys[i] + 8, xs[i]:xs[i] + 10]
+        if flips[i]:
+            ref = ref[:, ::-1]
+        ref = (ref.astype(np.float32) / 255.0 - mean) / std
+        np.testing.assert_allclose(got[i], ref, atol=1e-6)
+
+
+def test_normalize_u8_batch_parity():
+    imgs = _rand_u8((3, 6, 7, 3), seed=1)
+    got = native.normalize_u8_batch(imgs, vision.IMAGENET_MEAN, vision.IMAGENET_STD)
+    ref = (imgs.astype(np.float32) / 255.0 - vision.IMAGENET_MEAN) / vision.IMAGENET_STD
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_resize_bilinear_parity():
+    img = np.random.default_rng(2).normal(0, 1, (17, 23, 3)).astype(np.float32)
+    got = native.resize_bilinear(img, (8, 9))
+    ref = vision.resize_bilinear(img, (8, 9))
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+    # upsampling too
+    got_up = native.resize_bilinear(img, (30, 40))
+    ref_up = vision.resize_bilinear(img, (30, 40))
+    np.testing.assert_allclose(got_up, ref_up, atol=1e-5, rtol=1e-5)
+
+
+def test_sum_into_parity():
+    a = np.random.default_rng(3).normal(0, 1, (1 << 17,)).astype(np.float32)
+    b = np.random.default_rng(4).normal(0, 1, (1 << 17,)).astype(np.float32)
+    want = a + b
+    got = native.sum_into(a.copy(), b)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_numpy_fallback_matches_native(monkeypatch):
+    imgs = _rand_u8((2, 8, 8, 3), seed=5)
+    ys = xs = np.zeros(2, np.int32)
+    flips = np.array([1, 0], np.uint8)
+    args = (imgs, ys, xs, flips, (8, 8), vision.IMAGENET_MEAN, vision.IMAGENET_STD)
+    with_native = native.crop_flip_normalize_batch(*args)
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_TRIED", True)
+    assert not native.available()
+    without = native.crop_flip_normalize_batch(*args)
+    np.testing.assert_allclose(with_native, without, atol=1e-6)
+
+
+def test_train_transform_uint8_standardizes():
+    """uint8 inputs must come out unit-scaled AND standardized — including
+    through the crop path (regression: crop path skipped /255)."""
+    tf = vision.train_transform(size=8, seed=0)
+    same = tf({"image": _rand_u8((8, 8, 3), seed=6), "label": np.int32(0)})["image"]
+    cropped = tf({"image": _rand_u8((14, 14, 3), seed=7), "label": np.int32(0)})["image"]
+    for out in (same, cropped):
+        assert out.shape == (8, 8, 3) and out.dtype == np.float32
+        # standardized pixels live in roughly [-3, 3]; unnormalized would be ~255
+        assert np.abs(out).max() < 5.0
+
+
+def test_eval_transform_uint8_standardizes():
+    tf = vision.eval_transform(size=8)
+    out = tf({"image": _rand_u8((12, 16, 3), seed=8)})["image"]
+    assert out.shape == (8, 8, 3) and np.abs(out).max() < 5.0
+    out_same = tf({"image": _rand_u8((8, 8, 3), seed=9)})["image"]
+    assert np.abs(out_same).max() < 5.0
