@@ -105,9 +105,18 @@ void dls_crop_flip_normalize_batch(const uint8_t* in, int64_t n, int h, int w,
   for (int k = 0; k < c; ++k) inv_std[k] = 1.0f / std[k];
   const int64_t in_stride = static_cast<int64_t>(h) * w * c;
   const int64_t out_stride = static_cast<int64_t>(ch) * cw * c;
-  parallel_for(n, [&](int64_t i) {
-    crop_flip_normalize_one(in + i * in_stride, h, w, c, ys[i], xs[i], ch, cw,
-                            flips[i], mean, inv_std.data(), out + i * out_stride);
+  // Parallelize over (image, row-group) so n=1 calls (the per-example
+  // transform path) still use every core, not just batch-level callers.
+  const int kRowGroup = 32;
+  const int64_t groups_per_img = (ch + kRowGroup - 1) / kRowGroup;
+  parallel_for(n * groups_per_img, [&](int64_t g) {
+    const int64_t i = g / groups_per_img;
+    const int y0 = static_cast<int>(g % groups_per_img) * kRowGroup;
+    const int rows = std::min(kRowGroup, ch - y0);
+    crop_flip_normalize_one(in + i * in_stride, h, w, c, ys[i] + y0, xs[i],
+                            rows, cw, flips[i], mean, inv_std.data(),
+                            out + i * out_stride +
+                                static_cast<int64_t>(y0) * cw * c);
   });
 }
 

@@ -1,0 +1,341 @@
+"""Pallas blockwise flash attention for TPU — the long-sequence hot op.
+
+The reference leans on cuDNN/torch SDPA CUDA kernels for attention; the
+TPU-native equivalent is a Pallas (Mosaic) kernel tiled for the MXU and VMEM
+(SURVEY.md §1 L2, pallas_guide.md). Standard FlashAttention-2 scheme:
+
+- **Forward**: grid over (batch·heads, Q blocks, K blocks); the K dimension is
+  innermost so VMEM accumulators (running max ``m``, denominator ``l``, output
+  ``acc``) persist across K steps — O(S) memory, no [S, S] score matrix ever
+  hits HBM. Also emits the log-sum-exp per row for the backward pass.
+- **Backward**: recomputation-based, two kernels — dQ (grid K-innermost) and
+  dK/dV (grid Q-innermost) — using the forward's LSE and the precomputed
+  ``delta = rowsum(dO ∘ O)`` (FlashAttention-2, arXiv:2307.08691).
+- Accumulation is f32 throughout; inputs may be bf16 (MXU-native).
+
+Layout: [B, S, H, D] (BSHD) at the API, flattened to [B·H, S, D] for the
+kernels. ``causal`` masks per-block: blocks strictly above the diagonal are
+skipped entirely (their grid steps no-op), the diagonal block gets a
+positional mask.
+
+Shape contract (checked): S divisible by the block sizes, D divisible by 128
+on real TPU (the MXU lane width; tests use interpret mode with small D).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = float("-inf")
+DEFAULT_BLOCK = 512
+
+
+def _vmem():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref,          # [1, Bq, D], [1, Bk, D] blocks
+                o_ref, lse_ref,               # [1, Bq, D], [1, Bq]
+                acc_ref, m_ref, l_ref,        # VMEM scratch
+                *, scale: float, causal: bool, num_kb: int, block_q: int,
+                block_k: int):
+    qb, kb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)                  # [Bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [Bq, Bk]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[:, 0]                              # [Bq]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_cur[:, None])                   # masked rows → 0
+        corr = jnp.exp(m_prev - m_cur)
+        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+        m_ref[:, 0] = m_cur
+        pv = jnp.dot(p.astype(v_ref.dtype), v_ref[0],
+                     preferred_element_type=jnp.float32)  # [Bq, D]
+        acc_ref[:] = acc_ref[:] * corr[:, None] + pv
+
+    if causal:
+        # blocks strictly above the diagonal contribute nothing
+        pl.when(kb * block_k < (qb + 1) * block_q)(compute)
+    else:
+        compute()
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, 0] + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    num_qb, num_kb = s // block_q, s // block_k
+    grid = (bh, num_qb, num_kb)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, num_kb=num_kb,
+        block_q=block_q, block_k=block_k,
+    )
+    vmem = _vmem()
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            vmem((block_q, d), jnp.float32),    # acc
+            vmem((block_q, 128), jnp.float32),  # m (col 0 used)
+            vmem((block_q, 128), jnp.float32),  # l (col 0 used)
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (recomputation, FlashAttention-2)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref,
+                   *, scale: float, causal: bool, num_kb: int,
+                   block_q: int, block_k: int):
+    qb, kb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])                       # [Bq, Bk]
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])                      # [Bq, Bk]
+        acc_ref[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(kb * block_k < (qb + 1) * block_q)(compute)
+    else:
+        compute()
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale: float, causal: bool, num_qb: int,
+                    block_q: int, block_k: int):
+    kb, qb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [Bq, Bk]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])                       # [Bq, Bk]
+        do = do_ref[0].astype(jnp.float32)
+        # dV += Pᵀ dO
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        # dK += dSᵀ (Q·scale); the extra `scale` belongs to dQ only, and
+        # q here already carries it — exactly the dK of s = scale·q·kᵀ
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(kb * block_k < (qb + 1) * block_q)(compute)
+    else:
+        compute()
+
+    @pl.when(qb == num_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
+    q, k, v, o, lse = res
+    do = g
+    bh, s, d = q.shape
+    num_qb, num_kb = s // block_q, s // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    vmem = _vmem()
+
+    in_specs_q = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),         # lse
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),         # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          num_kb=num_kb, block_q=block_q, block_k=block_k),
+        grid=(bh, num_qb, num_kb),
+        in_specs=in_specs_q,
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
+        scratch_shapes=[vmem((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)[0]
+
+    in_specs_kv = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),   # v
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),   # do
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),         # lse
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),         # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          num_qb=num_qb, block_q=block_q, block_k=block_k),
+        grid=(bh, num_kb, num_qb),
+        in_specs=in_specs_kv,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            vmem((block_k, d), jnp.float32),
+            vmem((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, scale=scale, causal=causal,
+                        block_q=block_q, block_k=block_k, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    bias=None,
+    mask=None,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """BSHD flash attention (Pallas). Differentiable (custom VJP).
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU so tests run on
+    CPU; on TPU the kernel compiles via Mosaic.
+    """
+    if bias is not None or mask is not None:
+        raise NotImplementedError(
+            "flash kernel supports causal/full only; use impl='xla' for "
+            "arbitrary bias/mask tensors"
+        )
+    b, sq, h, d = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shapes must match: {q.shape} {k.shape} {v.shape}")
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sq)
+    if sq % block_q or sq % block_k:
+        raise ValueError(f"seq len {sq} must divide by blocks ({block_q}, {block_k})")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    scale = scale if scale is not None else d**-0.5
+
+    # BSHD → [B·H, S, D] for the kernels
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+
+    o = _flash(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+               scale, causal, block_q, block_k, interpret)
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

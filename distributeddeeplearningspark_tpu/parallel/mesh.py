@@ -37,9 +37,12 @@ AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
+AXIS_PIPE = "pipe"
 
 #: Fixed axis order, outermost (slowest links, DCN) → innermost (fastest ICI).
-MESH_AXES: tuple[str, ...] = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
+#: `pipe` sits outside expert/seq/tensor: stage boundaries are point-to-point
+#: transfers, tolerant of slower links; TP/SP collectives need the fastest.
+MESH_AXES: tuple[str, ...] = (AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
 
 #: PartitionSpec for the leading (batch) axis of inputs: batch is split across
 #: both the pure-DP and the FSDP axes (FSDP is data parallelism with sharded
@@ -59,12 +62,13 @@ class MeshSpec:
 
     data: int = -1
     fsdp: int = 1
+    pipe: int = 1
     expert: int = 1
     seq: int = 1
     tensor: int = 1
 
     def axis_sizes(self, num_devices: int) -> tuple[int, ...]:
-        sizes = [self.data, self.fsdp, self.expert, self.seq, self.tensor]
+        sizes = [self.data, self.fsdp, self.pipe, self.expert, self.seq, self.tensor]
         wild = [i for i, s in enumerate(sizes) if s == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one mesh axis may be -1, got spec {self}")

@@ -27,8 +27,8 @@ Recognized ``.config()`` keys (Spark names kept where they exist):
 
 - ``spark.executor.instances``  → data-parallel degree (mesh ``data`` axis)
 - ``spark.app.name``            → app name
-- ``mesh.data`` / ``mesh.fsdp`` / ``mesh.tensor`` / ``mesh.seq`` /
-  ``mesh.expert``               → mesh axis sizes (one may be -1 = wildcard;
+- ``mesh.data`` / ``mesh.fsdp`` / ``mesh.pipe`` / ``mesh.tensor`` /
+  ``mesh.seq`` / ``mesh.expert`` → mesh axis sizes (one may be -1 = wildcard;
                                 ``spark.executor.instances`` overrides ``mesh.data``)
 """
 
@@ -208,6 +208,7 @@ def _local_n(master: str | None) -> int | None:
 def _parse_master(master: str | None, conf: dict[str, str]) -> tuple[list[jax.Device] | None, MeshSpec]:
     """Resolve a master URL + conf into (device subset, MeshSpec)."""
     fsdp = int(conf.get("mesh.fsdp", 1))
+    pipe = int(conf.get("mesh.pipe", 1))
     tensor = int(conf.get("mesh.tensor", 1))
     seq = int(conf.get("mesh.seq", 1))
     expert = int(conf.get("mesh.expert", 1))
@@ -222,7 +223,8 @@ def _parse_master(master: str | None, conf: dict[str, str]) -> tuple[list[jax.De
         n = _local_n(master)
         # a -1 (wildcard) axis contributes ×1 here: local[N] then means "N
         # workers total", and the wildcard axis absorbs them in MeshSpec
-        n_dev = n * max(fsdp, 1) * max(tensor, 1) * max(seq, 1) * max(expert, 1)
+        n_dev = (n * max(fsdp, 1) * max(pipe, 1) * max(tensor, 1)
+                 * max(seq, 1) * max(expert, 1))
         all_dev = jax.devices()
         if n_dev > len(all_dev):
             raise ValueError(
@@ -240,7 +242,8 @@ def _parse_master(master: str | None, conf: dict[str, str]) -> tuple[list[jax.De
     if executors is not None:
         data = int(executors)
         if devices is None:
-            n_dev = data * max(fsdp, 1) * max(tensor, 1) * max(seq, 1) * max(expert, 1)
+            n_dev = (data * max(fsdp, 1) * max(pipe, 1) * max(tensor, 1)
+                     * max(seq, 1) * max(expert, 1))
             all_dev = jax.devices()
             if n_dev > len(all_dev):
                 raise ValueError(
@@ -249,7 +252,7 @@ def _parse_master(master: str | None, conf: dict[str, str]) -> tuple[list[jax.De
                 )
             devices = all_dev[:n_dev]
 
-    spec = MeshSpec(data=data, fsdp=fsdp, tensor=tensor, seq=seq, expert=expert)
+    spec = MeshSpec(data=data, fsdp=fsdp, pipe=pipe, tensor=tensor, seq=seq, expert=expert)
     return devices, spec
 
 

@@ -84,6 +84,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def _prebuilt() -> str | None:
+    """A _dls_native*.so shipped next to the package (no-compiler deploys)."""
+    import glob
+
+    pkg_dir = os.path.dirname(os.path.dirname(__file__))
+    hits = sorted(glob.glob(os.path.join(pkg_dir, "_dls_native*.so")))
+    return hits[-1] if hits else None
+
+
 def _load() -> ctypes.CDLL | None:
     global _LIB, _TRIED
     if _TRIED:
@@ -92,7 +101,7 @@ def _load() -> ctypes.CDLL | None:
     if os.environ.get("DLS_DISABLE_NATIVE"):
         return None
     try:
-        path = _build(_SRC)
+        path = _prebuilt() or _build(_SRC)
         if path is not None:
             _LIB = _bind(ctypes.CDLL(path))
             logger.info("native kernels loaded (%d threads): %s",
@@ -184,6 +193,10 @@ def sum_into(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
         # reshape(-1) on a non-contiguous dst would COPY, and the kernel
         # would accumulate into the discarded copy — hard error instead
         raise ValueError("sum_into needs a C-contiguous float32 dst")
+    if src.size != dst.size:
+        # the kernel reads dst.size floats from src — a short src would be
+        # a heap over-read, not the broadcast error numpy would raise
+        raise ValueError(f"sum_into size mismatch: dst {dst.size} vs src {src.size}")
     src = np.ascontiguousarray(src, np.float32)
     lib = _load()
     if lib is not None:

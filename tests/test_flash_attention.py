@@ -1,0 +1,63 @@
+"""Pallas flash attention vs dense XLA attention (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu.ops.attention import _xla_attention
+from distributeddeeplearningspark_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(b=2, s=128, h=2, d=32, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(0, 1, (b, s, h, d)).astype(dtype))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    q, k, v = _qkv()
+    want = _xla_attention(q, k, v, bias=None, mask=None, causal=causal, scale=None)
+    got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_dense(causal):
+    q, k, v = _qkv(b=1, s=64, h=2, d=16, seed=3)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_q=32, block_k=32) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, bias=None, mask=None,
+                                      causal=causal, scale=None) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_uneven_blocks_rejected():
+    q, k, v = _qkv(s=96)
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, k, v, block_q=64, block_k=64)
+
+
+def test_flash_rejects_mask():
+    q, k, v = _qkv(s=64)
+    with pytest.raises(NotImplementedError):
+        flash_attention(q, k, v, mask=jnp.ones((2, 1, 1, 64), bool))
+
+
+def test_flash_bf16_close_to_f32_reference():
+    q, k, v = _qkv(s=64, d=32, seed=7)
+    want = _xla_attention(q, k, v, bias=None, mask=None, causal=True, scale=None)
+    got = flash_attention(*(x.astype(jnp.bfloat16) for x in (q, k, v)),
+                          causal=True, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want),
+                               atol=5e-2, rtol=5e-2)
