@@ -1,19 +1,68 @@
 """Benchmark harness — prints ONE JSON line with the headline metric.
 
-Run on the real chip (default env, JAX_PLATFORMS=axon). Metric follows
-BASELINE.json: images/sec/chip on the heaviest image model available.
-``vs_baseline`` is measured-MFU / 0.50 (the north-star MFU target); the
-reference published no absolute numbers (BASELINE.md), so the MFU target is
-the only honest denominator available.
+Run on the real chip (default env, JAX_PLATFORMS=axon). Metrics follow
+BASELINE.json: **ResNet-50 images/sec/chip** (headline) and **BERT-base MLM
+tokens/sec/chip** (in ``extra``), plus achieved MFU. ``vs_baseline`` is
+measured-MFU / 0.50 (the north-star MFU target); the reference published no
+absolute numbers (BASELINE.md), so the MFU target is the only honest
+denominator available.
+
+Resilience (VERDICT r1 #1: one flaky PJRT init burned the whole round):
+
+- the TPU backend is probed in a SUBPROCESS with a hard timeout, retried with
+  backoff — a hanging or erroring ``axon`` init can neither wedge the harness
+  nor leak a poisoned backend cache into it;
+- every failure path emits a structured JSON record (rc 0, parseable) with
+  the error chain in ``extra.errors`` instead of a traceback;
+- each workload benches independently — a BERT failure still reports ResNet;
+- when the TPU never comes up, the record says exactly that (and how long we
+  waited); ``--allow-cpu`` opts into a CPU fallback run for harness-path
+  debugging only (clearly labeled, vs_baseline forced 0).
+
+Also records a single-chip Pallas flash-attention fwd+bwd compile/run smoke
+(VERDICT r1 #10) so "interpret-only verified" becomes hardware evidence the
+moment the backend cooperates.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+PROBE_SNIPPET = (
+    "import jax; d = jax.devices(); "
+    "print(d[0].platform, getattr(d[0], 'device_kind', '?'), len(d))"
+)
+
+
+def probe_backend(*, attempts: int = 3, timeout_s: float = 150.0,
+                  backoff_s: float = 20.0) -> tuple[bool, list[str]]:
+    """Subprocess-probe TPU init; returns (ok, error log). Never hangs."""
+    errors: list[str] = []
+    for i in range(attempts):
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", PROBE_SNIPPET],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if out.returncode == 0:
+                return True, errors
+            tail = (out.stderr or out.stdout).strip().splitlines()[-1:]
+            errors.append(
+                f"probe {i + 1}/{attempts}: rc={out.returncode} "
+                f"after {time.time() - t0:.0f}s: {' '.join(tail)[:300]}")
+        except subprocess.TimeoutExpired:
+            errors.append(
+                f"probe {i + 1}/{attempts}: hung past {timeout_s:.0f}s (killed)")
+        if i + 1 < attempts:
+            time.sleep(backoff_s)
+    return False, errors
 
 
 def bench_steps(step_fn, state, batch, *, warmup: int = 3, iters: int = 20):
@@ -29,76 +78,222 @@ def bench_steps(step_fn, state, batch, *, warmup: int = 3, iters: int = 20):
     return (time.perf_counter() - t0) / iters, state
 
 
-def main() -> None:
-    import jax
+def _train_setup(model, batch, loss_fn, *, tx=None):
+    """Shared: mesh, sharded state, jitted step, global batch, flops."""
     import optax
 
-    from distributeddeeplearningspark_tpu.data.feed import put_global, stack_examples
-    from distributeddeeplearningspark_tpu.metrics import (
-        compiled_flops_per_step,
-        device_peak_flops,
-    )
+    from distributeddeeplearningspark_tpu.data.feed import put_global
+    from distributeddeeplearningspark_tpu.metrics import compiled_flops_per_step
     from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
     from distributeddeeplearningspark_tpu.parallel.sharding import REPLICATED
-    from distributeddeeplearningspark_tpu.train import losses, step as step_lib
-
-    try:
-        from distributeddeeplearningspark_tpu.models import ResNet50  # type: ignore
-
-        model = ResNet50(num_classes=1000, dtype="bfloat16")
-        batch_size = 256
-        example = {
-            "image": np.random.default_rng(0).normal(0, 1, (224, 224, 3)).astype(np.float32),
-            "label": np.int32(1),
-        }
-        name = "resnet50_images_per_sec_per_chip"
-    except ImportError:
-        from distributeddeeplearningspark_tpu.models import LeNet5
-
-        model = LeNet5()
-        batch_size = 1024
-        example = {"image": np.zeros((28, 28, 1), np.float32), "label": np.int32(1)}
-        name = "lenet5_images_per_sec_per_chip"
+    from distributeddeeplearningspark_tpu.train import step as step_lib
 
     mesh = MeshSpec(data=-1).build()
-    n_chips = mesh.devices.size
-    batch = stack_examples([example] * batch_size)
-    tx = optax.sgd(0.01, momentum=0.9)
+    tx = tx or optax.sgd(0.01, momentum=0.9)
     state, shardings = step_lib.init_state(model, tx, batch, mesh, REPLICATED)
     train_step = step_lib.jit_train_step(
         step_lib.make_train_step(
-            model.apply, tx, losses.softmax_xent,
-            mutable_keys=tuple(state.mutable.keys()),
+            model.apply, tx, loss_fn, mutable_keys=tuple(state.mutable.keys()),
         ),
-        mesh,
-        shardings,
+        mesh, shardings,
     )
     gbatch = put_global(batch, mesh)
+    flops = compiled_flops_per_step(train_step.lower(state, gbatch).compile())
+    return mesh, state, train_step, gbatch, flops
 
-    lowered = train_step.lower(state, gbatch)
-    flops = compiled_flops_per_step(lowered.compile())
-    step_time, state = bench_steps(train_step, state, gbatch)
 
-    imgs_per_sec_chip = batch_size / step_time / n_chips
+def bench_resnet(iters: int, batch_size: int = 256) -> dict:
+    """ResNet-50 images/sec/chip + MFU (BASELINE.json metric #1)."""
+    from distributeddeeplearningspark_tpu.data.feed import stack_examples
+    from distributeddeeplearningspark_tpu.metrics import device_peak_flops
+    from distributeddeeplearningspark_tpu.models import ResNet50
+    from distributeddeeplearningspark_tpu.train import losses
+
+    model = ResNet50(num_classes=1000, dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    example = {
+        "image": rng.normal(0, 1, (224, 224, 3)).astype(np.float32),
+        "label": np.int32(1),
+    }
+    batch = stack_examples([example] * batch_size)
+    mesh, state, step, gbatch, flops = _train_setup(model, batch, losses.softmax_xent)
+    n_chips = mesh.devices.size
+    step_time, _ = bench_steps(step, state, gbatch, iters=iters)
     peak = device_peak_flops()
     mfu = (flops / step_time / n_chips / peak) if (flops and peak) else 0.0
-    print(
-        json.dumps(
-            {
-                "metric": name,
-                "value": round(imgs_per_sec_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(mfu / 0.50, 4),
-                "extra": {
-                    "step_time_ms": round(step_time * 1e3, 3),
-                    "mfu": round(mfu, 4),
-                    "chips": n_chips,
-                    "device": getattr(jax.devices()[0], "device_kind", "unknown"),
-                    "batch_size": batch_size,
-                },
-            }
-        )
-    )
+    return {
+        "images_per_sec_per_chip": round(batch_size / step_time / n_chips, 2),
+        "step_time_ms": round(step_time * 1e3, 3),
+        "mfu": round(mfu, 4),
+        "batch_size": batch_size,
+        "chips": n_chips,
+    }
+
+
+def bench_bert(iters: int, batch_size: int = 32, seq: int = 512) -> dict:
+    """BERT-base MLM tokens/sec/chip + MFU (BASELINE.json metric #2).
+
+    Full 512-token sequences with an all-ones attention mask (the padding-mask
+    path BERT always runs through — routes to the Pallas flash kernel on TPU,
+    see ops/attention._pick_impl) and 15% MLM positions, AdamW.
+    """
+    import optax
+
+    from distributeddeeplearningspark_tpu.data.feed import stack_examples
+    from distributeddeeplearningspark_tpu.metrics import device_peak_flops
+    from distributeddeeplearningspark_tpu.models import bert_base
+    from distributeddeeplearningspark_tpu.train import losses
+
+    model = bert_base()
+    rng = np.random.default_rng(1)
+    examples = []
+    for _ in range(batch_size):
+        ids = rng.integers(0, 30522, (seq,)).astype(np.int32)
+        weights = (rng.random(seq) < 0.15).astype(np.float32)
+        examples.append({
+            "input_ids": ids,
+            "attention_mask": np.ones((seq,), np.int32),
+            "mlm_labels": ids,
+            "mlm_weights": weights,
+        })
+    batch = stack_examples(examples)
+    mesh, state, step, gbatch, flops = _train_setup(
+        model, batch, losses.masked_lm, tx=optax.adamw(1e-4))
+    n_chips = mesh.devices.size
+    step_time, _ = bench_steps(step, state, gbatch, iters=iters)
+    peak = device_peak_flops()
+    mfu = (flops / step_time / n_chips / peak) if (flops and peak) else 0.0
+    tokens = batch_size * seq
+    return {
+        "tokens_per_sec_per_chip": round(tokens / step_time / n_chips, 1),
+        "step_time_ms": round(step_time * 1e3, 3),
+        "mfu": round(mfu, 4),
+        "batch_size": batch_size,
+        "seq_len": seq,
+        "chips": n_chips,
+    }
+
+
+def pallas_smoke() -> dict:
+    """Compile-and-run flash attention fwd+bwd on the real chip (Mosaic).
+
+    Covers the three kernel regimes the models use: causal d=128 (Llama),
+    key-padding mask d=64 (BERT-base), GQA grouped KV (Llama 70B-family).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearningspark_tpu.ops.flash_attention import flash_attention
+
+    cases = {
+        "causal_d128": dict(b=2, s=1024, h=4, hkv=4, d=128, causal=True, mask=False),
+        "masked_d64_bert": dict(b=2, s=512, h=12, hkv=12, d=64, causal=False, mask=True),
+        "gqa_causal_d128": dict(b=1, s=1024, h=8, hkv=2, d=128, causal=True, mask=False),
+    }
+    results = {}
+    for name, c in cases.items():
+        try:
+            key = jax.random.PRNGKey(0)
+            q = jax.random.normal(key, (c["b"], c["s"], c["h"], c["d"]), jnp.bfloat16)
+            kv_shape = (c["b"], c["s"], c["hkv"], c["d"])
+            k = jax.random.normal(key, kv_shape, jnp.bfloat16)
+            v = jax.random.normal(key, kv_shape, jnp.bfloat16)
+            mask = jnp.ones((c["b"], c["s"]), jnp.int32) if c["mask"] else None
+
+            def loss(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, mask=mask, causal=c["causal"]).astype(
+                        jnp.float32) ** 2)
+
+            val, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(q, k, v)
+            jax.block_until_ready(grads)
+            ok = bool(np.isfinite(float(val)))
+            results[name] = "ok" if ok else "nonfinite"
+        except Exception as e:  # noqa: BLE001 — smoke must never kill the bench
+            results[name] = f"FAIL: {type(e).__name__}: {str(e)[:200]}"
+    return results
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float, extra: dict) -> None:
+    print(json.dumps({
+        "metric": metric, "value": value, "unit": unit,
+        "vs_baseline": vs_baseline, "extra": extra,
+    }))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["all", "resnet", "bert"], default="all")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=0,
+                    help="override per-model default batch size (debug)")
+    ap.add_argument("--seq", type=int, default=0,
+                    help="override BERT sequence length (debug)")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="bench on CPU if TPU never initializes (debug only)")
+    ap.add_argument("--skip-probe", action="store_true")
+    ap.add_argument("--skip-smoke", action="store_true")
+    args = ap.parse_args(argv)
+
+    extra: dict = {"errors": []}
+    backend = "tpu"
+    if not args.skip_probe:
+        ok, probe_errors = probe_backend()
+        extra["errors"].extend(probe_errors)
+        if not ok:
+            if args.allow_cpu:
+                import os
+
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                backend = "cpu-fallback"
+            else:
+                emit("backend_unavailable", 0.0, "none", 0.0, {
+                    **extra,
+                    "detail": "axon TPU backend failed to initialize after "
+                              "retries; no perf numbers this run",
+                })
+                return 0
+
+    import jax
+
+    extra["device"] = getattr(jax.devices()[0], "device_kind", jax.devices()[0].platform)
+    extra["backend"] = backend
+
+    want = {"all": ("resnet50", "bert_base_mlm"),
+            "resnet": ("resnet50",),
+            "bert": ("bert_base_mlm",)}[args.model]
+    runners = {
+        "resnet50": lambda: bench_resnet(
+            args.iters, **({"batch_size": args.batch} if args.batch else {})),
+        "bert_base_mlm": lambda: bench_bert(
+            args.iters,
+            **({"batch_size": args.batch} if args.batch else {}),
+            **({"seq": args.seq} if args.seq else {})),
+    }
+    results: dict = {}
+    for name in want:
+        try:
+            results[name] = runners[name]()
+        except Exception as e:  # noqa: BLE001 — report, don't crash the round
+            extra["errors"].append(f"{name}: {type(e).__name__}: {str(e)[:300]}")
+
+    if not args.skip_smoke and backend == "tpu":
+        extra["pallas_smoke"] = pallas_smoke()
+
+    extra.update(results)
+    if "resnet50" in results:
+        r = results["resnet50"]
+        mfu = r["mfu"] if backend == "tpu" else 0.0
+        emit("resnet50_images_per_sec_per_chip", r["images_per_sec_per_chip"],
+             "images/sec/chip", round(mfu / 0.50, 4), extra)
+    elif "bert_base_mlm" in results:
+        r = results["bert_base_mlm"]
+        mfu = r["mfu"] if backend == "tpu" else 0.0
+        emit("bert_base_mlm_tokens_per_sec_per_chip", r["tokens_per_sec_per_chip"],
+             "tokens/sec/chip", round(mfu / 0.50, 4), extra)
+    else:
+        emit("bench_failed", 0.0, "none", 0.0, extra)
+    return 0
 
 
 if __name__ == "__main__":

@@ -31,11 +31,6 @@ import jax
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.checkpoint")
 
-# orbax narrates every save/restore phase at INFO through the root logger;
-# keep driver-script logs readable (opt back in via the 'orbax' logger).
-for _name in ("orbax", "absl"):
-    logging.getLogger(_name).setLevel(logging.WARNING)
-
 _STATE = "state"
 _DATA = "data"
 
@@ -64,12 +59,20 @@ class Checkpointer:
         Write in a background thread so training continues during the save
         (the TPU-first replacement for the reference's blocking driver-side
         ``torch.save``). ``wait()`` or ``close()`` joins outstanding writes.
+    quiet_deps:
+        orbax narrates every save/restore phase at INFO through the root
+        logger; by default the 'orbax'/'absl' loggers are capped to WARNING
+        *here* (not at import time, so merely importing this package never
+        mutates global logging state). Pass ``False`` to keep their output.
     """
 
     def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 3,
-                 async_save: bool = True):
+                 async_save: bool = True, quiet_deps: bool = True):
         import orbax.checkpoint as ocp
 
+        if quiet_deps:
+            for _name in ("orbax", "absl"):
+                logging.getLogger(_name).setLevel(logging.WARNING)
         self.directory = os.path.abspath(os.fspath(directory))
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(

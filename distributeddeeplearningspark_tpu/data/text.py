@@ -17,6 +17,7 @@ runs a pre-built vocab file can be loaded.
 from __future__ import annotations
 
 import collections
+import os
 import re
 from typing import Iterable, Iterator, Sequence
 
@@ -301,3 +302,166 @@ def synthetic_wikipedia(
         return gen
 
     return PartitionedDataset([make_partition(i) for i in range(num_partitions)])
+
+
+def wikipedia_dump(
+    path: str,
+    *,
+    num_partitions: int = 8,
+    min_chars: int = 64,
+) -> PartitionedDataset:
+    """Real Wikipedia text → document RDD (VERDICT r1 missing-#3, config 3).
+
+    Accepts the three on-disk shapes Wikipedia pretraining corpora come in:
+
+    - a **mediawiki XML dump** (``*.xml`` / ``*.xml.bz2``, the enwiki
+      download): streamed with stdlib ``iterparse`` (constant memory), one
+      document per ``<page>``'s ``<text>``, redirects skipped, wikitext
+      lightly cleaned (markup → plain-ish text — the same level of cleaning
+      the reference-era BERT pipelines applied);
+    - a **wikiextractor output tree** (``AA/wiki_00`` files of ``<doc>``
+      blocks): one document per ``<doc>`` element;
+    - **plain text**: one document per line (or per blank-line-separated
+      paragraph group when lines are short), matching this module's
+      synthetic corpus shape.
+
+    Documents stream lazily per partition (files are dealt round-robin;
+    a single big XML file is read by every partition with stride — cheap
+    relative to tokenization, and keeps partition boundaries deterministic).
+    """
+    import glob as _glob
+
+    if os.path.isdir(path):
+        files = sorted(
+            f for f in _glob.glob(os.path.join(path, "**", "*"), recursive=True)
+            if os.path.isfile(f) and not os.path.basename(f).startswith(".")
+        )
+    else:
+        files = [path]
+    if not files:
+        raise FileNotFoundError(f"no corpus files under {path}")
+
+    def open_maybe_bz2(fname: str):
+        if fname.endswith(".bz2"):
+            import bz2
+
+            return bz2.open(fname, "rt", encoding="utf-8", errors="replace")
+        return open(fname, "rt", encoding="utf-8", errors="replace")
+
+    def iter_xml_docs(fname: str) -> Iterator[str]:
+        from xml.etree import ElementTree
+
+        with open_maybe_bz2(fname) as f:
+            # namespace-agnostic: match on the tag's local name
+            for _, elem in ElementTree.iterparse(f, events=("end",)):
+                tag = elem.tag.rsplit("}", 1)[-1]
+                if tag == "page":
+                    text_el = None
+                    redirect = False
+                    for child in elem.iter():
+                        ctag = child.tag.rsplit("}", 1)[-1]
+                        if ctag == "redirect":
+                            redirect = True
+                        elif ctag == "text":
+                            text_el = child
+                    if not redirect and text_el is not None and text_el.text:
+                        doc = clean_wikitext(text_el.text)
+                        if len(doc) >= min_chars:
+                            yield doc
+                    elem.clear()  # constant memory
+
+    def iter_docfile(fname: str) -> Iterator[str]:
+        """wikiextractor '<doc ...> text </doc>' blocks or plain text."""
+        with open_maybe_bz2(fname) as f:
+            first = f.readline()
+            if first.lstrip().startswith("<doc"):
+                buf: list[str] = []
+                for line in f:
+                    if line.startswith("</doc>"):
+                        doc = "\n".join(buf[1:] if buf and not buf[0].strip() else buf)
+                        if len(doc) >= min_chars:
+                            yield doc.strip()
+                        buf = []
+                    elif line.startswith("<doc"):
+                        buf = []
+                    else:
+                        buf.append(line.rstrip("\n"))
+            else:
+                # plain text: a line per doc; short lines merge into paragraphs
+                para: list[str] = []
+                for line in [first] + list(f):
+                    s = line.strip()
+                    if not s:
+                        if para:
+                            doc = " ".join(para)
+                            if len(doc) >= min_chars:
+                                yield doc
+                            para = []
+                    elif len(s) >= min_chars:
+                        yield s
+                    else:
+                        para.append(s)
+                if para and len(" ".join(para)) >= min_chars:
+                    yield " ".join(para)
+
+    def iter_file(fname: str) -> Iterator[str]:
+        base = fname[:-4] if fname.endswith(".bz2") else fname
+        if base.endswith(".xml"):
+            yield from iter_xml_docs(fname)
+        else:
+            yield from iter_docfile(fname)
+
+    def make_partition(pidx: int):
+        def gen() -> Iterator[str]:
+            if len(files) >= num_partitions:
+                for fname in files[pidx::num_partitions]:
+                    yield from iter_file(fname)
+            else:
+                # few big files: stride documents across partitions
+                for fname in files:
+                    for i, doc in enumerate(iter_file(fname)):
+                        if i % num_partitions == pidx:
+                            yield doc
+
+        return gen
+
+    return PartitionedDataset([make_partition(i) for i in range(num_partitions)])
+
+
+_WIKI_PATTERNS: "list[tuple[re.Pattern, str]] | None" = None
+
+
+def clean_wikitext(text: str) -> str:
+    """Light wikitext → plain text (the BERT-era preprocessing level).
+
+    Drops templates/tables/refs/files, unwraps [[links|label]] and quotes,
+    strips headings and html tags. Not a full parser — the goal is clean
+    *training prose*, not rendering fidelity.
+    """
+    global _WIKI_PATTERNS
+    if _WIKI_PATTERNS is None:
+        _WIKI_PATTERNS = [
+            (re.compile(r"<ref[^>]*/>|<ref[^>]*>.*?</ref>", re.S), " "),
+            (re.compile(r"<!--.*?-->", re.S), " "),
+            (re.compile(r"\{\|.*?\|\}", re.S), " "),            # tables
+            (re.compile(r"\[\[(?:File|Image|Category):[^\]]*\]\]"), " "),
+            (re.compile(r"\[\[[^\]|]*\|([^\]]*)\]\]"), r"\1"),  # [[a|b]] → b
+            (re.compile(r"\[\[([^\]]*)\]\]"), r"\1"),           # [[a]] → a
+            (re.compile(r"\[https?://\S*\s([^\]]*)\]"), r"\1"),
+            (re.compile(r"\[https?://\S*\]"), " "),
+            (re.compile(r"'{2,}"), ""),                          # bold/italics
+            (re.compile(r"^=+.*?=+\s*$", re.M), " "),            # headings
+            (re.compile(r"<[^>]+>"), " "),                       # html tags
+            (re.compile(r"^\s*[*#:;]+\s*", re.M), ""),           # list markers
+            (re.compile(r"[ \t]+"), " "),
+            (re.compile(r"\n{3,}"), "\n\n"),
+        ]
+    # templates {{...}} nest; peel iteratively (bounded)
+    for _ in range(4):
+        new = re.sub(r"\{\{[^{}]*\}\}", " ", text)
+        if new == text:
+            break
+        text = new
+    for pat, repl in _WIKI_PATTERNS:
+        text = pat.sub(repl, text)
+    return text.strip()

@@ -168,9 +168,8 @@ class LlamaAttention(nn.Module):
         positions = jnp.arange(x.shape[1])[None, :]
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
-        if nkv != nh:                                           # GQA: expand KV groups
-            k = jnp.repeat(k, nh // nkv, axis=2)
-            v = jnp.repeat(v, nh // nkv, axis=2)
+        # GQA K/V stay at nkv heads: the flash kernel indexes groups directly;
+        # xla/ring fallbacks broadcast inside dot_product_attention.
         y = dot_product_attention(q, k, v, mask=mask, causal=True,
                                   impl=cfg.attention_impl)
         rank = cfg.lora_rank if "wo" in cfg.lora_targets else 0
@@ -271,7 +270,7 @@ def lora_trainable(path: str) -> bool:
 
 
 def llama_rules(cfg: LlamaConfig, *, fsdp: bool = True,
-                fsdp_min_size: int = 2**14) -> ShardingRules:
+                fsdp_min_size: int = 2**14, pipeline: bool = False) -> ShardingRules:
     """FSDP + Megatron-style tensor-parallel layout for the Llama tree.
 
     Attention QKV shard heads over ``tensor``; the out-projection and MLP
@@ -282,16 +281,28 @@ def llama_rules(cfg: LlamaConfig, *, fsdp: bool = True,
     auto-FSDP pass then shards the largest remaining dim of every large
     param over ``fsdp`` (with scanned layers that is usually the [L, ...]
     leading dim — uniform and always divisible).
+
+    ``pipeline=True`` (requires ``scan_layers``): the stacked [L, ...]
+    leading dim of every decoder-layer param shards over ``pipe`` instead —
+    each device then STORES only its own stages, making PP a param-memory
+    partitioning like the reference's FSDP but along depth; auto-FSDP moves
+    to the next-largest dim.
     """
-    lead = (None,) if cfg.scan_layers else ()
+    if pipeline and not cfg.scan_layers:
+        raise ValueError("pipeline rules need scan_layers=True stacked params")
+    lead = (("pipe",) if pipeline else (None,)) if cfg.scan_layers else ()
     rules = (
-        (r"lora_", P()),
+        (r"lora_", P(*lead) if pipeline else P()),
         (r"(wq|wk|wv)/base/kernel", P(*lead, None, "tensor", None)),
         (r"wo/base/kernel", P(*lead, "tensor", None, None)),
         (r"(gate|up)/base/kernel", P(*lead, None, "tensor")),
         (r"down/base/kernel", P(*lead, "tensor", None)),
         (r"token_embed/embedding", P("tensor", None)),
         (r"lm_head/kernel", P(None, "tensor")),
+        # PP catch-all: any remaining stacked layer param (norm scales)
+        # stores on its own stage's devices. (`(^|/)` anchor: TrainState
+        # paths are prefixed, e.g. "params/layers/...".)
+        *(((r"(^|/)layers/", P(*lead)),) if pipeline else ()),
     )
     return ShardingRules(rules=rules, fsdp=fsdp, fsdp_min_size=fsdp_min_size,
                          fsdp_exclude=(r"lora_",))

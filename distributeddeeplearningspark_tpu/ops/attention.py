@@ -6,15 +6,19 @@ Models call :func:`dot_product_attention`; the implementation is chosen by
 - ``"xla"`` — plain einsum softmax attention. XLA fuses the scale/mask/softmax
   chain into the matmuls well enough for short sequences (BERT's 512).
 - ``"flash"`` — Pallas blockwise flash attention (O(seq) memory, HBM-tiled);
-  the long-sequence hot op (see :mod:`.flash_attention`).
+  the long-sequence hot op (see :mod:`.flash_attention`). Handles key-padding
+  masks and grouped (GQA) K/V natively.
 - ``"ring"`` — context-parallel exact attention over the mesh ``seq`` axis
   (see :mod:`.ring_attention`); use when sequences are sharded across chips.
-- ``"auto"`` — flash on TPU when the shape qualifies (seq multiple of block,
-  head_dim multiple of 128), else xla.
+- ``"auto"`` — flash on TPU when the shape qualifies (seq multiple of the
+  block size, head_dim lane-friendly, mask expressible key-only), else xla.
 
 All implementations take/return ``[batch, seq, heads, head_dim]`` (BSHD
 layout — batch and sequence leading so (data, fsdp) batch sharding and
 ``seq``-axis context parallelism shard the first two dims without transposes).
+K/V may carry fewer heads than Q (GQA; ``num_heads % num_kv_heads == 0``) —
+the flash kernel indexes the grouped heads directly, the xla/ring paths
+broadcast them (an O(group) HBM copy the kernel path exists to avoid).
 """
 
 from __future__ import annotations
@@ -40,7 +44,7 @@ def dot_product_attention(
     ``bias``: additive, broadcastable to [B, H, Sq, Sk].
     """
     if impl == "auto":
-        impl = _pick_impl(q, bias, mask)
+        impl = _pick_impl(q, k, bias, mask)
     if impl == "flash":
         from distributeddeeplearningspark_tpu.ops.flash_attention import flash_attention
 
@@ -48,19 +52,48 @@ def dot_product_attention(
     if impl == "ring":
         from distributeddeeplearningspark_tpu.ops.ring_attention import ring_attention
 
+        k, v = _expand_gqa(q, k, v)
         return ring_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=scale)
+    k, v = _expand_gqa(q, k, v)
     return _xla_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=scale)
 
 
-def _pick_impl(q: jax.Array, bias, mask) -> str:
+def _expand_gqa(q, k, v):
+    """Broadcast grouped KV heads up to the query head count (xla/ring paths)."""
+    h, hkv = q.shape[2], k.shape[2]
+    if h == hkv:
+        return k, v
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    return (jnp.repeat(k, h // hkv, axis=2), jnp.repeat(v, h // hkv, axis=2))
+
+
+def _key_only_mask(mask, sq: int) -> bool:
+    """True if ``mask`` is expressible as a key-padding mask [B, Sk].
+
+    [Sk] and [B, Sk] qualify outright; higher ranks ([B, 1, 1, Sk] BERT
+    style) qualify when every middle (head/query) dim is 1.
+    """
+    del sq
+    shape = jnp.shape(mask)
+    if len(shape) > 4:
+        return False
+    if len(shape) <= 2:
+        return True
+    return all(s == 1 for s in shape[1:-1])
+
+
+def _pick_impl(q: jax.Array, k: jax.Array, bias, mask) -> str:
     # Flash kernel requires TPU, block-divisible seq, lane-divisible head_dim,
-    # and no per-position bias/mask tensors (causal masking is built in).
+    # and a mask (if any) that reduces to key-only padding form.
     if jax.default_backend() not in ("tpu", "axon"):
         return "xla"
     b, s, h, d = q.shape
-    if bias is not None or mask is not None:
+    if bias is not None:
         return "xla"
-    if s % 512 or d % 128:
+    if mask is not None and not _key_only_mask(mask, s):
+        return "xla"
+    if s % 512 or d % 8 or h % k.shape[2]:
         return "xla"
     try:
         from distributeddeeplearningspark_tpu.ops import flash_attention  # noqa: F401

@@ -13,13 +13,33 @@ TPU-native equivalent is a Pallas (Mosaic) kernel tiled for the MXU and VMEM
   ``delta = rowsum(dO ∘ O)`` (FlashAttention-2, arXiv:2307.08691).
 - Accumulation is f32 throughout; inputs may be bf16 (MXU-native).
 
-Layout: [B, S, H, D] (BSHD) at the API, flattened to [B·H, S, D] for the
-kernels. ``causal`` masks per-block: blocks strictly above the diagonal are
-skipped entirely (their grid steps no-op), the diagonal block gets a
-positional mask.
+Supported masking (BASELINE.json config 3 needs this — BERT always attends
+under a key-padding mask):
 
-Shape contract (checked): S divisible by the block sizes, D divisible by 128
-on real TPU (the MXU lane width; tests use interpret mode with small D).
+- ``causal`` — per-block: blocks strictly above the diagonal are skipped
+  entirely (their grid steps no-op), the diagonal block gets a positional mask.
+- ``mask`` — a *key-only* padding mask ([B, Sk] or the BERT-style
+  [B, 1, 1, Sk]); streamed into the kernel one [block_k] slice at a time, so
+  no [S, S] mask tensor is ever built. Q-dependent masks are not expressible
+  blockwise without a full mask tensor — those fall back to the XLA path.
+
+Masked logits use a large *finite* negative (never -inf: running-max
+subtraction would produce inf - inf = NaN on fully-masked blocks) and
+probabilities are explicitly zeroed under the mask, so fully-padded key
+blocks contribute exactly nothing.
+
+**GQA** (grouped-query attention): K/V may carry ``Hkv < H`` heads with
+``H % Hkv == 0``. The kernels map each Q head to its KV group via the
+BlockSpec index maps (q row r reads kv row ``r // group``) — the grouped KV
+is never materialized at Q-head width, which is the whole point (the
+reference-style ``repeat_interleave`` would copy KV ``group``× in HBM).
+
+Layout: [B, S, H, D] (BSHD) at the API, flattened to [B·H, S, D] /
+[B·Hkv, S, D] for the kernels (head-major order, so consecutive q rows share
+a kv row).
+
+Shape contract (checked): S divisible by the block sizes; D a multiple of 8
+(Mosaic pads the lane dim; 128-multiples are fastest, BERT's 64 is fine).
 """
 
 from __future__ import annotations
@@ -30,7 +50,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-_NEG_INF = float("-inf")
+# Large finite negative for masked logits. Finite so the online-softmax
+# running max never hits -inf (exp(-inf - -inf) = NaN); small enough that
+# exp(_MASK_VALUE - m) underflows to 0 for any real row max m.
+_MASK_VALUE = -1e30
 DEFAULT_BLOCK = 512
 
 
@@ -40,21 +63,41 @@ def _vmem():
     return pltpu.VMEM
 
 
+def _block_mask(qb, kb, s_blk, *, causal, mask_blk, block_q, block_k):
+    """(masked logits, allowed bool | None) for one [Bq, Bk] score block."""
+    allowed = None
+    if causal:
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        allowed = q_pos >= k_pos
+    if mask_blk is not None:
+        kv_ok = jnp.broadcast_to(mask_blk[None, :] != 0, (block_q, block_k))
+        allowed = kv_ok if allowed is None else jnp.logical_and(allowed, kv_ok)
+    if allowed is None:
+        return s_blk, None
+    return jnp.where(allowed, s_blk, _MASK_VALUE), allowed
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref,          # [1, Bq, D], [1, Bk, D] blocks
-                o_ref, lse_ref,               # [1, Bq, D], [1, Bq]
-                acc_ref, m_ref, l_ref,        # VMEM scratch
-                *, scale: float, causal: bool, num_kb: int, block_q: int,
-                block_k: int):
+def _fwd_kernel(*refs, scale: float, causal: bool, has_mask: bool,
+                num_kb: int, block_q: int, block_k: int):
+    q_ref, k_ref, v_ref = refs[:3]            # [1, Bq, D], [1, Bk, D]
+    i = 3
+    mask_ref = refs[i] if has_mask else None  # [1, Bk] int8
+    i += int(has_mask)
+    o_ref, lse_ref = refs[i], refs[i + 1]     # [1, Bq, D], [1, Bq]
+    acc_ref, m_ref, l_ref = refs[i + 2:]      # VMEM scratch
     qb, kb = pl.program_id(1), pl.program_id(2)
 
     @pl.when(kb == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        m_ref[:] = jnp.full_like(m_ref, _MASK_VALUE)
         l_ref[:] = jnp.zeros_like(l_ref)
 
     def compute():
@@ -62,15 +105,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref,          # [1, Bq, D], [1, Bk, D] blocks
         k = k_ref[0].astype(jnp.float32)                  # [Bk, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
-        if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s, allowed = _block_mask(
+            qb, kb, s, causal=causal,
+            mask_blk=mask_ref[0] if has_mask else None,
+            block_q=block_q, block_k=block_k)
         m_prev = m_ref[:, 0]                              # [Bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_cur[:, None])                   # masked rows → 0
+        p = jnp.exp(s - m_cur[:, None])
+        if allowed is not None:
+            # exact zero under the mask (exp may give 1.0 on rows whose
+            # running max is still _MASK_VALUE)
+            p = jnp.where(allowed, p, 0.0)
         corr = jnp.exp(m_prev - m_cur)
         l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
         m_ref[:, 0] = m_cur
@@ -87,27 +132,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref,          # [1, Bq, D], [1, Bk, D] blocks
     @pl.when(kb == num_kb - 1)
     def _finalize():
         l = l_ref[:, 0]
-        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:, 0] + jnp.log(l)
+        # fully-masked rows (all keys padded): emit 0 output, and an LSE of
+        # _MASK_VALUE — the backward kernels re-zero p under the mask anyway
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, 0] + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, kv_mask, *, scale, causal, group, block_q, block_k,
+               interpret):
     bh, s, d = q.shape
+    bhkv = k.shape[0]
     num_qb, num_kb = s // block_q, s // block_k
     grid = (bh, num_qb, num_kb)
+    has_mask = kv_mask is not None
+    heads = bh // max(kv_mask.shape[0], 1) if has_mask else 0
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, num_kb=num_kb,
-        block_q=block_q, block_k=block_k,
+        _fwd_kernel, scale=scale, causal=causal, has_mask=has_mask,
+        num_kb=num_kb, block_q=block_q, block_k=block_k,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
+    ]
+    operands = [q, k, v]
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // heads, j)))
+        operands.append(kv_mask)
     vmem = _vmem()
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
@@ -122,7 +180,7 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             vmem((block_q, 128), jnp.float32),  # l (col 0 used)
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return o, lse
 
 
@@ -130,10 +188,13 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
 # backward (recomputation, FlashAttention-2)
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_ref,
-                   *, scale: float, causal: bool, num_kb: int,
-                   block_q: int, block_k: int):
+def _bwd_dq_kernel(*refs, scale: float, causal: bool, has_mask: bool,
+                   num_kb: int, block_q: int, block_k: int):
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    i = 6
+    mask_ref = refs[i] if has_mask else None
+    i += int(has_mask)
+    dq_ref, acc_ref = refs[i], refs[i + 1]
     qb, kb = pl.program_id(1), pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -145,13 +206,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s, allowed = _block_mask(
+            qb, kb, s, causal=causal,
+            mask_blk=mask_ref[0] if has_mask else None,
+            block_q=block_q, block_k=block_k)
         p = jnp.exp(s - lse_ref[0][:, None])                       # [Bq, Bk]
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
@@ -169,13 +230,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale: float, causal: bool, num_qb: int,
-                    block_q: int, block_k: int):
-    kb, qb = pl.program_id(1), pl.program_id(2)
+def _bwd_dkv_kernel(*refs, scale: float, causal: bool, has_mask: bool,
+                    num_qb: int, group: int, block_q: int, block_k: int):
+    """dK/dV for ONE kv head, accumulating over its `group` q heads × q blocks.
 
-    @pl.when(qb == 0)
+    Grid: (B·Hkv, num_kb, group·num_qb) — the innermost index j interleaves
+    (q head in group, q block); the index maps select q row b·group + j//num_qb.
+    """
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    i = 6
+    mask_ref = refs[i] if has_mask else None
+    i += int(has_mask)
+    dk_ref, dv_ref, dk_acc, dv_acc = refs[i:]
+    kb, j = pl.program_id(1), pl.program_id(2)
+    qb = j % num_qb
+
+    @pl.when(j == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -185,13 +255,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
-        if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s, allowed = _block_mask(
+            qb, kb, s, causal=causal,
+            mask_blk=mask_ref[0] if has_mask else None,
+            block_q=block_q, block_k=block_k)
         p = jnp.exp(s - lse_ref[0][:, None])                       # [Bq, Bk]
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
         do = do_ref[0].astype(jnp.float32)
         # dV += Pᵀ dO
         dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -210,66 +280,88 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         compute()
 
-    @pl.when(qb == num_qb - 1)
+    @pl.when(j == group * num_qb - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
-    q, k, v, o, lse = res
+def _flash_bwd(res, g, *, scale, causal, group, block_q, block_k, interpret):
+    q, k, v, kv_mask, o, lse = res
     do = g
     bh, s, d = q.shape
+    bhkv = k.shape[0]
     num_qb, num_kb = s // block_q, s // block_k
+    has_mask = kv_mask is not None
+    heads = bh // max(kv_mask.shape[0], 1) if has_mask else 0
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     vmem = _vmem()
 
     in_specs_q = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),         # lse
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),         # delta
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),          # q
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),  # k
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),  # v
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),          # do
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),                # lse
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),                # delta
     ]
+    operands = [q, k, v, do, lse, delta]
+    if has_mask:
+        in_specs_q.append(
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // heads, j)))
+        operands.append(kv_mask)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          num_kb=num_kb, block_q=block_q, block_k=block_k),
+                          has_mask=has_mask, num_kb=num_kb,
+                          block_q=block_q, block_k=block_k),
         grid=(bh, num_qb, num_kb),
         in_specs=in_specs_q,
         out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
         scratch_shapes=[vmem((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)[0]
+    )(*operands)[0]
 
+    # dK/dV: grid batch dim is B·Hkv; inner dim sweeps (group, q block) so the
+    # accumulators fold every q head of the group into one kv-head gradient.
+    kvheads = (bhkv // max(kv_mask.shape[0], 1)) if has_mask else 0
     in_specs_kv = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),   # q
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),   # k
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),   # v
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),   # do
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),         # lse
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),         # delta
+        pl.BlockSpec((1, block_q, d),
+                     lambda b, i, j: (b * group + j // num_qb, j % num_qb, 0)),  # q
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),               # k
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),               # v
+        pl.BlockSpec((1, block_q, d),
+                     lambda b, i, j: (b * group + j // num_qb, j % num_qb, 0)),  # do
+        pl.BlockSpec((1, block_q),
+                     lambda b, i, j: (b * group + j // num_qb, j % num_qb)),    # lse
+        pl.BlockSpec((1, block_q),
+                     lambda b, i, j: (b * group + j // num_qb, j % num_qb)),    # delta
     ]
+    operands_kv = [q, k, v, do, lse, delta]
+    if has_mask:
+        in_specs_kv.append(
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // kvheads, i)))
+        operands_kv.append(kv_mask)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          num_qb=num_qb, block_q=block_q, block_k=block_k),
-        grid=(bh, num_kb, num_qb),
+                          has_mask=has_mask, num_qb=num_qb, group=group,
+                          block_q=block_q, block_k=block_k),
+        grid=(bhkv, num_kb, group * num_qb),
         in_specs=in_specs_kv,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bhkv, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bhkv, s, d), v.dtype),
         ],
         scratch_shapes=[
             vmem((block_k, d), jnp.float32),
             vmem((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*operands_kv)
     return dq, dk, dv
 
 
@@ -277,25 +369,54 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, scale=scale, causal=causal,
-                      block_q=block_q, block_k=block_k, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, kv_mask, scale, causal, group, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, kv_mask, scale=scale, causal=causal,
+                      group=group, block_q=block_q, block_k=block_k,
+                      interpret=interpret)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, scale=scale, causal=causal,
-                        block_q=block_q, block_k=block_k, interpret=interpret)
-    return o, (q, k, v, o, lse)
+def _flash_vjp_fwd(q, k, v, kv_mask, scale, causal, group, block_q, block_k,
+                   interpret):
+    o, lse = _flash_fwd(q, k, v, kv_mask, scale=scale, causal=causal,
+                        group=group, block_q=block_q, block_k=block_k,
+                        interpret=interpret)
+    return o, (q, k, v, kv_mask, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    return _flash_bwd(res, g, scale=scale, causal=causal,
-                      block_q=block_q, block_k=block_k, interpret=interpret)
+def _flash_vjp_bwd(scale, causal, group, block_q, block_k, interpret, res, g):
+    dq, dk, dv = _flash_bwd(res, g, scale=scale, causal=causal, group=group,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def as_kv_mask(mask, batch: int, sk: int):
+    """Reduce a broadcastable attend-mask to key-only [B, Sk] form, or raise.
+
+    Accepts [B, Sk], [Sk], and the BERT-style [B, 1, 1, Sk] / [B, 1, Sk]
+    (any unit middle dims). A mask that varies along the query axis cannot be
+    streamed key-blockwise — callers should use impl='xla' for those.
+    """
+    m = jnp.asarray(mask)
+    if m.ndim == 1:
+        m = m[None, :]
+    while m.ndim > 2:
+        if m.shape[1] != 1:
+            raise NotImplementedError(
+                f"flash kernel supports key-only (padding) masks; got a mask "
+                f"of shape {jnp.shape(mask)} that varies over queries/heads — "
+                f"use impl='xla'")
+        m = m[:, 0]
+    if m.shape[-1] != sk:
+        raise ValueError(f"mask key dim {m.shape[-1]} != seq {sk}")
+    if m.shape[0] == 1 and batch > 1:
+        m = jnp.broadcast_to(m, (batch, sk))
+    return m.astype(jnp.int8)
 
 
 def flash_attention(
@@ -313,17 +434,24 @@ def flash_attention(
 ) -> jax.Array:
     """BSHD flash attention (Pallas). Differentiable (custom VJP).
 
-    ``interpret=None`` auto-selects interpreter mode off-TPU so tests run on
-    CPU; on TPU the kernel compiles via Mosaic.
+    ``mask`` may be a key-only padding mask (see :func:`as_kv_mask`); ``k``/
+    ``v`` may carry fewer (grouped) heads than ``q`` (GQA). ``interpret=None``
+    auto-selects interpreter mode off-TPU so tests run on CPU; on TPU the
+    kernel compiles via Mosaic.
     """
-    if bias is not None or mask is not None:
+    if bias is not None:
         raise NotImplementedError(
-            "flash kernel supports causal/full only; use impl='xla' for "
-            "arbitrary bias/mask tensors"
-        )
+            "flash kernel does not take additive bias; use impl='xla'")
     b, sq, h, d = q.shape
-    if k.shape != q.shape or v.shape != q.shape:
-        raise ValueError(f"q/k/v shapes must match: {q.shape} {k.shape} {v.shape}")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes must match: {k.shape} vs {v.shape}")
+    bk, sk, hkv, dk = k.shape
+    if (bk, dk) != (b, d) or sk != sq:
+        raise ValueError(f"q/k shape mismatch: {q.shape} vs {k.shape}")
+    if h % hkv:
+        raise ValueError(f"q heads {h} must be a multiple of kv heads {hkv}")
+    group = h // hkv
+    kv_mask = as_kv_mask(mask, b, sk) if mask is not None else None
     block_q = min(block_q, sq)
     block_k = min(block_k, sq)
     if sq % block_q or sq % block_k:
@@ -332,10 +460,11 @@ def flash_attention(
         interpret = jax.default_backend() not in ("tpu", "axon")
     scale = scale if scale is not None else d**-0.5
 
-    # BSHD → [B·H, S, D] for the kernels
-    def to_bhsd(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # BSHD → [B·H, S, D] for the kernels (head-major: q row r ↔ kv row r//group)
+    def flat(x):
+        bb, ss, hh, dd = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(bb * hh, ss, dd)
 
-    o = _flash(to_bhsd(q), to_bhsd(k), to_bhsd(v),
-               scale, causal, block_q, block_k, interpret)
+    o = _flash(flat(q), flat(k), flat(v), kv_mask,
+               scale, causal, group, block_q, block_k, interpret)
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -145,37 +145,9 @@ def grad_average(partition_grads: Sequence[Any]) -> Any:
     return jax.tree.map(avg, *partition_grads)
 
 
-# --- desync sanitizer (SURVEY.md §5 race detection) -------------------------
-
-
-def params_fingerprint(params: Any) -> jax.Array:
-    """Cheap order-independent scalar fingerprint of a param pytree."""
-    leaves = jax.tree.leaves(params)
-    acc = jnp.float32(0)
-    for leaf in leaves:
-        x = leaf.astype(jnp.float32)
-        acc = acc + jnp.sum(x * jnp.float32(1e-3)) + jnp.sum(jnp.abs(x)) * jnp.float32(1e-6)
-    return acc
-
-
-def assert_replicas_in_sync(params: Any, mesh, atol: float = 1e-5) -> None:
-    """Raise if replicas of nominally-replicated params have diverged.
-
-    The TPU analogue of the reference's silent NCCL-desync failure mode: under
-    SPMD this "cannot happen" on one slice, but host-side bugs (feeding
-    different RNGs / restoring mismatched checkpoints per process) can still
-    diverge state. Fetches each device's local copy and compares fingerprints.
-    """
-    import numpy as np
-
-    fp = jax.jit(params_fingerprint)(params)
-    shards = getattr(fp, "addressable_shards", None)
-    if not shards:
-        return
-    vals = [np.asarray(s.data) for s in shards]
-    ref = vals[0]
-    for i, v in enumerate(vals[1:], start=1):
-        if not np.allclose(ref, v, atol=atol):
-            raise RuntimeError(
-                f"replica desync: device shard {i} fingerprint {v} != shard 0 {ref}"
-            )
+# The desync sanitizer lives in utils/sanitize.py (one API for both the
+# local-device and cross-process checks); re-exported for callers that think
+# of it as a collective-layer concern.
+from distributeddeeplearningspark_tpu.utils.sanitize import (  # noqa: E402
+    assert_replicas_in_sync,  # noqa: F401
+)

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from distributeddeeplearningspark_tpu.parallel.mesh import AXIS_PIPE
+from distributeddeeplearningspark_tpu.parallel.mesh import AXIS_PIPE, BATCH_AXES
 
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
@@ -92,6 +92,12 @@ def pipeline(
     whose leaves have a leading stage axis of size P = mesh.shape['pipe'].
     ``x`` is the global batch [B, ...]; B must divide by ``num_microbatches``.
 
+    Composes with data parallelism: on a data×pipe mesh the microbatch rows
+    stay sharded over (data, fsdp) inside the shard_map — the ring only spans
+    ``pipe``. (The [B] → [M, B/M] reshape regroups rows across data shards,
+    so GSPMD inserts one input all-to-all per step; activations inside the
+    pipeline never leave their data shard.)
+
     Differentiable end-to-end (ppermute/scan are); params stay sharded over
     ``pipe`` so each device stores only its stage — PP is also a param-memory
     partitioning, like the reference's FSDP but along depth.
@@ -107,14 +113,15 @@ def pipeline(
         raise ValueError(f"batch {b} must divide by microbatches {num_microbatches}")
     x_mb = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
 
+    act_spec = P(None, BATCH_AXES)  # [M, mb, ...]: rows sharded, rest replicated
     fn = jax.shard_map(
         functools.partial(
             _pipeline_local, stage_fn=stage_fn, num_stages=p,
             num_microbatches=num_microbatches,
         ),
         mesh=mesh,
-        in_specs=(P(AXIS_PIPE), P()),
-        out_specs=P(),
+        in_specs=(P(AXIS_PIPE), act_spec),
+        out_specs=act_spec,
         check_vma=False,
     )
     out_mb = fn(stage_params, x_mb)

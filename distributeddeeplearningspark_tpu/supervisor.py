@@ -95,6 +95,7 @@ class Supervisor:
         restart_backoff_s: float = 0.5,
         hang_timeout_s: float | None = None,
         progress_path: str | None = None,
+        startup_grace_s: float | None = None,
     ):
         self.argv = list(argv)
         self.num_processes = num_processes
@@ -104,6 +105,23 @@ class Supervisor:
         self.restart_backoff_s = restart_backoff_s
         self.hang_timeout_s = hang_timeout_s
         self.progress_path = progress_path
+        # First-progress latency includes JIT compile + checkpoint_every steps,
+        # which can dwarf the steady-state checkpoint cadence — give startup
+        # its own (longer) window so a healthy gang isn't killed mid-compile.
+        # Default: 5× the hang timeout.
+        self.startup_grace_s = (
+            startup_grace_s if startup_grace_s is not None
+            else (hang_timeout_s * 5.0 if hang_timeout_s is not None else None)
+        )
+        # Per-process heartbeat files (ADVICE r1: checkpoint-dir mtimes alone
+        # can't tell "training between checkpoints" from "spinning"): workers
+        # touch DLS_HEARTBEAT_FILE at every metrics lap (Trainer.fit does it
+        # automatically), and the stamp below folds those mtimes in.
+        self._hb_dir: str | None = None
+        if hang_timeout_s is not None:
+            import tempfile
+
+            self._hb_dir = tempfile.mkdtemp(prefix="dls_hb_")
 
     # -- one gang ------------------------------------------------------------
 
@@ -119,6 +137,9 @@ class Supervisor:
                 "DLS_PROCESS_ID": str(pid),
                 "DLS_RESTART": str(ordinal),
             }
+            if self._hb_dir is not None:
+                env["DLS_HEARTBEAT_FILE"] = os.path.join(
+                    self._hb_dir, f"hb_{pid}")
             procs.append(subprocess.Popen(self.argv, env=env))
         logger.info(
             "attempt %d: launched %d worker(s) (coordinator :%d)",
@@ -127,26 +148,28 @@ class Supervisor:
         return procs
 
     def _progress_stamp(self) -> float:
-        """Newest mtime among progress_path and its immediate children.
+        """Newest mtime among heartbeat files, progress_path, and its
+        immediate children.
 
         Deliberately shallow: an orbax step dir appears by atomic rename at
         finalize (bumping the parent and step-dir mtimes), so one level is
         enough — recursing into thousands of tensorstore chunk files every
         poll would hammer the filesystem.
         """
-        if not self.progress_path or not os.path.exists(self.progress_path):
-            return 0.0
         latest = 0.0
-        try:
-            with os.scandir(self.progress_path) as it:
-                latest = os.stat(self.progress_path).st_mtime
-                for entry in it:
-                    try:
-                        latest = max(latest, entry.stat().st_mtime)
-                    except OSError:
-                        pass
-        except OSError:
-            pass
+        for d in (self._hb_dir, self.progress_path):
+            if not d or not os.path.exists(d):
+                continue
+            try:
+                with os.scandir(d) as it:
+                    latest = max(latest, os.stat(d).st_mtime)
+                    for entry in it:
+                        try:
+                            latest = max(latest, entry.stat().st_mtime)
+                        except OSError:
+                            pass
+            except OSError:
+                pass
         return latest
 
     def _run_attempt(self, ordinal: int) -> Attempt:
@@ -154,6 +177,7 @@ class Supervisor:
         procs = self._launch(ordinal)
         last_progress = time.monotonic()
         stamp = self._progress_stamp()
+        seen_progress = False
         try:
             while True:
                 codes = [p.poll() for p in procs]
@@ -170,12 +194,16 @@ class Supervisor:
                     return Attempt(ordinal, [int(c) for c in codes], time.monotonic() - t0)
                 if self.hang_timeout_s is not None:
                     now_stamp = self._progress_stamp()
+                    limit = (self.hang_timeout_s if seen_progress
+                             else self.startup_grace_s)
                     if now_stamp > stamp:
                         stamp, last_progress = now_stamp, time.monotonic()
-                    elif time.monotonic() - last_progress > self.hang_timeout_s:
+                        seen_progress = True
+                    elif time.monotonic() - last_progress > limit:
                         logger.warning(
-                            "attempt %d: no progress for %.1fs; killing hung gang",
-                            ordinal, self.hang_timeout_s,
+                            "attempt %d: no progress for %.1fs (%s); killing hung gang",
+                            ordinal, limit,
+                            "steady state" if seen_progress else "startup grace",
                         )
                         self._kill(procs)
                         codes = [p.wait() for p in procs]
@@ -203,23 +231,29 @@ class Supervisor:
 
     def run(self) -> SupervisorResult:
         attempts: list[Attempt] = []
-        for ordinal in range(self.max_restarts + 1):
-            attempt = self._run_attempt(ordinal)
-            attempts.append(attempt)
-            if attempt.ok:
-                logger.info(
-                    "attempt %d succeeded after %.1fs (%d restart(s) total)",
-                    ordinal, attempt.duration_s, ordinal,
-                )
-                return SupervisorResult(attempts)
-            if ordinal < self.max_restarts:
-                logger.warning(
-                    "attempt %d failed (codes %s); restarting from latest checkpoint",
-                    ordinal, attempt.returncodes,
-                )
-                time.sleep(self.restart_backoff_s)
-        logger.error("giving up after %d attempt(s)", len(attempts))
-        return SupervisorResult(attempts)
+        try:
+            for ordinal in range(self.max_restarts + 1):
+                attempt = self._run_attempt(ordinal)
+                attempts.append(attempt)
+                if attempt.ok:
+                    logger.info(
+                        "attempt %d succeeded after %.1fs (%d restart(s) total)",
+                        ordinal, attempt.duration_s, ordinal,
+                    )
+                    return SupervisorResult(attempts)
+                if ordinal < self.max_restarts:
+                    logger.warning(
+                        "attempt %d failed (codes %s); restarting from latest checkpoint",
+                        ordinal, attempt.returncodes,
+                    )
+                    time.sleep(self.restart_backoff_s)
+            logger.error("giving up after %d attempt(s)", len(attempts))
+            return SupervisorResult(attempts)
+        finally:
+            if self._hb_dir is not None:
+                import shutil
+
+                shutil.rmtree(self._hb_dir, ignore_errors=True)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -45,6 +45,7 @@ def make_train_step(
     mutable_keys: Sequence[str] = (),
     rng_names: Sequence[str] = ("dropout",),
     compute_dtype: Any = None,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, Any]]]:
     """Build the (state, batch) → (state, metrics) function (un-jitted).
 
@@ -54,8 +55,20 @@ def make_train_step(
     ``compute_dtype`` (e.g. jnp.bfloat16) casts inputs for the forward pass —
     params stay in their stored dtype; MXU-bound matmuls pick up bf16 via the
     models' own ``dtype`` attributes, so this only affects raw inputs.
+
+    ``accum_steps > 1`` — gradient accumulation (microbatching): the batch is
+    split into ``accum_steps`` equal micro-batches scanned sequentially, their
+    gradients averaged, and ONE optimizer update applied. This is the HBM
+    lever when the per-chip batch doesn't fit (7B LoRA on small meshes): peak
+    activation memory drops ×accum while arithmetic intensity per micro-step
+    stays MXU-friendly. The reference gets the same effect for free from its
+    round loop (multiple batches per aggregation round, SURVEY.md §3.1); here
+    it is a ``lax.scan`` *inside* the jitted step so the optimizer/collective
+    cost stays once-per-step.
     """
     mutable_keys = tuple(mutable_keys)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     def train_step(state: TrainState, batch: dict[str, Any]):
         next_rng, step_rng = jax.random.split(jax.random.fold_in(state.rng, state.step))
@@ -67,21 +80,52 @@ def make_train_step(
                 batch,
             )
 
-        def loss_of(params):
-            variables = {"params": params, **state.mutable}
+        def loss_of(params, mutable, mb, mb_rngs):
+            variables = {"params": params, **mutable}
             if mutable_keys:
                 outputs, updated = apply_fn(
-                    variables, batch, train=True, mutable=list(mutable_keys), rngs=rngs
+                    variables, mb, train=True, mutable=list(mutable_keys), rngs=mb_rngs
                 )
             else:
-                outputs = apply_fn(variables, batch, train=True, rngs=rngs)
+                outputs = apply_fn(variables, mb, train=True, rngs=mb_rngs)
                 updated = {}
-            loss, metrics = loss_fn(outputs, batch)
+            loss, metrics = loss_fn(outputs, mb)
             return loss, (metrics, updated)
 
-        (_, (metrics, updated)), grads = jax.value_and_grad(loss_of, has_aux=True)(
-            state.params
-        )
+        grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+
+        if accum_steps == 1:
+            (_, (metrics, updated)), grads = grad_fn(
+                state.params, state.mutable, batch, rngs
+            )
+            metrics = dict(metrics)
+        else:
+            def split_leaf(x):
+                if x.shape[0] % accum_steps:
+                    raise ValueError(
+                        f"global batch {x.shape[0]} must divide by "
+                        f"accum_steps {accum_steps}")
+                return x.reshape((accum_steps, x.shape[0] // accum_steps) + x.shape[1:])
+
+            micro = jax.tree.map(split_leaf, batch)
+            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+
+            def body(carry, xs):
+                mutable, gsum = carry
+                mb, idx = xs
+                mb_rngs = {n: jax.random.fold_in(r, idx) for n, r in rngs.items()}
+                (_, (m, updated)), g = grad_fn(state.params, mutable, mb, mb_rngs)
+                mutable = {**mutable, **updated} if mutable_keys else mutable
+                gsum = jax.tree.map(jnp.add, gsum, g)
+                return (mutable, gsum), m
+
+            (updated, grads), stacked_metrics = jax.lax.scan(
+                body, (state.mutable, zero_grads),
+                (micro, jnp.arange(accum_steps)),
+            )
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            metrics = {k: jnp.mean(v, axis=0) for k, v in dict(stacked_metrics).items()}
+
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -91,7 +135,6 @@ def make_train_step(
             mutable={**state.mutable, **updated} if mutable_keys else state.mutable,
             rng=next_rng,
         )
-        metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 

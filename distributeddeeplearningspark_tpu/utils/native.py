@@ -27,19 +27,30 @@ import numpy as np
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.native")
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc", "dls_native.cc")
+_CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
+_SRC = [
+    os.path.join(_CSRC_DIR, "dls_native.cc"),
+    os.path.join(_CSRC_DIR, "dls_jpeg.cc"),
+]
 _LIB: ctypes.CDLL | None = None
 _TRIED = False
+
+#: dls_jpeg.cc return codes
+_JPEG_OK = 0
+_JPEG_UNSUPPORTED = -2
 
 _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 
 
-def _build(src: str) -> str | None:
-    """Compile csrc → cached .so keyed by source hash; None if no compiler."""
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+def _build(srcs: list[str]) -> str | None:
+    """Compile csrc → cached .so keyed by source hashes; None if no compiler."""
+    h = hashlib.sha256()
+    for src in srcs:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()[:16]
     cache_dir = os.path.join(
         os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "dls_tpu"
     )
@@ -51,7 +62,7 @@ def _build(src: str) -> str | None:
     # concurrent builders each link their own file and the last rename wins
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
     os.close(fd)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", "-o", tmp, src]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread", "-o", tmp, *srcs]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
@@ -81,6 +92,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int, _f32p,
     ]
     lib.dls_sum_into_f32.argtypes = [_f32p, _f32p, ctypes.c_int64]
+    lib.dls_jpeg_info.restype = ctypes.c_int
+    lib.dls_jpeg_info.argtypes = [
+        _u8p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.dls_jpeg_decode.restype = ctypes.c_int
+    lib.dls_jpeg_decode.argtypes = [_u8p, ctypes.c_int64, _u8p, ctypes.c_int64]
+    lib.dls_jpeg_decode_batch.restype = None
+    lib.dls_jpeg_decode_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+    ]
     return lib
 
 
@@ -135,6 +160,19 @@ def crop_flip_normalize_batch(
     images = np.ascontiguousarray(images, np.uint8)
     ys = np.ascontiguousarray(ys, np.int32)
     xs = np.ascontiguousarray(xs, np.int32)
+    # Bounds-check BEFORE dispatch: the C++ kernel reads raw offsets, so an
+    # invalid origin would be an out-of-bounds heap read there, while the
+    # numpy path would merely produce a short slice — fail loudly on both.
+    if len(ys) != n or len(xs) != n:
+        raise ValueError(f"ys/xs must have length {n}: got {len(ys)}/{len(xs)}")
+    if ch > h or cw > w:
+        raise ValueError(f"crop {crop} exceeds image size {(h, w)}")
+    bad = (ys < 0) | (ys > h - ch) | (xs < 0) | (xs > w - cw)
+    if bad.any():
+        i = int(np.argmax(bad))
+        raise ValueError(
+            f"crop origin out of bounds at index {i}: y={ys[i]} x={xs[i]} "
+            f"for image {(h, w)} crop {crop}")
     flips = np.ascontiguousarray(flips, np.uint8)
     mean = np.ascontiguousarray(mean, np.float32)
     std = np.ascontiguousarray(std, np.float32)
@@ -185,6 +223,81 @@ def resize_bilinear(image: np.ndarray, size: tuple[int, int]) -> np.ndarray:
     from distributeddeeplearningspark_tpu.data import vision
 
     return vision.resize_bilinear(image, size)
+
+
+class JpegUnsupported(ValueError):
+    """Valid JPEG but a coding mode outside baseline (progressive, 12-bit,
+    arithmetic, CMYK) — callers fall back to PIL."""
+
+
+def jpeg_decode(data: bytes) -> np.ndarray | None:
+    """Baseline JPEG bytes → uint8 HWC (csrc/dls_jpeg.cc).
+
+    Returns None when the native library is unavailable; raises
+    :class:`JpegUnsupported` for non-baseline streams and ValueError for
+    malformed data. The decode releases the GIL (ctypes), so prefetch
+    threads decode in parallel with the main thread.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    rc = lib.dls_jpeg_info(buf, buf.size, ctypes.byref(h), ctypes.byref(w),
+                           ctypes.byref(c))
+    if rc == _JPEG_UNSUPPORTED:
+        raise JpegUnsupported("non-baseline JPEG (progressive/12-bit/arith)")
+    if rc != _JPEG_OK:
+        raise ValueError(f"malformed JPEG (dls_jpeg_info rc={rc})")
+    out = np.empty((h.value, w.value, c.value), np.uint8)
+    rc = lib.dls_jpeg_decode(buf, buf.size, out.reshape(-1), out.size)
+    if rc == _JPEG_UNSUPPORTED:
+        raise JpegUnsupported("non-baseline JPEG (progressive/12-bit/arith)")
+    if rc != _JPEG_OK:
+        raise ValueError(f"malformed JPEG (dls_jpeg_decode rc={rc})")
+    return out
+
+
+def jpeg_decode_batch(datas: list[bytes]) -> list[np.ndarray] | None:
+    """Decode many baseline JPEGs in parallel (one C++ thread per image).
+
+    Returns None when the native library is unavailable. Per-image failures
+    raise (JpegUnsupported if any stream is non-baseline, ValueError
+    otherwise) — callers wanting soft failure decode singly.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(datas)
+    if n == 0:
+        return []
+    bufs = [np.frombuffer(d, np.uint8) for d in datas]
+    outs: list[np.ndarray] = []
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    for buf in bufs:
+        rc = lib.dls_jpeg_info(buf, buf.size, ctypes.byref(h), ctypes.byref(w),
+                               ctypes.byref(c))
+        if rc == _JPEG_UNSUPPORTED:
+            raise JpegUnsupported("non-baseline JPEG in batch")
+        if rc != _JPEG_OK:
+            raise ValueError(f"malformed JPEG in batch (rc={rc})")
+        outs.append(np.empty((h.value, w.value, c.value), np.uint8))
+    data_ptrs = (ctypes.c_void_p * n)(*[b.ctypes.data for b in bufs])
+    lens = (ctypes.c_int64 * n)(*[b.size for b in bufs])
+    out_ptrs = (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+    out_lens = (ctypes.c_int64 * n)(*[o.size for o in outs])
+    rcs = (ctypes.c_int * n)()
+    lib.dls_jpeg_decode_batch(data_ptrs, lens, out_ptrs, out_lens, n, rcs)
+    for i in range(n):
+        if rcs[i] == _JPEG_UNSUPPORTED:
+            raise JpegUnsupported(f"non-baseline JPEG at batch index {i}")
+        if rcs[i] != _JPEG_OK:
+            raise ValueError(f"malformed JPEG at batch index {i} (rc={rcs[i]})")
+    return outs
 
 
 def sum_into(dst: np.ndarray, src: np.ndarray) -> np.ndarray:

@@ -27,6 +27,9 @@ def main() -> None:
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--warmup", type=int, default=100)
     p.add_argument("--corpus", default=None, help="text file (one doc per line); synthetic if unset")
+    p.add_argument("--data-dir", default=None,
+                   help="Wikipedia dump: mediawiki .xml(.bz2), wikiextractor "
+                        "tree, or plain-text dir (config 3's real feed)")
     p.add_argument("--vocab", default=None, help="vocab file; trained from corpus if unset")
     args = p.parse_args()
 
@@ -34,7 +37,10 @@ def main() -> None:
     spark = Session.builder.master(args.master or "auto").appName("bert-mlm").getOrCreate()
     print(spark)
 
-    if args.corpus:
+    if args.data_dir:
+        docs = text_lib.wikipedia_dump(
+            args.data_dir, num_partitions=max(spark.default_parallelism, 1))
+    elif args.corpus:
         with open(args.corpus) as f:
             lines = [ln.rstrip("\n") for ln in f if ln.strip()]
         docs = PartitionedDataset.parallelize(lines, spark.default_parallelism)
@@ -44,7 +50,10 @@ def main() -> None:
     if args.vocab:
         tok = text_lib.WordPieceTokenizer.load(args.vocab)
     else:
-        tok = text_lib.WordPieceTokenizer.train(docs.collect(), vocab_size=8192)
+        # vocab pass over (a sample of) the corpus — the reference's
+        # equivalent is a driver-side vocab build before the training job
+        sample = docs.take(20000) if args.data_dir else docs.collect()
+        tok = text_lib.WordPieceTokenizer.train(sample, vocab_size=8192)
 
     ds = text_lib.mlm_dataset(docs, tok, seq_len=args.seq_len).repeat()
 

@@ -29,6 +29,8 @@ def main() -> None:
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--expert-shards", type=int, default=1,
                    help="ways to row-shard the embedding table (expert mesh axis)")
+    p.add_argument("--data-dir", default=None,
+                   help="Criteo TSV file or directory of day_* shards; synthetic if unset")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -41,10 +43,18 @@ def main() -> None:
     print(spark)
 
     vocabs = (args.vocab_size,) * args.num_sparse
-    ds = synthetic_criteo(
-        args.batch_size * 64, vocab_sizes=vocabs,
-        num_partitions=max(spark.default_parallelism, 1),
-    ).repeat()
+    if args.data_dir:
+        from distributeddeeplearningspark_tpu.data.sources import criteo_tsv
+
+        ds = criteo_tsv(
+            args.data_dir, vocab_sizes=vocabs,
+            num_partitions=max(spark.default_parallelism, 1),
+        ).repeat()
+    else:
+        ds = synthetic_criteo(
+            args.batch_size * 64, vocab_sizes=vocabs,
+            num_partitions=max(spark.default_parallelism, 1),
+        ).repeat()
 
     if args.model == "dlrm":
         model = DLRM(vocab_sizes=vocabs, embed_dim=args.embed_dim,

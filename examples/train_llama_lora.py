@@ -41,6 +41,12 @@ def main() -> None:
     p.add_argument("--tensor", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="context-parallel axis size (ring attention shards the sequence)")
+    p.add_argument("--pipeline", type=int, default=1,
+                   help="pipeline-parallel axis size (GPipe stages over scanned layers)")
+    p.add_argument("--microbatches", type=int, default=0,
+                   help="pipeline microbatches per step (default: the pipe degree)")
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="gradient-accumulation micro-steps per optimizer step")
     p.add_argument("--corpus", default=None, help="text file (one doc per line); synthetic if unset")
     p.add_argument("--tokenizer", default=None,
                    help="HF tokenizer dir matching --weights (required with --weights: "
@@ -57,6 +63,7 @@ def main() -> None:
         Session.builder.master(args.master or "auto").appName("llama-lora")
         .config("mesh.data", 1).config("mesh.fsdp", args.fsdp)
         .config("mesh.tensor", args.tensor).config("mesh.seq", args.seq_parallel)
+        .config("mesh.pipe", args.pipeline)
         .getOrCreate()
     )
     print(spark)
@@ -103,8 +110,13 @@ def main() -> None:
         ),
         lora_trainable,
     )
-    trainer = Trainer(spark, model, losses.causal_lm, tx, rules=llama_rules(cfg),
-                      context_parallel=args.seq_parallel > 1)
+    trainer = Trainer(
+        spark, model, losses.causal_lm, tx,
+        rules=llama_rules(cfg, pipeline=args.pipeline > 1),
+        context_parallel=args.seq_parallel > 1,
+        accum_steps=args.accum_steps,
+        pipeline_microbatches=args.microbatches or None,
+    )
     trainer.init(trainer._sample_batch(ds, args.batch_size))
     if args.weights:
         trainer.load_pretrained(llama_io.load_llama_safetensors(args.weights, cfg))

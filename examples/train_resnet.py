@@ -26,6 +26,8 @@ def main() -> None:
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--data-dir", default=None,
+                   help="ImageNet root (class-per-subdir of JPEGs); synthetic if unset")
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace window into this dir")
@@ -39,12 +41,19 @@ def main() -> None:
     spark = Session.builder.master(args.master or "auto").appName("resnet-imagenet").getOrCreate()
     print(spark)
 
-    ds = synthetic_images(
-        args.batch_size * max(args.steps, 1),
-        image_size=args.image_size,
-        num_classes=args.num_classes,
-        num_partitions=max(spark.default_parallelism, 1),
-    )
+    if args.data_dir:
+        from distributeddeeplearningspark_tpu.data.sources import imagenet_folder
+
+        ds = imagenet_folder(
+            args.data_dir, num_partitions=max(spark.default_parallelism, 1)
+        ).repeat()
+    else:
+        ds = synthetic_images(
+            args.batch_size * max(args.steps, 1),
+            image_size=args.image_size,
+            num_classes=args.num_classes,
+            num_partitions=max(spark.default_parallelism, 1),
+        )
     ds = vision.imagenet_train(ds, size=args.image_size)
 
     model = (ResNet50 if args.variant == "resnet50" else ResNet18)(num_classes=args.num_classes)

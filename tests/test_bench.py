@@ -1,0 +1,90 @@
+"""bench.py resilience (VERDICT r1 weak-#1): the harness must survive a dead
+backend and emit structured JSON, never a traceback."""
+
+import json
+import subprocess
+import sys
+
+import bench
+
+
+def test_probe_timeout_and_failure_are_contained(monkeypatch):
+    """A hanging probe subprocess is killed at the timeout and logged."""
+    calls = {"n": 0}
+
+    def fake_run(*a, **kw):
+        calls["n"] += 1
+        raise subprocess.TimeoutExpired(cmd=a[0], timeout=kw["timeout"])
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    ok, errors = bench.probe_backend(attempts=3, timeout_s=0.01, backoff_s=0.0)
+    assert not ok
+    assert calls["n"] == 3
+    assert len(errors) == 3 and all("hung" in e for e in errors)
+
+
+def test_probe_rc_failure_recorded(monkeypatch):
+    def fake_run(*a, **kw):
+        return subprocess.CompletedProcess(
+            a[0], 1, stdout="", stderr="UNAVAILABLE: TPU backend setup error\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    ok, errors = bench.probe_backend(attempts=2, timeout_s=1, backoff_s=0.0)
+    assert not ok and len(errors) == 2
+    assert "UNAVAILABLE" in errors[0]
+
+
+def test_probe_success_short_circuits(monkeypatch):
+    def fake_run(*a, **kw):
+        return subprocess.CompletedProcess(a[0], 0, stdout="tpu v5 1\n", stderr="")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    ok, errors = bench.probe_backend(attempts=3, timeout_s=1, backoff_s=0.0)
+    assert ok and errors == []
+
+
+def test_backend_unavailable_emits_structured_json(monkeypatch, capsys):
+    """Main with a dead backend: rc 0 and one parseable JSON line (this is
+    exactly the r1 failure mode that produced BENCH_r01.json rc=1)."""
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda **kw: (False, ["probe 1/3: hung past 150s (killed)"]))
+    rc = bench.main([])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "backend_unavailable"
+    assert rec["value"] == 0.0 and rec["vs_baseline"] == 0.0
+    assert rec["extra"]["errors"]
+
+
+def test_bench_failure_in_one_model_does_not_kill_the_other(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "probe_backend", lambda **kw: (True, []))
+    monkeypatch.setattr(bench, "bench_resnet",
+                        lambda iters, **kw: {"images_per_sec_per_chip": 123.0,
+                                             "mfu": 0.5, "step_time_ms": 1.0,
+                                             "batch_size": 8, "chips": 1})
+
+    def boom(iters, **kw):
+        raise RuntimeError("RESOURCE_EXHAUSTED: OOM")
+
+    monkeypatch.setattr(bench, "bench_bert", boom)
+    monkeypatch.setattr(bench, "pallas_smoke", lambda: {"causal_d128": "ok"})
+    rc = bench.main([])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "resnet50_images_per_sec_per_chip"
+    assert rec["value"] == 123.0
+    assert any("OOM" in e for e in rec["extra"]["errors"])
+    assert rec["extra"]["pallas_smoke"] == {"causal_d128": "ok"}
+
+
+def test_bench_cli_is_importable_and_parses():
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import bench; bench.main(['--model', 'resnet', '--iters', '1', "
+         "'--skip-probe', '--skip-smoke', '--batch', '0'])"],
+        capture_output=True, text=True, timeout=5, cwd=".",
+        env={"PATH": "/usr/bin:/bin"}, check=False)
+    # we only check it fails on MISSING JAX (env stripped), not argparse —
+    # i.e. the CLI surface parses before any heavy import
+    assert "usage:" not in out.stderr

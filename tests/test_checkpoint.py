@@ -133,3 +133,37 @@ def test_trainer_resume_matches_uninterrupted_run(tmp_path):
 
     assert int(jax.device_get(state_r.step)) == 6
     _assert_trees_equal(_host_tree(state6.params), _host_tree(state_r.params))
+
+
+def test_resume_batch_size_mismatch_rejected(tmp_path):
+    """ADVICE r1: resuming with a different batch_size would fast-forward to
+    the wrong stream position — must raise, not silently misalign."""
+    rng = np.random.default_rng(3)
+    examples = [
+        {"image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+         "label": np.int32(i % 10)}
+        for i in range(64)
+    ]
+    sess = Session.builder.master("local[2]").getOrCreate()
+    ds = PartitionedDataset.parallelize(examples, 2)
+    t = Trainer(sess, LeNet5(), losses.softmax_xent, optax.sgd(0.1))
+    with pytest.raises(ValueError, match="batch_size mismatch"):
+        t.fit(ds.repeat(), batch_size=32, steps=4, log_every=100,
+              data_state={"examples_seen": 64, "batch_size": 16})
+
+
+def test_resume_exhausted_feed_raises(tmp_path):
+    """ADVICE r1: if the fast-forward skip consumes the whole (finite)
+    dataset, fit() must raise instead of returning zero-step success."""
+    rng = np.random.default_rng(4)
+    examples = [
+        {"image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+         "label": np.int32(i % 10)}
+        for i in range(32)
+    ]
+    sess = Session.builder.master("local[2]").getOrCreate()
+    ds = PartitionedDataset.parallelize(examples, 2)
+    t = Trainer(sess, LeNet5(), losses.softmax_xent, optax.sgd(0.1))
+    with pytest.raises(RuntimeError, match="fast-forward"):
+        t.fit(ds, batch_size=16, steps=100, log_every=100,
+              data_state={"examples_seen": 64, "batch_size": 16})

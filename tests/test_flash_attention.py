@@ -5,20 +5,41 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributeddeeplearningspark_tpu.ops.attention import _xla_attention
+from distributeddeeplearningspark_tpu.ops.attention import (
+    _pick_impl,
+    _xla_attention,
+    padding_mask,
+)
 from distributeddeeplearningspark_tpu.ops.flash_attention import flash_attention
 
 
-def _qkv(b=2, s=128, h=2, d=32, seed=0, dtype=np.float32):
+def _qkv(b=2, s=128, h=2, d=32, seed=0, dtype=np.float32, hkv=None):
     rng = np.random.default_rng(seed)
-    mk = lambda: jnp.asarray(rng.normal(0, 1, (b, s, h, d)).astype(dtype))
-    return mk(), mk(), mk()
+    mk = lambda hh: jnp.asarray(rng.normal(0, 1, (b, s, hh, d)).astype(dtype))
+    hkv = hkv or h
+    return mk(h), mk(hkv), mk(hkv)
+
+
+def _pad_mask(b, s, valid, seed=0):
+    """[B, S] 1/0 attention mask with `valid` real tokens per row."""
+    am = np.zeros((b, s), np.int32)
+    am[:, :valid] = 1
+    return jnp.asarray(am)
+
+
+def _dense(q, k, v, *, mask=None, causal=False):
+    """XLA reference; expands GQA KV heads the reference way (repeat)."""
+    h, hkv = q.shape[2], k.shape[2]
+    if h != hkv:
+        k = jnp.repeat(k, h // hkv, axis=2)
+        v = jnp.repeat(v, h // hkv, axis=2)
+    return _xla_attention(q, k, v, bias=None, mask=mask, causal=causal, scale=None)
 
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_matches_dense(causal):
     q, k, v = _qkv()
-    want = _xla_attention(q, k, v, bias=None, mask=None, causal=causal, scale=None)
+    want = _dense(q, k, v, causal=causal)
     got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
@@ -32,8 +53,7 @@ def test_flash_gradients_match_dense(causal):
                                        block_q=32, block_k=32) ** 2)
 
     def loss_dense(q, k, v):
-        return jnp.sum(_xla_attention(q, k, v, bias=None, mask=None,
-                                      causal=causal, scale=None) ** 2)
+        return jnp.sum(_dense(q, k, v, causal=causal) ** 2)
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
@@ -42,21 +62,131 @@ def test_flash_gradients_match_dense(causal):
                                    atol=2e-4, rtol=2e-4)
 
 
+# -- key-padding masks (the BERT case: VERDICT r1 item 2) --------------------
+
+@pytest.mark.parametrize("mask_shape", ["bs", "b11s"])
+def test_flash_padding_mask_matches_dense(mask_shape):
+    b, s = 2, 128
+    q, k, v = _qkv(b=b, s=s)
+    am = _pad_mask(b, s, valid=80)
+    mask = am if mask_shape == "bs" else padding_mask(am)
+    want = _dense(q, k, v, mask=padding_mask(am))
+    got = flash_attention(q, k, v, mask=mask, block_q=64, block_k=64)
+    # padded *query* rows still attend (masked in the loss downstream); all
+    # rows must agree since the mask is key-only
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_padding_mask_gradients_match_dense():
+    b, s = 1, 64
+    q, k, v = _qkv(b=b, s=s, h=2, d=16, seed=5)
+    am = _pad_mask(b, s, valid=40)
+    # weight like a real loss: only valid query rows contribute
+    w = jnp.asarray(np.asarray(am), jnp.float32)[:, :, None, None]
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, mask=am, block_q=32, block_k=32)
+        return jnp.sum((o * w) ** 2)
+
+    def loss_dense(q, k, v):
+        o = _dense(q, k, v, mask=padding_mask(am))
+        return jnp.sum((o * w) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_fully_masked_key_block_no_nan():
+    # valid tokens confined to the first of two key blocks: the second block
+    # is fully masked for every row and must contribute exactly nothing
+    b, s = 1, 64
+    q, k, v = _qkv(b=b, s=s, h=1, d=16, seed=9)
+    am = _pad_mask(b, s, valid=32)
+    got = flash_attention(q, k, v, mask=am, block_q=32, block_k=32)
+    assert np.isfinite(np.asarray(got)).all()
+    want = _dense(q, k, v, mask=padding_mask(am))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_rejects_query_varying_mask():
+    q, k, v = _qkv(s=64)
+    with pytest.raises(NotImplementedError, match="key-only"):
+        flash_attention(q, k, v, mask=jnp.ones((2, 1, 64, 64), bool))
+
+
+# -- GQA (grouped KV without jnp.repeat: VERDICT r1 item 2) ------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_matches_dense(causal):
+    q, k, v = _qkv(b=2, s=128, h=4, hkv=2, d=32, seed=11)
+    want = _dense(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gqa_gradients_match_dense():
+    q, k, v = _qkv(b=1, s=64, h=4, hkv=2, d=16, seed=13)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=32, block_k=32) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_gqa_masked_causal_combined():
+    b, s = 2, 64
+    q, k, v = _qkv(b=b, s=s, h=4, hkv=2, d=16, seed=17)
+    am = _pad_mask(b, s, valid=48)
+    want = _dense(q, k, v, mask=padding_mask(am), causal=True)
+    got = flash_attention(q, k, v, mask=am, causal=True, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gqa_bad_head_ratio_rejected():
+    q, k, v = _qkv(b=1, s=64, h=4, hkv=3, d=16)
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, k, v)
+
+
+# -- auto impl selection -----------------------------------------------------
+
+def test_pick_impl_routes_bert_and_gqa_on_tpu(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    q = jnp.zeros((2, 512, 12, 64))        # BERT-base: S=512, d=64
+    kv = jnp.zeros((2, 512, 12, 64))
+    bert_mask = padding_mask(jnp.ones((2, 512), jnp.int32))
+    assert _pick_impl(q, kv, None, bert_mask) == "flash"
+    # GQA llama: 8 q heads / 2 kv heads, long seq
+    q2 = jnp.zeros((1, 1024, 8, 128))
+    kv2 = jnp.zeros((1, 1024, 2, 128))
+    assert _pick_impl(q2, kv2, None, None) == "flash"
+    # q-varying mask → xla
+    assert _pick_impl(q, kv, None, jnp.ones((2, 1, 512, 512), bool)) == "xla"
+    # bias → xla
+    assert _pick_impl(q, kv, None, None) == "flash"
+    assert _pick_impl(q, kv, jnp.zeros((2, 12, 512, 512)), None) == "xla"
+
+
 def test_flash_uneven_blocks_rejected():
     q, k, v = _qkv(s=96)
     with pytest.raises(ValueError, match="divide"):
         flash_attention(q, k, v, block_q=64, block_k=64)
 
 
-def test_flash_rejects_mask():
-    q, k, v = _qkv(s=64)
-    with pytest.raises(NotImplementedError):
-        flash_attention(q, k, v, mask=jnp.ones((2, 1, 1, 64), bool))
-
-
 def test_flash_bf16_close_to_f32_reference():
     q, k, v = _qkv(s=64, d=32, seed=7)
-    want = _xla_attention(q, k, v, bias=None, mask=None, causal=True, scale=None)
+    want = _dense(q, k, v, causal=True)
     got = flash_attention(*(x.astype(jnp.bfloat16) for x in (q, k, v)),
                           causal=True, block_q=32, block_k=32)
     np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want),

@@ -94,3 +94,25 @@ def test_eval_transform_uint8_standardizes():
     assert out.shape == (8, 8, 3) and np.abs(out).max() < 5.0
     out_same = tf({"image": _rand_u8((8, 8, 3), seed=9)})["image"]
     assert np.abs(out_same).max() < 5.0
+
+
+def test_crop_origin_bounds_checked():
+    """ADVICE r1: invalid crop origins must raise, not heap-overread in C++."""
+    imgs = _rand_u8((2, 12, 16, 3))
+    flips = np.zeros(2, np.uint8)
+    mean, std = vision.IMAGENET_MEAN, vision.IMAGENET_STD
+    # y origin too large: 5 + 8 > 12
+    with pytest.raises(ValueError, match="out of bounds"):
+        native.crop_flip_normalize_batch(
+            imgs, np.array([0, 5], np.int32), np.zeros(2, np.int32), flips,
+            (8, 10), mean, std)
+    # negative x origin
+    with pytest.raises(ValueError, match="out of bounds"):
+        native.crop_flip_normalize_batch(
+            imgs, np.zeros(2, np.int32), np.array([-1, 0], np.int32), flips,
+            (8, 10), mean, std)
+    # crop larger than image
+    with pytest.raises(ValueError, match="exceeds"):
+        native.crop_flip_normalize_batch(
+            imgs, np.zeros(2, np.int32), np.zeros(2, np.int32), flips,
+            (13, 10), mean, std)

@@ -66,3 +66,114 @@ def test_pipeline_validates_inputs(eight_devices):
     with pytest.raises(ValueError, match="divide"):
         pipeline(_stage_fn, jnp.zeros((4, 4, 4)), jnp.zeros((7, 4)),
                  mesh=mesh, num_microbatches=2)
+
+
+# -- end-to-end PP integration (VERDICT r1 next-#5) --------------------------
+
+def _llama_batch(n, s, vocab, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": rng.integers(0, vocab, (n, s)).astype(np.int32),
+        "loss_mask": np.ones((n, s), np.float32),
+    }
+
+
+def test_pp_llama_loss_equals_non_pp(eight_devices):
+    """One train step of pipelined Llama == non-PP Llama: identical init,
+    identical data, same loss and same updated params (fp tol)."""
+    import optax
+
+    from distributeddeeplearningspark_tpu.data.feed import put_global
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig, LlamaForCausalLM, llama_rules,
+    )
+    from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+
+    cfg = LlamaConfig.tiny()  # scan_layers=True, remat=True, 4 layers
+    model = LlamaForCausalLM(cfg)
+    batch = _llama_batch(8, 32, cfg.vocab_size, seed=3)
+    tx = optax.adamw(1e-3)
+
+    from distributeddeeplearningspark_tpu.models.llama_pp import make_pp_apply
+
+    results = {}
+    for mode in ("pp", "dp"):
+        if mode == "pp":
+            mesh = MeshSpec(data=2, pipe=2).build(jax.devices()[:4])
+            rules = llama_rules(cfg, fsdp=False, pipeline=True)
+            apply_fn = make_pp_apply(cfg, mesh, 2)
+        else:
+            mesh = MeshSpec(data=4).build(jax.devices()[:4])
+            rules = llama_rules(cfg, fsdp=False)
+            apply_fn = model.apply
+        state, shardings = step_lib.init_state(model, tx, batch, mesh, rules, seed=7)
+        step = step_lib.jit_train_step(
+            step_lib.make_train_step(apply_fn, tx, losses.causal_lm),
+            mesh, shardings,
+        )
+        new_state, metrics = step(state, put_global(batch, mesh))
+        results[mode] = (
+            jax.device_get(metrics), jax.device_get(new_state.params),
+        )
+
+    m_pp, p_pp = results["pp"]
+    m_dp, p_dp = results["dp"]
+    np.testing.assert_allclose(m_pp["loss"], m_dp["loss"], rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        p_pp, p_dp,
+    )
+
+
+def test_pp_layer_params_sharded_over_pipe(eight_devices):
+    """pipeline=True rules put every stacked layer param on its stage's
+    devices (PP as depth-wise param partitioning)."""
+    import optax
+
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig, LlamaForCausalLM, llama_rules,
+    )
+    from distributeddeeplearningspark_tpu.train import step as step_lib
+
+    cfg = LlamaConfig.tiny(lora_rank=2)
+    mesh = MeshSpec(data=2, pipe=2).build(jax.devices()[:4])
+    model = LlamaForCausalLM(cfg)
+    batch = _llama_batch(4, 16, cfg.vocab_size)
+    state, shardings = step_lib.init_state(
+        model, optax.sgd(0.1), batch, mesh, llama_rules(cfg, fsdp=False, pipeline=True))
+    flat = jax.tree_util.tree_flatten_with_path(shardings.params)[0]
+    layer_leaves = [(p, s) for p, s in flat if "layers" in str(p[0])]
+    assert layer_leaves
+    for path, sh in layer_leaves:
+        assert "pipe" in str(sh.spec), f"{path} not pipe-sharded: {sh.spec}"
+
+
+def test_trainer_pp_fit(eight_devices):
+    """Trainer on a data x pipe mesh trains Llama end-to-end via the PP path."""
+    import optax
+
+    from distributeddeeplearningspark_tpu import Session, Trainer
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig, LlamaForCausalLM, llama_rules,
+    )
+    from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+    from distributeddeeplearningspark_tpu.train import losses
+
+    spark = (Session.builder.master("local[2]")
+             .config("mesh.pipe", "2").getOrCreate())
+    assert spark.mesh.shape["pipe"] == 2
+    cfg = LlamaConfig.tiny()
+    examples = [
+        {"input_ids": np.random.default_rng(i).integers(
+            0, cfg.vocab_size, (32,)).astype(np.int32),
+         "loss_mask": np.ones((32,), np.float32)}
+        for i in range(64)
+    ]
+    ds = PartitionedDataset.parallelize(examples, 2)
+    trainer = Trainer(spark, LlamaForCausalLM(cfg), losses.causal_lm,
+                      optax.adamw(1e-3),
+                      rules=llama_rules(cfg, fsdp=False, pipeline=True),
+                      pipeline_microbatches=2)
+    state, summary = trainer.fit(ds.repeat(), batch_size=8, steps=3, log_every=10)
+    assert int(jax.device_get(state.step)) == 3
+    assert np.isfinite(summary["loss"])

@@ -77,3 +77,36 @@ def test_supervisor_gives_up_after_max_restarts():
 def test_result_shapes():
     r = SupervisorResult(attempts=[])
     assert not r.ok and r.restarts == 0
+
+
+def test_startup_grace_defaults_to_5x_hang_timeout():
+    """ADVICE r1: first-checkpoint latency (compile + warmup) must not be
+    judged by the steady-state hang timeout."""
+    s = Supervisor(["true"], hang_timeout_s=2.0)
+    assert s.startup_grace_s == 10.0
+    s2 = Supervisor(["true"], hang_timeout_s=2.0, startup_grace_s=30.0)
+    assert s2.startup_grace_s == 30.0
+    assert Supervisor(["true"]).startup_grace_s is None
+
+
+def test_heartbeat_file_counts_as_progress(tmp_path):
+    """ADVICE r1: a worker stamping DLS_HEARTBEAT_FILE between checkpoints
+    must not be judged hung by the watchdog."""
+    # worker: stamps the heartbeat every 0.2s for 2.5s, never checkpoints
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, time\n"
+        "hb = os.environ['DLS_HEARTBEAT_FILE']\n"
+        "for _ in range(12):\n"
+        "    open(hb, 'w').write('x')\n"
+        "    time.sleep(0.2)\n"
+    )
+    # hang_timeout (steady state) is far shorter than the worker's runtime,
+    # so only the heartbeats keep it alive; startup grace stays generous —
+    # python startup in this sandbox alone takes >1s (site hooks)
+    s = Supervisor([sys.executable, str(script)], num_processes=1,
+                   max_restarts=0, hang_timeout_s=1.0, startup_grace_s=30.0,
+                   progress_path=str(tmp_path / "ckpt-does-not-exist"))
+    result = s.run()
+    assert result.ok, f"healthy heartbeating worker was killed: {result}"
+    assert result.attempts[0].returncodes == [0]

@@ -126,3 +126,50 @@ def test_eval_step_runs_without_dropout(eight_devices):
     m = trainer.evaluate(ds, batch_size=32)
     assert 0.0 <= m["accuracy"] <= 1.0
     assert np.isfinite(m["loss"])
+
+
+def test_evaluate_counts_tail_batch_exactly(eight_devices):
+    """VERDICT r1: eval on a non-divisible set must equal one full-batch pass
+    (the tail used to be silently dropped)."""
+    spark = Session.builder.master("local[2]").getOrCreate()
+    # 80 examples, batch 32 → 32 + 32 + 16-tail
+    ds = synthetic_mnist(num_examples=80, num_partitions=2, seed=21)
+    trainer = Trainer(spark, LeNet5(), losses.softmax_xent, optax.sgd(0.1))
+    trainer.init(stack_examples(ds.take(4)))
+
+    got = trainer.evaluate(ds, batch_size=32)
+    want = trainer.evaluate(ds, batch_size=80)  # one full batch, trivially exact
+    np.testing.assert_allclose(got["loss"], want["loss"], rtol=1e-5)
+    np.testing.assert_allclose(got["accuracy"], want["accuracy"], rtol=1e-5)
+
+
+def test_evaluate_weight_metric_aggregation(eight_devices):
+    """Token-weighted losses aggregate by their reported weight, so unequal
+    mask counts across batches still reduce to the exact global mean."""
+    import jax.numpy as jnp
+
+    from distributeddeeplearningspark_tpu.data.feed import put_global as _pg
+    from distributeddeeplearningspark_tpu.models import bert_tiny
+    from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+    spark = Session.builder.master("local[2]").getOrCreate()
+    rng = np.random.default_rng(5)
+    seq, vocab = 16, 1024
+    examples = []
+    for i in range(24):  # batch 16 → one full batch + 8-tail
+        ids = rng.integers(0, vocab, (seq,)).astype(np.int32)
+        w = np.zeros((seq,), np.float32)
+        w[: rng.integers(1, 6)] = 1.0  # unequal mask counts per example
+        examples.append({
+            "input_ids": ids,
+            "attention_mask": np.ones((seq,), np.int32),
+            "mlm_labels": ids,
+            "mlm_weights": w,
+        })
+    ds = PartitionedDataset.parallelize(examples, 2)
+    trainer = Trainer(spark, bert_tiny(), losses.masked_lm, optax.sgd(0.1))
+    trainer.init(stack_examples(ds.take(4)))
+    got = trainer.evaluate(ds, batch_size=16)
+    want = trainer.evaluate(ds, batch_size=24)
+    np.testing.assert_allclose(got["loss"], want["loss"], rtol=1e-5)
+    np.testing.assert_allclose(got["mlm_accuracy"], want["mlm_accuracy"], rtol=1e-5)
