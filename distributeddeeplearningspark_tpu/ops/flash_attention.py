@@ -38,6 +38,15 @@ Layout: [B, S, H, D] (BSHD) at the API, flattened to [B·H, S, D] /
 [B·Hkv, S, D] for the kernels (head-major order, so consecutive q rows share
 a kv row).
 
+Mosaic tiling contract (verified on a real v5e chip — the interpret-mode
+tests cannot catch this): the last two dims of every block must each be
+divisible by (8, 128) or equal the full array dim. Row-statistics (LSE,
+delta) therefore travel as [B·H, S, 8] — values replicated across a
+trailing size-8 dim that equals the array dim (legal) while costing 16×
+less HBM than the 128-lane layout the stock jax kernel uses — and the
+key-padding mask travels lane-oriented as [B, 1, Sk] so a [block_k] slice
+lands in the lane dim of the score block.
+
 Shape contract (checked): S divisible by the block sizes; D a multiple of 8
 (Mosaic pads the lane dim; 128-multiples are fastest, BERT's 64 is fine).
 """
@@ -55,6 +64,10 @@ from jax.experimental import pallas as pl
 # exp(_MASK_VALUE - m) underflows to 0 for any real row max m.
 _MASK_VALUE = -1e30
 DEFAULT_BLOCK = 512
+#: trailing dim for row-statistics (LSE/delta) arrays: the Mosaic block rule
+#: ("divisible by (8, 128) or equal to the array dim") is satisfied by making
+#: the minor dim exactly 8 and always blocking it whole.
+STAT_LANES = 8
 
 
 def _vmem():
@@ -88,9 +101,9 @@ def _fwd_kernel(*refs, scale: float, causal: bool, has_mask: bool,
                 num_kb: int, block_q: int, block_k: int):
     q_ref, k_ref, v_ref = refs[:3]            # [1, Bq, D], [1, Bk, D]
     i = 3
-    mask_ref = refs[i] if has_mask else None  # [1, Bk] int8
+    mask_ref = refs[i] if has_mask else None  # [1, 1, Bk] int32 (lane-major)
     i += int(has_mask)
-    o_ref, lse_ref = refs[i], refs[i + 1]     # [1, Bq, D], [1, Bq]
+    o_ref, lse_ref = refs[i], refs[i + 1]     # [1, Bq, D], [1, Bq, STAT]
     acc_ref, m_ref, l_ref = refs[i + 2:]      # VMEM scratch
     qb, kb = pl.program_id(1), pl.program_id(2)
 
@@ -107,7 +120,7 @@ def _fwd_kernel(*refs, scale: float, causal: bool, has_mask: bool,
                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
         s, allowed = _block_mask(
             qb, kb, s, causal=causal,
-            mask_blk=mask_ref[0] if has_mask else None,
+            mask_blk=mask_ref[0, 0] if has_mask else None,
             block_q=block_q, block_k=block_k)
         m_prev = m_ref[:, 0]                              # [Bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -136,7 +149,8 @@ def _fwd_kernel(*refs, scale: float, causal: bool, has_mask: bool,
         # _MASK_VALUE — the backward kernels re-zero p under the mask anyway
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:, 0] + jnp.log(l_safe)
+        lse = m_ref[:, 0] + jnp.log(l_safe)
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, kv_mask, *, scale, causal, group, block_q, block_k,
@@ -158,9 +172,10 @@ def _flash_fwd(q, k, v, kv_mask, *, scale, causal, group, block_q, block_k,
     ]
     operands = [q, k, v]
     if has_mask:
+        # lane-oriented [B, 1, Sk]: a [block_k] slice lands in the lane dim
         in_specs.append(
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // heads, j)))
-        operands.append(kv_mask)
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // heads, 0, j)))
+        operands.append(kv_mask[:, None, :])
     vmem = _vmem()
     o, lse = pl.pallas_call(
         kernel,
@@ -168,11 +183,11 @@ def _flash_fwd(q, k, v, kv_mask, *, scale, causal, group, block_q, block_k,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, STAT_LANES), jnp.float32),
         ],
         scratch_shapes=[
             vmem((block_q, d), jnp.float32),    # acc
@@ -181,7 +196,7 @@ def _flash_fwd(q, k, v, kv_mask, *, scale, causal, group, block_q, block_k,
         ],
         interpret=interpret,
     )(*operands)
-    return o, lse
+    return o, lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -208,16 +223,16 @@ def _bwd_dq_kernel(*refs, scale: float, causal: bool, has_mask: bool,
                                 preferred_element_type=jnp.float32)
         s, allowed = _block_mask(
             qb, kb, s, causal=causal,
-            mask_blk=mask_ref[0] if has_mask else None,
+            mask_blk=mask_ref[0, 0] if has_mask else None,
             block_q=block_q, block_k=block_k)
-        p = jnp.exp(s - lse_ref[0][:, None])                       # [Bq, Bk]
+        p = jnp.exp(s - lse_ref[0, :, 0][:, None])                 # [Bq, Bk]
         if allowed is not None:
             p = jnp.where(allowed, p, 0.0)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])                      # [Bq, Bk]
+        ds = p * (dp - delta_ref[0, :, 0][:, None])                # [Bq, Bk]
         acc_ref[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     if causal:
@@ -257,9 +272,9 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, has_mask: bool,
                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
         s, allowed = _block_mask(
             qb, kb, s, causal=causal,
-            mask_blk=mask_ref[0] if has_mask else None,
+            mask_blk=mask_ref[0, 0] if has_mask else None,
             block_q=block_q, block_k=block_k)
-        p = jnp.exp(s - lse_ref[0][:, None])                       # [Bq, Bk]
+        p = jnp.exp(s - lse_ref[0, :, 0][:, None])                 # [Bq, Bk]
         if allowed is not None:
             p = jnp.where(allowed, p, 0.0)
         do = do_ref[0].astype(jnp.float32)
@@ -269,7 +284,7 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, has_mask: bool,
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0, :, 0][:, None])
         # dK += dSᵀ (Q·scale); the extra `scale` belongs to dQ only, and
         # q here already carries it — exactly the dK of s = scale·q·kᵀ
         dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
@@ -295,6 +310,12 @@ def _flash_bwd(res, g, *, scale, causal, group, block_q, block_k, interpret):
     has_mask = kv_mask is not None
     heads = bh // max(kv_mask.shape[0], 1) if has_mask else 0
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # row stats travel as [bh, s, STAT_LANES] (Mosaic block rule — see module
+    # docstring); the replication is a cheap transient, the residual is 2-D
+    stat = lambda x: jnp.broadcast_to(x[..., None], (*x.shape, STAT_LANES))
+    lse3, delta3 = stat(lse), stat(delta)
+    stat_spec = lambda ix: pl.BlockSpec((1, block_q, STAT_LANES), ix)
+    mask3 = kv_mask[:, None, :] if has_mask else None
     vmem = _vmem()
 
     in_specs_q = [
@@ -302,14 +323,14 @@ def _flash_bwd(res, g, *, scale, causal, group, block_q, block_k, interpret):
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),  # k
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),  # v
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),          # do
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),                # lse
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),                # delta
+        stat_spec(lambda b, i, j: (b, i, 0)),                              # lse
+        stat_spec(lambda b, i, j: (b, i, 0)),                              # delta
     ]
-    operands = [q, k, v, do, lse, delta]
+    operands = [q, k, v, do, lse3, delta3]
     if has_mask:
         in_specs_q.append(
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // heads, j)))
-        operands.append(kv_mask)
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // heads, 0, j)))
+        operands.append(mask3)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           has_mask=has_mask, num_kb=num_kb,
@@ -332,16 +353,14 @@ def _flash_bwd(res, g, *, scale, causal, group, block_q, block_k, interpret):
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),               # v
         pl.BlockSpec((1, block_q, d),
                      lambda b, i, j: (b * group + j // num_qb, j % num_qb, 0)),  # do
-        pl.BlockSpec((1, block_q),
-                     lambda b, i, j: (b * group + j // num_qb, j % num_qb)),    # lse
-        pl.BlockSpec((1, block_q),
-                     lambda b, i, j: (b * group + j // num_qb, j % num_qb)),    # delta
+        stat_spec(lambda b, i, j: (b * group + j // num_qb, j % num_qb, 0)),    # lse
+        stat_spec(lambda b, i, j: (b * group + j // num_qb, j % num_qb, 0)),    # delta
     ]
-    operands_kv = [q, k, v, do, lse, delta]
+    operands_kv = [q, k, v, do, lse3, delta3]
     if has_mask:
         in_specs_kv.append(
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // kvheads, i)))
-        operands_kv.append(kv_mask)
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // kvheads, 0, i)))
+        operands_kv.append(mask3)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           has_mask=has_mask, num_qb=num_qb, group=group,
@@ -416,7 +435,8 @@ def as_kv_mask(mask, batch: int, sk: int):
         raise ValueError(f"mask key dim {m.shape[-1]} != seq {sk}")
     if m.shape[0] == 1 and batch > 1:
         m = jnp.broadcast_to(m, (batch, sk))
-    return m.astype(jnp.int8)
+    # int32: native VPU lane width — int8 would hit the (32, 128) tile rule
+    return m.astype(jnp.int32)
 
 
 def flash_attention(
@@ -458,6 +478,18 @@ def flash_attention(
         raise ValueError(f"seq len {sq} must divide by blocks ({block_q}, {block_k})")
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
+    if not interpret:
+        # Mosaic block rule: second-to-minor dim divisible by 8 (or whole),
+        # minor (lane) dim divisible by 128 (or whole). block_q/block_k sit in
+        # the sublane dim of the q/k/v blocks; block_k additionally lands in
+        # the LANE dim of the mask block [1, 1, block_k] when a mask is given.
+        if block_q % 8 and block_q != sq:
+            raise ValueError(f"TPU requires block_q % 8 == 0, got {block_q}")
+        if block_k % 8 and block_k != sq:
+            raise ValueError(f"TPU requires block_k % 8 == 0, got {block_k}")
+        if kv_mask is not None and block_k % 128 and block_k != sq:
+            raise ValueError(
+                f"TPU requires block_k % 128 == 0 with a mask, got {block_k}")
     scale = scale if scale is not None else d**-0.5
 
     # BSHD → [B·H, S, D] for the kernels (head-major: q row r ↔ kv row r//group)

@@ -13,6 +13,10 @@ import os
 import signal
 import sys
 
+# Launched as a bare script (sys.path[0] = tests/workers), so the package
+# under test must be made importable regardless of cwd/PYTHONPATH.
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax
