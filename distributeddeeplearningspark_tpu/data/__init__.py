@@ -6,6 +6,19 @@ from distributeddeeplearningspark_tpu.data.feed import (
     put_global,
     stack_examples,
 )
+from distributeddeeplearningspark_tpu.data.dataframe import (
+    Column,
+    DataFrame,
+    DataFrameReader,
+    col,
+    from_dataset,
+    from_rows,
+    hash_bucket,
+    lit,
+    log1p,
+    read_csv,
+    when,
+)
 from distributeddeeplearningspark_tpu.data.prefetch import prefetch_to_device
 
 __all__ = [
@@ -14,4 +27,15 @@ __all__ = [
     "put_global",
     "stack_examples",
     "prefetch_to_device",
+    "Column",
+    "DataFrame",
+    "DataFrameReader",
+    "col",
+    "from_dataset",
+    "from_rows",
+    "hash_bucket",
+    "lit",
+    "log1p",
+    "read_csv",
+    "when",
 ]

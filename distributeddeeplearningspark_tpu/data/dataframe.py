@@ -1,0 +1,593 @@
+"""DataFrame — the Spark-SQL-shaped feature-engineering plane.
+
+The reference's config 4 ("Wide&Deep / DLRM recommender on Criteo") feeds the
+trainer from *Spark DataFrame features*: ``spark.read.csv`` → ``withColumn`` /
+``fillna`` / hashing → executor partitions (SURVEY.md §2 "Data: tabular
+pipeline"; VERDICT r1 flagged the missing DataFrame surface). This module
+rebuilds that surface TPU-first:
+
+- **Columnar partitions.** A DataFrame partition is a stream of *column
+  chunks* (``dict[str, np.ndarray]``, a few thousand rows each). All
+  expressions evaluate vectorized over whole chunks — numpy is the host-side
+  vector engine standing in for Spark SQL's codegen'd JVM loops — so the
+  feature plane keeps up with the HBM feed instead of burning the host on
+  per-row Python.
+- **Lazy + partition-parallel**, riding :class:`~..rdd.PartitionedDataset`:
+  transformations compose chunk functions; actions materialize. One
+  partition ≙ one data shard, same as the RDD plane.
+- **No shuffle engine** (SURVEY.md §7 "What NOT to build"): verbs that need a
+  cross-partition exchange (joins, groupBy aggregations) are out of scope;
+  the Criteo feature pipeline — typed read, fillna, log-scaling, categorical
+  hashing, split — is narrow and fully covered.
+
+Expressions are :class:`Column` trees built from :func:`col` / :func:`lit`
+and composed with operators and functions (:func:`log1p`,
+:func:`hash_bucket`, ...), mirroring ``pyspark.sql.functions``.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Callable, Iterable, Iterator, Mapping, Sequence
+
+import numpy as np
+
+from ..rdd import PartitionedDataset
+
+Chunk = dict[str, np.ndarray]
+
+DEFAULT_CHUNK_ROWS = 4096
+
+
+# ---------------------------------------------------------------------------
+# Column expressions
+# ---------------------------------------------------------------------------
+
+class Column:
+    """A vectorized expression over column chunks (pyspark ``Column``-shaped).
+
+    Wraps ``fn(chunk) -> np.ndarray`` plus the output name. Operators build
+    new Columns; nothing evaluates until a DataFrame action runs.
+    """
+
+    def __init__(self, fn: Callable[[Chunk], np.ndarray], name: str):
+        self._fn = fn
+        self._name = name
+
+    def __call__(self, chunk: Chunk) -> np.ndarray:
+        return self._fn(chunk)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def alias(self, name: str) -> "Column":
+        return Column(self._fn, name)
+
+    def cast(self, dtype) -> "Column":
+        return Column(lambda c: self._fn(c).astype(dtype), self._name)
+
+    # -- operators ----------------------------------------------------------
+
+    def _bin(self, other, op, sym) -> "Column":
+        other = other if isinstance(other, Column) else lit(other)
+        return Column(lambda c: op(self._fn(c), other._fn(c)),
+                      f"({self._name} {sym} {other._name})")
+
+    def __add__(self, o): return self._bin(o, np.add, "+")
+    def __radd__(self, o): return lit(o)._bin(self, np.add, "+")
+    def __sub__(self, o): return self._bin(o, np.subtract, "-")
+    def __rsub__(self, o): return lit(o)._bin(self, np.subtract, "-")
+    def __mul__(self, o): return self._bin(o, np.multiply, "*")
+    def __rmul__(self, o): return lit(o)._bin(self, np.multiply, "*")
+    def __truediv__(self, o): return self._bin(o, np.divide, "/")
+    def __mod__(self, o): return self._bin(o, np.mod, "%")
+    def __gt__(self, o): return self._bin(o, np.greater, ">")
+    def __ge__(self, o): return self._bin(o, np.greater_equal, ">=")
+    def __lt__(self, o): return self._bin(o, np.less, "<")
+    def __le__(self, o): return self._bin(o, np.less_equal, "<=")
+    def __eq__(self, o):  # noqa: D105 — pyspark semantics: expr, not identity
+        return self._bin(o, np.equal, "==")
+    def __ne__(self, o): return self._bin(o, np.not_equal, "!=")
+    def __and__(self, o): return self._bin(o, np.logical_and, "&")
+    def __or__(self, o): return self._bin(o, np.logical_or, "|")
+    def __invert__(self): return Column(lambda c: np.logical_not(self._fn(c)),
+                                        f"(~{self._name})")
+    __hash__ = None  # unhashable, like pyspark Columns
+
+    def fillna(self, value) -> "Column":
+        """NaN (float) / '' (string) → ``value``."""
+        def fn(c: Chunk) -> np.ndarray:
+            x = self._fn(c)
+            if x.dtype.kind == "f":
+                return np.where(np.isnan(x), np.asarray(value, x.dtype), x)
+            if x.dtype.kind in ("U", "S", "O"):
+                return np.where(x == "", value, x)
+            return x
+        return Column(fn, self._name)
+
+    def isNotNull(self) -> "Column":
+        def fn(c: Chunk) -> np.ndarray:
+            x = self._fn(c)
+            if x.dtype.kind == "f":
+                return ~np.isnan(x)
+            if x.dtype.kind in ("U", "S", "O"):
+                return x != ""
+            return np.ones(len(x), bool)
+        return Column(fn, f"({self._name} IS NOT NULL)")
+
+    def isNull(self) -> "Column":
+        inner = self.isNotNull()
+        return Column(lambda c: ~inner(c), f"({self._name} IS NULL)")
+
+
+def col(name: str) -> Column:
+    def fn(chunk: Chunk) -> np.ndarray:
+        try:
+            return chunk[name]
+        except KeyError:
+            raise KeyError(
+                f"no column {name!r}; have {sorted(chunk)}") from None
+    return Column(fn, name)
+
+
+def lit(value) -> Column:
+    def fn(chunk: Chunk) -> np.ndarray:
+        n = len(next(iter(chunk.values()))) if chunk else 0
+        return np.full(n, value)
+    return Column(fn, str(value))
+
+
+def log1p(c: Column) -> Column:
+    """``log(1+x)`` with negatives clamped to 0 first — the standard Criteo
+    dense-feature transform (negatives appear in the raw dumps)."""
+    return Column(lambda ch: np.log1p(np.maximum(c(ch), 0.0)),
+                  f"log1p({c.name})")
+
+
+def clip(c: Column, lo, hi) -> Column:
+    return Column(lambda ch: np.clip(c(ch), lo, hi), f"clip({c.name})")
+
+
+def when(cond: Column, value) -> "_When":
+    return _When([(cond, value)])
+
+
+class _When:
+    """``when(cond, v).otherwise(d)`` chain (vectorized nested where)."""
+
+    def __init__(self, branches: list):
+        self._branches = branches
+
+    def when(self, cond: Column, value) -> "_When":
+        return _When(self._branches + [(cond, value)])
+
+    def otherwise(self, default) -> Column:
+        branches = self._branches
+
+        def fn(chunk: Chunk) -> np.ndarray:
+            default_c = default if isinstance(default, Column) else lit(default)
+            out = default_c(chunk)
+            for cond, value in reversed(branches):
+                value_c = value if isinstance(value, Column) else lit(value)
+                out = np.where(cond(chunk), value_c(chunk), out)
+            return out
+        return Column(fn, "CASE WHEN")
+
+
+def _hash_int_array(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer — deterministic across processes."""
+    z = x.astype(np.uint64, copy=True)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def hash_bucket(c: Column, num_buckets: int) -> Column:
+    """Stable hash → ``[0, num_buckets)`` int32 (Spark's feature hashing).
+
+    Numeric columns hash via a vectorized splitmix64; string columns via
+    crc32 (per-element, host-side — fine at feature-engineering rates).
+    Deterministic across runs and processes, unlike Python's ``hash``.
+    """
+    if num_buckets < 1:
+        raise ValueError("num_buckets must be >= 1")
+
+    def fn(chunk: Chunk) -> np.ndarray:
+        x = c(chunk)
+        if x.dtype.kind in ("i", "u"):
+            h = _hash_int_array(x)
+        elif x.dtype.kind == "f":
+            h = _hash_int_array(x.astype(np.float64).view(np.uint64))
+        else:
+            h = np.fromiter(
+                (zlib.crc32(str(s).encode()) for s in x),
+                dtype=np.uint64, count=len(x))
+            h = _hash_int_array(h)
+        return (h % np.uint64(num_buckets)).astype(np.int32)
+
+    return Column(fn, f"hash_bucket({c.name}, {num_buckets})")
+
+
+# ---------------------------------------------------------------------------
+# DataFrame
+# ---------------------------------------------------------------------------
+
+def _chunk_rows(chunk: Chunk) -> int:
+    return len(next(iter(chunk.values()))) if chunk else 0
+
+
+class DataFrame:
+    """Lazy columnar dataset: partitions stream column chunks.
+
+    Wraps a :class:`PartitionedDataset` whose elements are chunks
+    (``dict[str, np.ndarray]``); ``columns`` is the declared schema order.
+    """
+
+    def __init__(self, chunks: PartitionedDataset, columns: Sequence[str]):
+        self._chunks = chunks
+        self._columns = list(columns)
+
+    # -- schema -------------------------------------------------------------
+
+    @property
+    def columns(self) -> list[str]:
+        return list(self._columns)
+
+    @property
+    def num_partitions(self) -> int:
+        return self._chunks.num_partitions
+
+    @property
+    def rdd(self) -> PartitionedDataset:
+        """Row view: a PartitionedDataset of per-row dicts (Spark ``df.rdd``)."""
+        return self.to_dataset()
+
+    # -- transformations (lazy) ---------------------------------------------
+
+    def _map_chunks(self, f: Callable[[Chunk], Chunk],
+                    columns: Sequence[str]) -> "DataFrame":
+        return DataFrame(
+            self._chunks.map_partitions(lambda it: (f(ch) for ch in it)),
+            columns)
+
+    def select(self, *exprs: str | Column) -> "DataFrame":
+        cols = [col(e) if isinstance(e, str) else e for e in exprs]
+        names = [c.name for c in cols]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate output columns: {names}")
+        return self._map_chunks(
+            lambda ch: {c.name: np.asarray(c(ch)) for c in cols}, names)
+
+    def withColumn(self, name: str, expr: Column) -> "DataFrame":
+        names = self._columns + ([] if name in self._columns else [name])
+
+        def f(ch: Chunk) -> Chunk:
+            out = dict(ch)
+            out[name] = np.asarray(expr(ch))
+            return out
+        return self._map_chunks(f, names)
+
+    def withColumns(self, mapping: Mapping[str, Column]) -> "DataFrame":
+        """All expressions evaluate against the INPUT chunk (pyspark's
+        simultaneous semantics: ``{'a': col('b'), 'b': col('a')}`` swaps)."""
+        mapping = dict(mapping)
+        names = list(self._columns)
+        names += [n for n in mapping if n not in names]
+
+        def f(ch: Chunk) -> Chunk:
+            out = dict(ch)
+            out.update({n: np.asarray(e(ch)) for n, e in mapping.items()})
+            return out
+        return self._map_chunks(f, names)
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [c for c in self._columns if c not in names]
+        return self._map_chunks(
+            lambda ch: {k: v for k, v in ch.items() if k not in names}, keep)
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        return self.withColumn(new, col(old)).drop(old) if old != new else self
+
+    def filter(self, cond: Column) -> "DataFrame":
+        def f(ch: Chunk) -> Chunk:
+            m = cond(ch).astype(bool)
+            return {k: v[m] for k, v in ch.items()}
+        return self._map_chunks(f, self._columns)
+
+    where = filter
+
+    def fillna(self, value, subset: Sequence[str] | None = None) -> "DataFrame":
+        names = subset if subset is not None else self._columns
+        return self.withColumns({n: col(n).fillna(value) for n in names})
+
+    def randomSplit(self, weights: Sequence[float], seed: int = 0
+                    ) -> list["DataFrame"]:
+        """Split rows by a deterministic per-row hash (stable across runs,
+        unlike sampling state threaded through an iterator)."""
+        w = np.asarray(weights, np.float64)
+        if (w <= 0).any():
+            raise ValueError("weights must be positive")
+        edges = np.cumsum(w / w.sum())
+
+        def part_for(bucket_frac: np.ndarray) -> np.ndarray:
+            return np.searchsorted(edges, bucket_frac, side="right")
+
+        outs = []
+        for i in range(len(w)):
+            def f(ch: Chunk, i=i) -> Chunk:
+                n = _chunk_rows(ch)
+                # row identity: position within chunk + a per-chunk content
+                # fingerprint, so identical positions in different chunks
+                # land independently and the split is replay-stable
+                base = np.arange(n, dtype=np.uint64)
+                first = next(iter(ch.values())) if ch else base
+                fp = zlib.crc32(np.asarray(first).tobytes()) if n else 0
+                base = base + np.uint64(fp)
+                frac = (_hash_int_array(base + np.uint64(seed)) >> np.uint64(11)
+                        ).astype(np.float64) / float(1 << 53)
+                m = part_for(frac) == i
+                return {k: v[m] for k, v in ch.items()}
+            outs.append(self._map_chunks(f, self._columns))
+        return outs
+
+    def repartition(self, n: int) -> "DataFrame":
+        """Down: concatenate adjacent partitions. Up: split each partition's
+        chunk stream round-robin (each new partition re-walks its source
+        partition and keeps every k-th chunk — extra host IO, no shuffle)."""
+        cur = self.num_partitions
+        if n <= cur:
+            return DataFrame(self._chunks.coalesce(n), self._columns)
+        chunks = self._chunks
+        fan = [[] for _ in range(cur)]
+        for j in range(n):
+            fan[j % cur].append(j)
+
+        def make(k: int, slot: int, stride: int):
+            def gen() -> Iterator[Chunk]:
+                for idx, ch in enumerate(chunks.iter_partition(k)):
+                    if idx % stride == slot:
+                        yield ch
+            return gen
+
+        plan: dict[int, Any] = {}
+        for k in range(cur):
+            for slot, j in enumerate(fan[k]):
+                plan[j] = (k, slot, len(fan[k]))
+        parts = [make(*plan[j]) for j in range(n)]
+        return DataFrame(PartitionedDataset.from_generators(parts),
+                         self._columns)
+
+    # -- actions ------------------------------------------------------------
+
+    def _iter_chunks(self) -> Iterator[Chunk]:
+        for i in range(self._chunks.num_partitions):
+            yield from self._chunks.iter_partition(i)
+
+    def count(self) -> int:
+        return sum(_chunk_rows(ch) for ch in self._iter_chunks())
+
+    def take(self, n: int) -> list[dict]:
+        rows: list[dict] = []
+        for ch in self._iter_chunks():
+            for r in range(_chunk_rows(ch)):
+                rows.append({k: v[r] for k, v in ch.items()})
+                if len(rows) == n:
+                    return rows
+        return rows
+
+    def collect(self) -> list[dict]:
+        return self.take(float("inf"))  # type: ignore[arg-type]
+
+    def toPandas(self):
+        """Concatenate all chunks into one dict of arrays (no pandas in this
+        env — returns the columnar dict, which is what callers index anyway)."""
+        chunks = list(self._iter_chunks())
+        if not chunks:
+            return {c: np.empty((0,)) for c in self._columns}
+        return {c: np.concatenate([ch[c] for ch in chunks]) for c in self._columns}
+
+    def show(self, n: int = 10) -> None:
+        rows = self.take(n)
+        print(" | ".join(self._columns))
+        for r in rows:
+            print(" | ".join(str(r[c]) for c in self._columns))
+
+    # -- bridge to the feed/trainer -----------------------------------------
+
+    def to_dataset(self, *, columns: Sequence[str] | None = None,
+                   vector_columns: Mapping[str, Sequence[str]] | None = None
+                   ) -> PartitionedDataset:
+        """Row view for the HBM feed: a PartitionedDataset of example dicts.
+
+        ``vector_columns`` packs scalar columns into one feature vector per
+        example — e.g. ``{"dense": [f"I{i}" for i in range(13)]}`` yields a
+        ``[13]`` float array per row, the DLRM input contract — packed
+        vectorized per chunk, not per row.
+        """
+        names = list(columns) if columns is not None else list(self._columns)
+        vec = {k: list(v) for k, v in (vector_columns or {}).items()}
+        flat_used = {c for cols in vec.values() for c in cols}
+        scalars = [c for c in names if c not in flat_used]
+
+        def rows(it: Iterable[Chunk]) -> Iterator[dict]:
+            for ch in it:
+                n = _chunk_rows(ch)
+                packed = {k: np.stack([ch[c] for c in cols], axis=1)
+                          for k, cols in vec.items()}
+                for r in range(n):
+                    ex = {c: ch[c][r] for c in scalars}
+                    ex.update({k: v[r] for k, v in packed.items()})
+                    yield ex
+
+        return self._chunks.map_partitions(rows)
+
+    toDataset = to_dataset
+
+    def __repr__(self) -> str:
+        return (f"DataFrame(columns={self._columns}, "
+                f"num_partitions={self.num_partitions})")
+
+
+# ---------------------------------------------------------------------------
+# Readers
+# ---------------------------------------------------------------------------
+
+def from_rows(rows: Sequence[Mapping[str, Any]], *, num_partitions: int = 2,
+              chunk_rows: int = DEFAULT_CHUNK_ROWS) -> DataFrame:
+    """``createDataFrame``: columnarize a row sequence (driver-side)."""
+    if not rows:
+        raise ValueError("cannot infer schema from zero rows")
+    names = list(rows[0].keys())
+    ds = PartitionedDataset.parallelize(list(rows), num_partitions)
+    return from_dataset(ds, names, chunk_rows=chunk_rows)
+
+
+def from_dataset(ds: PartitionedDataset, columns: Sequence[str], *,
+                 chunk_rows: int = DEFAULT_CHUNK_ROWS) -> DataFrame:
+    """Columnarize a PartitionedDataset of row dicts (the RDD→DF bridge)."""
+    names = list(columns)
+
+    def chunker(it: Iterable[Mapping]) -> Iterator[Chunk]:
+        buf: list[Mapping] = []
+        for r in it:
+            buf.append(r)
+            if len(buf) == chunk_rows:
+                yield {n: np.asarray([b[n] for b in buf]) for n in names}
+                buf = []
+        if buf:
+            yield {n: np.asarray([b[n] for b in buf]) for n in names}
+
+    return DataFrame(ds.map_partitions(chunker), names)
+
+
+def read_csv(
+    paths: str | Sequence[str],
+    *,
+    names: Sequence[str],
+    sep: str = ",",
+    dtypes: Mapping[str, Any] | None = None,
+    num_partitions: int = 2,
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+) -> DataFrame:
+    """Typed delimited-text reader (``spark.read.csv``-shaped).
+
+    Files (or one file split by contiguous line ranges) spread over
+    ``num_partitions``. Missing fields parse as NaN (float columns) / ''
+    (string columns). ``dtypes`` maps column → numpy dtype; default f4.
+    """
+    import glob as _glob
+    import os
+
+    if isinstance(paths, str):
+        expanded = sorted(_glob.glob(paths)) if any(
+            ch in paths for ch in "*?[") else [paths]
+    else:
+        expanded = list(paths)
+    if not expanded:
+        raise FileNotFoundError(f"no files match {paths!r}")
+    for p in expanded:
+        if not os.path.exists(p):
+            raise FileNotFoundError(p)
+    names = list(names)
+    dtypes = dict(dtypes or {})
+    np_dtypes = {n: np.dtype(dtypes.get(n, np.float32)) for n in names}
+
+    def parse_lines(lines: Iterable[str]) -> Iterator[Chunk]:
+        buf: list[list[str]] = []
+
+        def flush(buf: list[list[str]]) -> Chunk:
+            cols: Chunk = {}
+            for j, n in enumerate(names):
+                raw = [row[j] if j < len(row) else "" for row in buf]
+                dt = np_dtypes[n]
+                if dt.kind == "f":
+                    cols[n] = np.array(
+                        [float(x) if x else np.nan for x in raw], dt)
+                elif dt.kind in ("i", "u"):
+                    cols[n] = np.array(
+                        [int(x) if x else 0 for x in raw], dt)
+                else:
+                    cols[n] = np.array(raw, dtype=np.str_)
+            return cols
+
+        for line in lines:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            buf.append(line.split(sep))
+            if len(buf) == chunk_rows:
+                yield flush(buf)
+                buf = []
+        if buf:
+            yield flush(buf)
+
+    # several files but fewer than requested partitions: clamp to one
+    # partition per file (repartition(n) can split streams afterwards)
+    if 1 < len(expanded) < num_partitions:
+        num_partitions = len(expanded)
+    if len(expanded) >= num_partitions:
+        file_groups = np.array_split(np.array(expanded, object), num_partitions)
+
+        def make_part(group) -> Callable[[], Iterator[Chunk]]:
+            def gen() -> Iterator[Chunk]:
+                def lines() -> Iterator[str]:
+                    for fname in group:
+                        with open(fname, "r") as f:
+                            yield from f
+                return parse_lines(lines())
+            return gen
+
+        parts = [make_part(g) for g in file_groups if len(g)]
+    else:
+        # split each file by contiguous line ranges (counted once, driver-side)
+        fname = expanded[0]
+        with open(fname, "r") as f:
+            total = sum(1 for _ in f)
+        bounds = [(i * total // num_partitions, (i + 1) * total // num_partitions)
+                  for i in range(num_partitions)]
+
+        def make_range(lo: int, hi: int) -> Callable[[], Iterator[Chunk]]:
+            def gen() -> Iterator[Chunk]:
+                def lines() -> Iterator[str]:
+                    with open(fname, "r") as f:
+                        for i, line in enumerate(f):
+                            if i >= hi:
+                                break
+                            if i >= lo:
+                                yield line
+                return parse_lines(lines())
+            return gen
+
+        parts = [make_range(lo, hi) for lo, hi in bounds]
+
+    return DataFrame(PartitionedDataset.from_generators(parts), names)
+
+
+class DataFrameReader:
+    """``session.read`` surface: ``.option(...).schema(...).csv(path)``."""
+
+    def __init__(self, *, default_parallelism: int = 2):
+        self._opts: dict[str, Any] = {"sep": ","}
+        self._names: Sequence[str] | None = None
+        self._dtypes: Mapping[str, Any] | None = None
+        self._parallelism = default_parallelism
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._opts[key] = value
+        return self
+
+    def schema(self, names: Sequence[str],
+               dtypes: Mapping[str, Any] | None = None) -> "DataFrameReader":
+        self._names = names
+        self._dtypes = dtypes
+        return self
+
+    def csv(self, path: str | Sequence[str]) -> DataFrame:
+        if self._names is None:
+            raise ValueError("call .schema([...column names...]) before .csv()")
+        return read_csv(
+            path, names=self._names, sep=str(self._opts.get("sep", ",")),
+            dtypes=self._dtypes,
+            num_partitions=int(self._opts.get(
+                "num_partitions", self._parallelism)))

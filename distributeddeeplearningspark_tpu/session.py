@@ -144,6 +144,24 @@ class Session:
         return self.parallelize(range(n), numSlices)
 
     @property
+    def read(self):
+        """``spark.read`` — the DataFrame reader surface (config 4's
+        feature-engineering entry point): ``spark.read.option("sep", "\\t")
+        .schema([...]).csv(path)``."""
+        from .data.dataframe import DataFrameReader
+
+        return DataFrameReader(default_parallelism=self.default_parallelism)
+
+    def createDataFrame(self, rows, numSlices: int | None = None):
+        """Columnarize driver-side rows into a :class:`DataFrame`."""
+        from .data.dataframe import from_rows
+
+        n = numSlices if numSlices is not None else self.default_parallelism
+        return from_rows(rows, num_partitions=n)
+
+    create_dataframe = createDataFrame
+
+    @property
     def default_parallelism(self) -> int:
         return num_data_shards(self.mesh)
 

@@ -31,6 +31,10 @@ def main() -> None:
                    help="ways to row-shard the embedding table (expert mesh axis)")
     p.add_argument("--data-dir", default=None,
                    help="Criteo TSV file or directory of day_* shards; synthetic if unset")
+    p.add_argument("--sql-features", action="store_true",
+                   help="engineer features through the DataFrame plane "
+                        "(spark.read.csv -> fillna/log1p/hash_bucket), the "
+                        "reference's Spark-SQL route, instead of criteo_tsv")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -43,7 +47,29 @@ def main() -> None:
     print(spark)
 
     vocabs = (args.vocab_size,) * args.num_sparse
-    if args.data_dir:
+    if args.data_dir and args.sql_features:
+        import os
+
+        import numpy as np
+
+        from distributeddeeplearningspark_tpu.data.dataframe import col, hash_bucket
+
+        dense = [f"I{i + 1}" for i in range(13)]
+        cats = [f"C{i + 1}" for i in range(args.num_sparse)]
+        path = (os.path.join(args.data_dir, "day_*")
+                if os.path.isdir(args.data_dir) else args.data_dir)
+        df = (spark.read.option("sep", "\t")
+              .schema(["label"] + dense + cats,
+                      {"label": np.int32, **{c: np.str_ for c in cats}})
+              .csv(path))
+        # dense: fill missing only — DLRM/WideAndDeep apply the Criteo
+        # log1p(max(x, 0)) transform inside the model (models/dlrm.py)
+        df = df.withColumns({c: col(c).fillna(0.0) for c in dense})
+        df = df.withColumns(
+            {c: hash_bucket(col(c), vocabs[i]) for i, c in enumerate(cats)})
+        ds = df.to_dataset(
+            vector_columns={"dense": dense, "sparse": cats}).repeat()
+    elif args.data_dir:
         from distributeddeeplearningspark_tpu.data.sources import criteo_tsv
 
         ds = criteo_tsv(
