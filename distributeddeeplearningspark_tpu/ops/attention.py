@@ -83,15 +83,41 @@ def _key_only_mask(mask, sq: int) -> bool:
     return all(s == 1 for s in shape[1:-1])
 
 
+#: Below this sequence length "auto" prefers XLA attention. Two measurements
+#: on the dev v5e (2026-07-29, bf16) and a moral: an ISOLATED one-kernel
+#: program timed flash far slower at short seq (s=512 fwd+bwd: flash 67ms vs
+#: xla 6.8ms) — but that is a per-program dispatch floor of the tunneled
+#: backend, amortized inside any real training step. IN-MODEL (BERT-base
+#: b=32 s=512 full train step): flash 159.0ms/step vs xla 169.9ms/step, and
+#: at s=8192 the isolated gap itself flips 5x toward flash (86ms vs 488ms —
+#: XLA's O(s²) score materialization). End-to-end numbers are the ones that
+#: count, so the default keeps flash for every kernel-qualifying shape
+#: (the kernel already requires s % 512 == 0). Override with
+#: DLS_FLASH_MIN_SEQ (e.g. 100000 to force the XLA path for A/B timing).
+FLASH_MIN_SEQ = 512
+
+
+def _flash_min_seq() -> int:
+    import os
+
+    try:
+        return int(os.environ.get("DLS_FLASH_MIN_SEQ", FLASH_MIN_SEQ))
+    except ValueError:
+        return FLASH_MIN_SEQ
+
+
 def _pick_impl(q: jax.Array, k: jax.Array, bias, mask) -> str:
     # Flash kernel requires TPU, block-divisible seq, lane-divisible head_dim,
-    # and a mask (if any) that reduces to key-only padding form.
+    # a mask (if any) in key-only padding form — and a sequence long enough
+    # that blockwise beats XLA's fused softmax (see FLASH_MIN_SEQ).
     if jax.default_backend() not in ("tpu", "axon"):
         return "xla"
     b, s, h, d = q.shape
     if bias is not None:
         return "xla"
     if mask is not None and not _key_only_mask(mask, s):
+        return "xla"
+    if s < _flash_min_seq():
         return "xla"
     if s % 512 or d % 8 or h % k.shape[2]:
         return "xla"

@@ -76,6 +76,17 @@ def _vmem():
     return pltpu.VMEM
 
 
+def _grid_params(*semantics: str):
+    """Mosaic dimension semantics: mark non-accumulating grid dims
+    "parallel" so the pipeline can overlap DMA/compute across them (the
+    innermost accumulator dim stays "arbitrary" = sequential). Measured on
+    v5e: without this the grid serializes completely and per-step overhead
+    dominates (~90µs/step — 10× slower than XLA attention at s=512)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(dimension_semantics=semantics)
+
+
 def _block_mask(qb, kb, s_blk, *, causal, mask_blk, block_q, block_k):
     """(masked logits, allowed bool | None) for one [Bq, Bk] score block."""
     allowed = None
@@ -194,6 +205,7 @@ def _flash_fwd(q, k, v, kv_mask, *, scale, causal, group, block_q, block_k,
             vmem((block_q, 128), jnp.float32),  # m (col 0 used)
             vmem((block_q, 128), jnp.float32),  # l (col 0 used)
         ],
+        compiler_params=_grid_params("parallel", "parallel", "arbitrary"),
         interpret=interpret,
     )(*operands)
     return o, lse[..., 0]
@@ -340,6 +352,7 @@ def _flash_bwd(res, g, *, scale, causal, group, block_q, block_k, interpret):
         out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype)],
         scratch_shapes=[vmem((block_q, d), jnp.float32)],
+        compiler_params=_grid_params("parallel", "parallel", "arbitrary"),
         interpret=interpret,
     )(*operands)[0]
 
@@ -379,6 +392,7 @@ def _flash_bwd(res, g, *, scale, causal, group, block_q, block_k, interpret):
             vmem((block_k, d), jnp.float32),
             vmem((block_k, d), jnp.float32),
         ],
+        compiler_params=_grid_params("parallel", "parallel", "arbitrary"),
         interpret=interpret,
     )(*operands_kv)
     return dq, dk, dv

@@ -32,10 +32,22 @@ PartitionFn = Callable[[], Iterable[Any]]
 
 
 class PartitionedDataset:
-    """A lazy, partitioned dataset (RDD-shaped)."""
+    """A lazy, partitioned dataset (RDD-shaped).
 
-    def __init__(self, partition_fns: Sequence[PartitionFn]):
+    ``infinite=True`` marks a dataset whose partitions never exhaust
+    (``repeat()``); transformations propagate it. The multi-host feed uses it
+    to skip walking non-local partitions (end-of-data can never need global
+    agreement), which is what makes pod-scale input IO per-host-local.
+    """
+
+    def __init__(self, partition_fns: Sequence[PartitionFn], *,
+                 infinite: bool = False):
         self._parts: tuple[PartitionFn, ...] = tuple(partition_fns)
+        self._infinite = infinite
+
+    @property
+    def is_infinite(self) -> bool:
+        return self._infinite
 
     # -- construction -------------------------------------------------------
 
@@ -78,7 +90,8 @@ class PartitionedDataset:
         def wrap(part: PartitionFn) -> PartitionFn:
             return lambda: f(part())
 
-        return PartitionedDataset([wrap(p) for p in self._parts])
+        return PartitionedDataset([wrap(p) for p in self._parts],
+                                  infinite=self._infinite)
 
     def map_partitions_with_index(
         self, f: Callable[[int, Iterable[Any]], Iterable[Any]]
@@ -86,7 +99,8 @@ class PartitionedDataset:
         def wrap(i: int, part: PartitionFn) -> PartitionFn:
             return lambda: f(i, part())
 
-        return PartitionedDataset([wrap(i, p) for i, p in enumerate(self._parts)])
+        return PartitionedDataset([wrap(i, p) for i, p in enumerate(self._parts)],
+                                  infinite=self._infinite)
 
     def batch(self, batch_size: int, *, drop_remainder: bool = True) -> "PartitionedDataset":
         """Group elements into lists of ``batch_size`` within each partition."""
@@ -103,9 +117,17 @@ class PartitionedDataset:
 
         return self.map_partitions(batcher)
 
+    def _require_finite(self, op: str) -> None:
+        if self._infinite:
+            raise ValueError(
+                f"{op}() on an infinite (.repeat()) dataset would hang or "
+                f"drop data — apply {op}() BEFORE .repeat()")
+
     def shuffle(self, seed: int = 0) -> "PartitionedDataset":
         """Per-partition shuffle (narrow; no cross-partition exchange —
-        combine with interleaved partition assignment for global mixing)."""
+        combine with interleaved partition assignment for global mixing).
+        Shuffle BEFORE ``.repeat()`` (materializes each partition once)."""
+        self._require_finite("shuffle")
 
         def shuf(i: int, it: Iterable[Any]) -> Iterable[Any]:
             items = list(it)
@@ -128,10 +150,12 @@ class PartitionedDataset:
 
             return gen
 
-        return PartitionedDataset([rep(p) for p in self._parts])
+        return PartitionedDataset([rep(p) for p in self._parts],
+                                  infinite=count is None or self._infinite)
 
     def coalesce(self, num_partitions: int) -> "PartitionedDataset":
         """Reduce partition count by concatenating adjacent partitions."""
+        self._require_finite("coalesce")
         if num_partitions >= self.num_partitions:
             return self
         groups = np.array_split(np.arange(self.num_partitions), num_partitions)
@@ -140,10 +164,12 @@ class PartitionedDataset:
         def make(idx: np.ndarray) -> PartitionFn:
             return lambda: itertools.chain.from_iterable(parts[i]() for i in idx)
 
-        return PartitionedDataset([make(g) for g in groups])
+        return PartitionedDataset([make(g) for g in groups],
+                                  infinite=self._infinite)
 
     def zip_with_index(self) -> "PartitionedDataset":
         """(elem, global_index) pairs; forces a driver count of prior partitions."""
+        self._require_finite("zip_with_index")
         sizes = [sum(1 for _ in p()) for p in self._parts]
         offsets = list(itertools.accumulate([0] + sizes[:-1]))
 
@@ -162,9 +188,11 @@ class PartitionedDataset:
         return iter(self._parts[i]())
 
     def collect(self) -> list:
+        self._require_finite("collect")
         return [x for p in self._parts for x in p()]
 
     def count(self) -> int:
+        self._require_finite("count")
         return sum(sum(1 for _ in p()) for p in self._parts)
 
     def take(self, n: int) -> list:

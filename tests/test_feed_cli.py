@@ -88,3 +88,46 @@ def test_shard_range_rows_are_disjoint_and_ordered():
     assert len(full) == len(left) == len(right)
     for f, l, r in zip(full, left, right):
         np.testing.assert_array_equal(f["x"], np.concatenate([l["x"], r["x"]]))
+
+
+def test_infinite_feed_never_opens_non_local_partitions():
+    """.repeat() multi-host feed: host IO must be shard-local (pod-scale
+    bandwidth contract) — non-local partitions are never even opened."""
+    from distributeddeeplearningspark_tpu.data.feed import host_batches
+
+    opened: list[int] = []
+
+    def make(i):
+        def gen():
+            opened.append(i)
+            k = 0
+            while True:
+                yield {"x": np.float32(i * 1000 + k)}
+                k += 1
+        return gen
+
+    ds = PartitionedDataset.from_generators([make(i) for i in range(4)])
+    ds = ds.map(lambda e: e).repeat()
+    assert ds.is_infinite
+    it = host_batches(ds, 16, num_shards=2, shard_range=(1, 2))
+    batches = [next(it) for _ in range(3)]
+    # shard 1 owns partitions 1 and 3; partitions 0/2 must stay closed
+    assert sorted(set(opened)) == [1, 3]
+    assert all(b["x"].shape == (8,) for b in batches)
+    vals = np.concatenate([b["x"] for b in batches])
+    assert set(np.unique(vals // 1000).astype(int)) == {1, 3}
+
+
+def test_infinite_flag_propagation_and_guards():
+    import pytest
+
+    ds = PartitionedDataset.parallelize(list(range(8)), 2)
+    assert not ds.is_infinite
+    assert ds.repeat().is_infinite
+    assert ds.repeat(2).is_infinite is False
+    assert ds.repeat().map(lambda x: x).is_infinite
+    assert ds.shuffle().repeat().is_infinite  # documented order: shuffle first
+    # degenerate compositions fail loudly instead of hanging / dropping data
+    for op in ("shuffle", "coalesce", "collect", "count", "zip_with_index"):
+        with pytest.raises(ValueError, match="BEFORE .repeat"):
+            getattr(ds.repeat(), op)(*((1,) if op == "coalesce" else ()))

@@ -166,16 +166,20 @@ def test_pick_impl_routes_bert_and_gqa_on_tpu(monkeypatch):
     q = jnp.zeros((2, 512, 12, 64))        # BERT-base: S=512, d=64
     kv = jnp.zeros((2, 512, 12, 64))
     bert_mask = padding_mask(jnp.ones((2, 512), jnp.int32))
+    # BERT with its padding mask rides the kernel (in-model measured faster)
     assert _pick_impl(q, kv, None, bert_mask) == "flash"
     # GQA llama: 8 q heads / 2 kv heads, long seq
-    q2 = jnp.zeros((1, 1024, 8, 128))
-    kv2 = jnp.zeros((1, 1024, 2, 128))
+    q2 = jnp.zeros((1, 8192, 8, 128))
+    kv2 = jnp.zeros((1, 8192, 2, 128))
     assert _pick_impl(q2, kv2, None, None) == "flash"
     # q-varying mask → xla
     assert _pick_impl(q, kv, None, jnp.ones((2, 1, 512, 512), bool)) == "xla"
     # bias → xla
-    assert _pick_impl(q, kv, None, None) == "flash"
     assert _pick_impl(q, kv, jnp.zeros((2, 12, 512, 512)), None) == "xla"
+    # threshold override forces the XLA path (A/B timing escape hatch)
+    monkeypatch.setenv("DLS_FLASH_MIN_SEQ", "100000")
+    assert _pick_impl(q, kv, None, bert_mask) == "xla"
+    assert _pick_impl(q2, kv2, None, None) == "xla"
 
 
 def test_flash_uneven_blocks_rejected():
