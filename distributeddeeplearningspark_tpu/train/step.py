@@ -153,6 +153,30 @@ def make_eval_step(apply_fn: Callable, loss_fn: LossFn) -> Callable:
     return eval_step
 
 
+def make_predict_step(apply_fn: Callable) -> Callable:
+    """(state, batch) → raw model outputs, inference mode (no loss).
+
+    The reference's inference stack is ``broadcast(params)`` →
+    ``rdd.mapPartitions(predict_fn)`` → collect (SURVEY.md §3.3); this is
+    the jitted per-batch body of that ``predict_fn``.
+    """
+
+    def predict_step(state: TrainState, batch: dict[str, Any]):
+        variables = {"params": state.params, **state.mutable}
+        return apply_fn(variables, batch, train=False)
+
+    return predict_step
+
+
+def jit_predict_step(predict_step: Callable, mesh: Mesh, state_sh: Any) -> Callable:
+    # outputs replicate (all-gather) like eval metrics: device_get cannot
+    # fetch shards living on other hosts' devices, so batch-sharded outputs
+    # would crash any multi-process run
+    out_sh = NamedSharding(mesh, P())
+    return jax.jit(predict_step, in_shardings=(state_sh, None),
+                   out_shardings=out_sh)
+
+
 def batch_shardings_like(batch: Any, mesh: Mesh) -> Any:
     """Per-leaf NamedSharding: leading axis over (data, fsdp), rest replicated.
 

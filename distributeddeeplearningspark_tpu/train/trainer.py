@@ -11,7 +11,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import logging
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Iterator, Sequence
 
 import jax
 import optax
@@ -103,6 +103,7 @@ class Trainer:
         self.state_shardings = None
         self._train_step = None
         self._eval_step = None
+        self._predict_step = None
 
     # -- setup --------------------------------------------------------------
 
@@ -124,6 +125,10 @@ class Trainer:
         ev = step_lib.make_eval_step(self._apply_fn(), self.loss_fn)
         self._eval_step = step_lib.jit_eval_step(
             ev, self.mesh, self.state_shardings, seq_sharded=self.context_parallel
+        )
+        self._predict_step = step_lib.jit_predict_step(
+            step_lib.make_predict_step(self._apply_fn()),
+            self.mesh, self.state_shardings,
         )
         logger.info("initialized %s params over mesh %s",
                     f"{self.state.num_params:,}", dict(self.mesh.shape))
@@ -410,6 +415,65 @@ class Trainer:
                 totals[k] = totals.get(k, 0.0) + float(v) * w
             wsum += w
         return {k: v / max(wsum, 1e-9) for k, v in totals.items()}
+
+    def predict(
+        self,
+        dataset: PartitionedDataset,
+        *,
+        batch_size: int,
+        output_fn: Callable[[Any], Any] | None = None,
+        with_inputs: bool = False,
+    ) -> Iterator[Any]:
+        """Yield per-example model outputs over ``dataset`` (host numpy).
+
+        The reference's inference path (SURVEY.md §3.3): params broadcast →
+        ``rdd.mapPartitions(predict_fn)`` → collect. The jitted forward runs
+        batch-sharded over the mesh; the tail batch is processed at its
+        natural size (same GSPMD divisibility rule as :meth:`evaluate`).
+
+        **Ordering:** rows stream in *feed order* — shard-interleaved
+        (partition *i* → data shard ``i % num_shards``), which is NOT
+        ``dataset.collect()`` order when there are multiple partitions. To
+        attach predictions to their examples, pass ``with_inputs=True`` and
+        receive ``(example, output)`` pairs — never zip against a separately
+        iterated dataset.
+
+        ``output_fn`` post-processes each device batch BEFORE the host fetch
+        (e.g. ``lambda logits: jnp.argmax(logits, -1)`` to ship class ids,
+        not [B, 1000] logit matrices). Multi-process: outputs replicate
+        (all-gather) so every host yields the full global row stream —
+        except with ``with_inputs``, where each host yields only the rows
+        whose inputs it holds (its own data shards).
+        """
+        assert self._predict_step is not None and self.state is not None
+        nshards = num_data_shards(self.mesh)
+        srange = process_shard_range(nshards)
+        hb = host_batches(
+            dataset, batch_size, num_shards=nshards, drop_remainder=False,
+            shard_range=srange,
+        )
+        put = functools.partial(put_global, mesh=self.mesh,
+                                seq_sharded=self.context_parallel)
+        for host_batch in hb:
+            out = self._predict_step(self.state, put(host_batch))
+            if output_fn is not None:
+                out = output_fn(out)
+            host = jax.device_get(out)
+            leaves = jax.tree.leaves(host)
+            rows = leaves[0].shape[0] if leaves else 0
+            local_rows = next(iter(host_batch.values())).shape[0]
+            # multi-process: the replicated output is GLOBAL; this host's
+            # input rows sit at [lo, lo + local_rows) of it
+            lo = 0 if srange is None else srange[0] * (rows // nshards)
+            for r in range(rows):
+                row_out = jax.tree.map(lambda a: a[r], host)
+                if with_inputs:
+                    if not (lo <= r < lo + local_rows):
+                        continue
+                    yield ({k: v[r - lo] for k, v in host_batch.items()},
+                           row_out)
+                else:
+                    yield row_out
 
     def compiled_cost(self, batch: dict[str, Any]) -> float | None:
         """FLOPs per step from XLA cost analysis (for MFU reporting)."""
