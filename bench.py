@@ -91,7 +91,7 @@ def bench_steps(step_fn, state, batch, *, warmup: int = 3, iters: int = 20):
     return (time.perf_counter() - t0) / iters, state
 
 
-def _train_setup(model, batch, loss_fn, *, tx=None):
+def _train_setup(model, batch, loss_fn, *, tx=None, rules=None):
     """Shared: mesh, sharded state, jitted step, global batch, flops."""
     import optax
 
@@ -103,7 +103,8 @@ def _train_setup(model, batch, loss_fn, *, tx=None):
 
     mesh = MeshSpec(data=-1).build()
     tx = tx or optax.sgd(0.01, momentum=0.9)
-    state, shardings = step_lib.init_state(model, tx, batch, mesh, REPLICATED)
+    state, shardings = step_lib.init_state(
+        model, tx, batch, mesh, rules if rules is not None else REPLICATED)
     train_step = step_lib.jit_train_step(
         step_lib.make_train_step(
             model.apply, tx, loss_fn, mutable_keys=tuple(state.mutable.keys()),
@@ -200,6 +201,58 @@ def bench_bert(iters: int, batch_size: int = 32, seq: int = 512) -> dict:
     return rec
 
 
+def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048) -> dict:
+    """Llama LoRA fine-tune tokens/sec/chip (BASELINE.json config 5 shape).
+
+    Single-chip-sized geometry (~0.9B params, hidden 2048 / 16 layers,
+    GQA 16q/8kv, LoRA rank 16, AdamW on adapters only, remat on — remat=False
+    fails in this backend's remote compile helper); the real 7B runs FSDP
+    across chips (dryrun-validated). Reported in ``extra`` only.
+    """
+    import optax
+
+    from distributeddeeplearningspark_tpu.data.feed import stack_examples
+    from distributeddeeplearningspark_tpu.metrics import device_peak_flops
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        llama_rules,
+        lora_trainable,
+    )
+    from distributeddeeplearningspark_tpu.train import losses, optim
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, num_layers=16, num_heads=16,
+        num_kv_heads=8, intermediate_size=5632, max_position=seq,
+        lora_rank=16, dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(2)
+    batch = stack_examples([
+        {"input_ids": rng.integers(0, cfg.vocab_size, (seq,)).astype(np.int32),
+         "loss_mask": np.ones((seq,), np.float32)}
+        for _ in range(batch_size)])
+    mesh, state, step, gbatch, flops = _train_setup(
+        model, batch, losses.causal_lm,
+        tx=optim.masked(optax.adamw(1e-4), lora_trainable),
+        rules=llama_rules(cfg))
+    n_chips = mesh.devices.size
+    step_time, _ = bench_steps(step, state, gbatch, iters=iters)
+    peak = device_peak_flops()
+    # cost analysis misses flash-attention custom-call flops and counts the
+    # remat forward once — treat mfu as a LOWER bound here
+    mfu = (flops / step_time / n_chips / peak) if (flops and peak) else 0.0
+    rec = {
+        "tokens_per_sec_per_chip": round(batch_size * seq / step_time / n_chips, 1),
+        "step_time_ms": round(step_time * 1e3, 3),
+        "mfu_lower_bound": round(mfu, 4),
+        "params": 887_949_312,
+        "batch_size": batch_size,
+        "seq_len": seq,
+        "chips": n_chips,
+    }
+    return rec
+
+
 def pallas_smoke() -> dict:
     """Compile-and-run flash attention fwd+bwd on the real chip (Mosaic).
 
@@ -249,7 +302,8 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float, extra: dict) 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", choices=["all", "resnet", "bert"], default="all")
+    ap.add_argument("--model", choices=["all", "resnet", "bert", "llama"],
+                    default="all")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--batch", type=int, default=0,
                     help="override per-model default batch size (debug)")
@@ -285,14 +339,19 @@ def main(argv=None) -> int:
     extra["device"] = getattr(jax.devices()[0], "device_kind", jax.devices()[0].platform)
     extra["backend"] = backend
 
-    want = {"all": ("resnet50", "bert_base_mlm"),
+    want = {"all": ("resnet50", "bert_base_mlm", "llama_lora"),
             "resnet": ("resnet50",),
-            "bert": ("bert_base_mlm",)}[args.model]
+            "bert": ("bert_base_mlm",),
+            "llama": ("llama_lora",)}[args.model]
     runners = {
         "resnet50": lambda: bench_resnet(
             args.iters, **({"batch_size": args.batch} if args.batch else {})),
         "bert_base_mlm": lambda: bench_bert(
             args.iters,
+            **({"batch_size": args.batch} if args.batch else {}),
+            **({"seq": args.seq} if args.seq else {})),
+        "llama_lora": lambda: bench_llama(
+            max(5, args.iters // 2),
             **({"batch_size": args.batch} if args.batch else {}),
             **({"seq": args.seq} if args.seq else {})),
     }
@@ -315,10 +374,14 @@ def main(argv=None) -> int:
         name, r = "bert_base_mlm", results["bert_base_mlm"]
         value, unit = r["tokens_per_sec_per_chip"], "tokens/sec/chip"
         metric = "bert_base_mlm_tokens_per_sec_per_chip"
+    elif "llama_lora" in results:
+        name, r = "llama_lora", results["llama_lora"]
+        value, unit = r["tokens_per_sec_per_chip"], "tokens/sec/chip"
+        metric = "llama_lora_tokens_per_sec_per_chip"
     else:
         emit("bench_failed", 0.0, "none", 0.0, extra)
         return 0
-    mfu = r["mfu"] if backend == "tpu" else 0.0
+    mfu = r.get("mfu", r.get("mfu_lower_bound", 0.0)) if backend == "tpu" else 0.0
     if any("timing_suspect" in res for res in results.values()):
         # a physically impossible measurement must not masquerade as a
         # headline number — surface it at the top level and zero the ratio
