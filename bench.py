@@ -160,27 +160,31 @@ def bench_bert(iters: int, batch_size: int = 32, seq: int = 512) -> dict:
 
     Full 512-token sequences with an all-ones attention mask (the padding-mask
     path BERT always runs through — routes to the Pallas flash kernel on TPU,
-    see ops/attention._pick_impl) and 15% MLM positions, AdamW.
+    see ops/attention._pick_impl), 15% MLM targets in the gathered
+    (``mlm_positions``) form so the vocab projection runs on masked positions
+    only (models/bert.py docstring), AdamW.
     """
     import optax
 
     from distributeddeeplearningspark_tpu.data.feed import stack_examples
+    from distributeddeeplearningspark_tpu.data.text import pack_mlm_predictions
     from distributeddeeplearningspark_tpu.metrics import device_peak_flops
     from distributeddeeplearningspark_tpu.models import bert_base
     from distributeddeeplearningspark_tpu.train import losses
 
     model = bert_base()
     rng = np.random.default_rng(1)
+    max_pred = int(seq * 0.15) + 4
     examples = []
     for _ in range(batch_size):
         ids = rng.integers(0, 30522, (seq,)).astype(np.int32)
         weights = (rng.random(seq) < 0.15).astype(np.float32)
-        examples.append({
+        examples.append(pack_mlm_predictions({
             "input_ids": ids,
             "attention_mask": np.ones((seq,), np.int32),
             "mlm_labels": ids,
             "mlm_weights": weights,
-        })
+        }, max_pred))
     batch = stack_examples(examples)
     mesh, state, step, gbatch, flops = _train_setup(
         model, batch, losses.masked_lm, tx=optax.adamw(1e-4))

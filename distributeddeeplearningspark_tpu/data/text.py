@@ -178,6 +178,33 @@ def mask_tokens(
     }
 
 
+def pack_mlm_predictions(
+    example: dict[str, np.ndarray], max_predictions: int
+) -> dict[str, np.ndarray]:
+    """Full-length MLM example → gathered form (original TPU BERT layout).
+
+    Adds ``mlm_positions`` [P] and rewrites ``mlm_labels``/``mlm_weights``
+    to [P] (P = ``max_predictions``, zero-padded/weighted-0), so
+    :class:`~..models.bert.BertForMLM` runs its vocab projection on masked
+    positions only. Targets beyond P are dropped (weight-0), matching the
+    reference BERT data pipeline's ``max_predictions_per_seq`` truncation.
+    """
+    sel = np.flatnonzero(example["mlm_weights"] > 0)[:max_predictions]
+    pos = np.zeros((max_predictions,), np.int32)
+    labels = np.zeros((max_predictions,), np.int32)
+    weights = np.zeros((max_predictions,), np.float32)
+    pos[: len(sel)] = sel
+    labels[: len(sel)] = example["mlm_labels"][sel]
+    weights[: len(sel)] = example["mlm_weights"][sel]  # preserve weighting
+    return {
+        "input_ids": example["input_ids"],
+        "attention_mask": example["attention_mask"],
+        "mlm_positions": pos,
+        "mlm_labels": labels,
+        "mlm_weights": weights,
+    }
+
+
 def mlm_dataset(
     docs: PartitionedDataset,
     tokenizer: WordPieceTokenizer,
@@ -185,13 +212,21 @@ def mlm_dataset(
     seq_len: int = 128,
     mask_prob: float = 0.15,
     seed: int = 0,
+    max_predictions: int | None = None,
 ) -> PartitionedDataset:
-    """Text RDD → MLM example RDD (tokenize → pack → mask, per partition)."""
+    """Text RDD → MLM example RDD (tokenize → pack → mask, per partition).
+
+    ``max_predictions``: emit the gathered (``mlm_positions``) form so the
+    model's vocab projection runs on masked positions only (recommended:
+    ``ceil(seq_len * mask_prob) + a few``, e.g. 80 for 512×0.15).
+    """
 
     def per_partition(pidx: int, lines: Iterable[str]) -> Iterator[dict]:
         rng = np.random.default_rng(seed * 100003 + pidx)
         for seg in segments_from_docs(lines, tokenizer, seq_len):
-            yield mask_tokens(seg, tokenizer, rng, mask_prob=mask_prob)
+            ex = mask_tokens(seg, tokenizer, rng, mask_prob=mask_prob)
+            yield (pack_mlm_predictions(ex, max_predictions)
+                   if max_predictions else ex)
 
     return docs.map_partitions_with_index(per_partition)
 

@@ -31,6 +31,10 @@ def main() -> None:
                    help="Wikipedia dump: mediawiki .xml(.bz2), wikiextractor "
                         "tree, or plain-text dir (config 3's real feed)")
     p.add_argument("--vocab", default=None, help="vocab file; trained from corpus if unset")
+    p.add_argument("--max-predictions", type=int, default=-1,
+                   help="gathered MLM form: vocab projection on at most this "
+                        "many masked positions per sequence (-1 = auto "
+                        "int(0.15*seq)+4; 0 = full-length head)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -55,7 +59,10 @@ def main() -> None:
         sample = docs.take(20000) if args.data_dir else docs.collect()
         tok = text_lib.WordPieceTokenizer.train(sample, vocab_size=8192)
 
-    ds = text_lib.mlm_dataset(docs, tok, seq_len=args.seq_len).repeat()
+    max_pred = (int(args.seq_len * 0.15) + 4 if args.max_predictions < 0
+                else args.max_predictions or None)
+    ds = text_lib.mlm_dataset(docs, tok, seq_len=args.seq_len,
+                              max_predictions=max_pred).repeat()
 
     make = bert_base if args.variant == "base" else bert_tiny
     model = make(vocab_size=tok.vocab_size, max_position=max(args.seq_len, 128))

@@ -114,3 +114,72 @@ def test_bert_mlm_learns(eight_devices):
         last = m
     assert float(last["loss"]) < first * 0.8
     assert float(last["mlm_accuracy"]) > 2.0 / tok.vocab_size
+
+
+def test_gathered_mlm_head_matches_full_length():
+    """mlm_positions gather: same loss/grads as the full-length head on the
+    same targets (the original TPU BERT masked_lm_positions design)."""
+    import optax
+
+    from distributeddeeplearningspark_tpu.data.text import pack_mlm_predictions
+    from distributeddeeplearningspark_tpu.models import bert_tiny
+    from distributeddeeplearningspark_tpu.train import losses
+
+    model = bert_tiny()
+    V = model.cfg.vocab_size
+    rng = np.random.default_rng(0)
+    b, s, p = 2, 32, 8
+    full = {
+        "input_ids": rng.integers(0, V, (b, s)).astype(np.int32),
+        "attention_mask": np.ones((b, s), np.int32),
+        "mlm_labels": rng.integers(0, V, (b, s)).astype(np.int32),
+        "mlm_weights": np.zeros((b, s), np.float32),
+    }
+    for i in range(b):  # 5 masked positions per row (< p)
+        full["mlm_weights"][i, rng.choice(s, 5, replace=False)] = 1.0
+    packed_rows = [pack_mlm_predictions(
+        {k: v[i] for k, v in full.items()}, p) for i in range(b)]
+    packed = {k: np.stack([r[k] for r in packed_rows]) for k in packed_rows[0]}
+
+    variables = model.init(jax.random.PRNGKey(0), full, train=False)
+
+    def loss_for(batch):
+        def f(params):
+            logits = model.apply({"params": params}, batch, train=False)
+            return losses.masked_lm(logits, batch)[0]
+        return f
+
+    lf = jax.value_and_grad(loss_for(full))(variables["params"])
+    lp = jax.value_and_grad(loss_for(packed))(variables["params"])
+    np.testing.assert_allclose(float(lf[0]), float(lp[0]), rtol=2e-5)
+    for a, b2 in zip(jax.tree.leaves(lf[1]), jax.tree.leaves(lp[1])):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b2, np.float32),
+                                   rtol=5e-3, atol=2e-5)
+
+
+def test_mlm_dataset_packed_form():
+    from distributeddeeplearningspark_tpu.data.text import (
+        WordPieceTokenizer, mlm_dataset)
+    from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+    tok = WordPieceTokenizer.train(
+        ["the quick brown fox jumps over the lazy dog"] * 20, vocab_size=64)
+    docs = PartitionedDataset.parallelize(
+        ["the quick brown fox jumps over the lazy dog"] * 8, 2)
+    ds = mlm_dataset(docs, tok, seq_len=16, max_predictions=4, seed=1)
+    ex = ds.take(3)[1]
+    assert set(ex) == {"input_ids", "attention_mask", "mlm_positions",
+                      "mlm_labels", "mlm_weights"}
+    assert ex["mlm_positions"].shape == (4,)
+    assert ex["mlm_weights"].sum() >= 1
+    # packed labels must equal the full-length example's ORIGINAL tokens at
+    # the packed positions — verify against an identically-seeded unpacked run
+    ds_full = mlm_dataset(docs, tok, seq_len=16, seed=1)
+    full = ds_full.take(3)[1]
+    for j in range(4):
+        if ex["mlm_weights"][j] > 0:
+            assert ex["mlm_labels"][j] == full["mlm_labels"][ex["mlm_positions"][j]]
+            assert full["mlm_weights"][ex["mlm_positions"][j]] > 0
+    # and the packed input_ids are the same corrupted stream
+    np.testing.assert_array_equal(ex["input_ids"], full["input_ids"])
