@@ -1,0 +1,194 @@
+"""BERT ↔ Hugging Face weight interchange.
+
+The reference world pretrains/loads stock BERT checkpoints; config 3 users
+expect to start MLM pretraining from (or export to) the standard
+``bert-base-uncased`` layout (SURVEY.md §2 'Models: BERT-base MLM' —
+"vendored or HF"). This maps the HF BERT parameter tree (the flax layout of
+``FlaxBertForMaskedLM``; the torch ``state_dict`` transposes linear weights)
+onto :class:`~.bert.BertForMLM`'s tree and back.
+
+Shape conventions bridged:
+
+- HF stores attention projections as flat ``[H, H]`` Dense kernels; ours are
+  ``DenseGeneral`` kernels ``[H, heads, head_dim]`` (and the output
+  projection ``[heads, head_dim, H]``) so TP rules can shard the head axis.
+- HF keeps a separate ``cls.predictions.decoder`` tied to the word
+  embeddings; ours ties structurally (``Embed.attend``), so only the bias
+  transfers.
+
+All staging is host-side numpy — call ``Trainer.load_pretrained`` with the
+result to place slices per the active sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from distributeddeeplearningspark_tpu.models.bert import BertConfig
+
+
+def _g(tree: Mapping, *path):
+    node: Any = tree
+    for p in path:
+        node = node[p]
+    return np.asarray(node)
+
+
+def import_hf_bert(hf_params: Mapping, cfg: BertConfig) -> dict:
+    """HF ``FlaxBertForMaskedLM`` param tree → :class:`BertForMLM` tree.
+
+    ``hf_params`` is the dict under the HF model's ``params`` (with top-level
+    keys ``bert`` and ``cls``). Returns a nested numpy tree matching
+    ``BertForMLM.init(...)['params']``.
+    """
+    h, heads = cfg.hidden_size, cfg.num_heads
+    hd = h // heads
+    emb = hf_params["bert"]["embeddings"]
+
+    def qkv(kernel, bias):
+        return {"kernel": np.asarray(kernel).reshape(h, heads, hd),
+                "bias": np.asarray(bias).reshape(heads, hd)}
+
+    encoder: dict[str, Any] = {
+        "position_embeddings": {"embedding": _g(emb, "position_embeddings", "embedding")},
+        "type_embeddings": {"embedding": _g(emb, "token_type_embeddings", "embedding")},
+        "embeddings_ln": {"scale": _g(emb, "LayerNorm", "scale"),
+                          "bias": _g(emb, "LayerNorm", "bias")},
+    }
+    for i in range(cfg.num_layers):
+        hf_layer = hf_params["bert"]["encoder"]["layer"][str(i)]
+        att, out = hf_layer["attention"], hf_layer["output"]
+        encoder[f"layer_{i}"] = {
+            "attention": {
+                "query": qkv(att["self"]["query"]["kernel"], att["self"]["query"]["bias"]),
+                "key": qkv(att["self"]["key"]["kernel"], att["self"]["key"]["bias"]),
+                "value": qkv(att["self"]["value"]["kernel"], att["self"]["value"]["bias"]),
+                "out": {
+                    "kernel": _g(att, "output", "dense", "kernel").reshape(heads, hd, h),
+                    "bias": _g(att, "output", "dense", "bias"),
+                },
+            },
+            "attention_ln": {"scale": _g(att, "output", "LayerNorm", "scale"),
+                             "bias": _g(att, "output", "LayerNorm", "bias")},
+            "mlp_in": {"kernel": _g(hf_layer, "intermediate", "dense", "kernel"),
+                       "bias": _g(hf_layer, "intermediate", "dense", "bias")},
+            "mlp_out": {"kernel": _g(out, "dense", "kernel"),
+                        "bias": _g(out, "dense", "bias")},
+            "mlp_ln": {"scale": _g(out, "LayerNorm", "scale"),
+                       "bias": _g(out, "LayerNorm", "bias")},
+        }
+    transform = hf_params["cls"]["predictions"]["transform"]
+    return {
+        "token_embeddings": {"embedding": _g(emb, "word_embeddings", "embedding")},
+        "encoder": encoder,
+        "mlm_dense": {"kernel": _g(transform, "dense", "kernel"),
+                      "bias": _g(transform, "dense", "bias")},
+        "mlm_ln": {"scale": _g(transform, "LayerNorm", "scale"),
+                   "bias": _g(transform, "LayerNorm", "bias")},
+        # cls/predictions/bias is the array itself in the HF flax layout
+        "mlm_bias": _g(hf_params["cls"]["predictions"], "bias"),
+    }
+
+
+def export_hf_bert(params: Mapping, cfg: BertConfig) -> dict:
+    """:class:`BertForMLM` tree → HF ``FlaxBertForMaskedLM`` layout (numpy).
+
+    Inverse of :func:`import_hf_bert`. Only the decoder BIAS is emitted
+    (``cls/predictions/bias``): HF's flax model ties the decoder kernel to
+    the word embeddings at apply time, same as ours — loading into an
+    UNTIED model requires materializing ``cls.predictions.decoder`` from
+    ``bert/embeddings/word_embeddings`` yourself.
+    """
+    h, heads = cfg.hidden_size, cfg.num_heads
+    hd = h // heads
+    enc = params["encoder"]
+
+    def flat(k, b):
+        return {"kernel": np.asarray(k).reshape(h, h),
+                "bias": np.asarray(b).reshape(h)}
+
+    layers: dict[str, Any] = {}
+    for i in range(cfg.num_layers):
+        ly = enc[f"layer_{i}"]
+        att = ly["attention"]
+        layers[str(i)] = {
+            "attention": {
+                "self": {
+                    "query": flat(att["query"]["kernel"], att["query"]["bias"]),
+                    "key": flat(att["key"]["kernel"], att["key"]["bias"]),
+                    "value": flat(att["value"]["kernel"], att["value"]["bias"]),
+                },
+                "output": {
+                    "dense": {"kernel": np.asarray(att["out"]["kernel"]).reshape(h, h),
+                              "bias": np.asarray(att["out"]["bias"])},
+                    "LayerNorm": {"scale": np.asarray(ly["attention_ln"]["scale"]),
+                                  "bias": np.asarray(ly["attention_ln"]["bias"])},
+                },
+            },
+            "intermediate": {"dense": {
+                "kernel": np.asarray(ly["mlp_in"]["kernel"]),
+                "bias": np.asarray(ly["mlp_in"]["bias"])}},
+            "output": {
+                "dense": {"kernel": np.asarray(ly["mlp_out"]["kernel"]),
+                          "bias": np.asarray(ly["mlp_out"]["bias"])},
+                "LayerNorm": {"scale": np.asarray(ly["mlp_ln"]["scale"]),
+                              "bias": np.asarray(ly["mlp_ln"]["bias"])},
+            },
+        }
+    word = np.asarray(params["token_embeddings"]["embedding"])
+    return {
+        "bert": {
+            "embeddings": {
+                "word_embeddings": {"embedding": word},
+                "position_embeddings": {
+                    "embedding": np.asarray(enc["position_embeddings"]["embedding"])},
+                "token_type_embeddings": {
+                    "embedding": np.asarray(enc["type_embeddings"]["embedding"])},
+                "LayerNorm": {"scale": np.asarray(enc["embeddings_ln"]["scale"]),
+                              "bias": np.asarray(enc["embeddings_ln"]["bias"])},
+            },
+            "encoder": {"layer": layers},
+        },
+        "cls": {"predictions": {
+            "transform": {
+                "dense": {"kernel": np.asarray(params["mlm_dense"]["kernel"]),
+                          "bias": np.asarray(params["mlm_dense"]["bias"])},
+                "LayerNorm": {"scale": np.asarray(params["mlm_ln"]["scale"]),
+                              "bias": np.asarray(params["mlm_ln"]["bias"])},
+            },
+            "bias": np.asarray(params["mlm_bias"]),
+        }},
+    }
+
+
+def import_hf_bert_torch(state_dict: Mapping, cfg: BertConfig) -> dict:
+    """Torch ``BertForMaskedLM.state_dict()`` → :class:`BertForMLM` tree.
+
+    Torch linear weights are ``[out, in]`` — transposed to flax's
+    ``[in, out]`` before the flax-layout mapping above is applied.
+    """
+    flax_tree: dict[str, Any] = {}
+
+    def put(path: list[str], value: np.ndarray) -> None:
+        node = flax_tree
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = value
+
+    for name, tensor in state_dict.items():
+        v = np.asarray(tensor)
+        parts = name.split(".")
+        if parts[-1] == "weight":
+            if "embeddings" in parts and "LayerNorm" not in parts:
+                parts[-1] = "embedding"
+            elif "LayerNorm" in parts:
+                parts[-1] = "scale"
+            else:
+                parts[-1] = "kernel"
+                v = v.T
+        if parts[:2] == ["cls", "predictions"] and parts[2] == "decoder":
+            continue  # tied to word embeddings structurally
+        put(parts, v)
+    return import_hf_bert(flax_tree, cfg)

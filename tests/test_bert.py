@@ -183,3 +183,97 @@ def test_mlm_dataset_packed_form():
             assert full["mlm_weights"][ex["mlm_positions"][j]] > 0
     # and the packed input_ids are the same corrupted stream
     np.testing.assert_array_equal(ex["input_ids"], full["input_ids"])
+
+
+def _tiny_hf_bert():
+    transformers = __import__("pytest").importorskip("transformers")
+    HFBertConfig = transformers.BertConfig
+    FlaxBertForMaskedLM = transformers.FlaxBertForMaskedLM
+
+    hf_cfg = HFBertConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    return FlaxBertForMaskedLM(hf_cfg, seed=0), hf_cfg
+
+
+def test_hf_bert_import_logits_parity():
+    """import_hf_bert: our BertForMLM reproduces FlaxBertForMaskedLM logits
+    on the same (randomly initialized) weights — full numerical parity of
+    embeddings, encoder stack, and tied MLM head."""
+    from distributeddeeplearningspark_tpu.models.bert import BertConfig, BertForMLM
+    from distributeddeeplearningspark_tpu.models.bert_io import import_hf_bert
+
+    hf_model, hf_cfg = _tiny_hf_bert()
+    cfg = BertConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                     intermediate_size=128, max_position=64,
+                     dropout_rate=0.0, dtype=jnp.float32, attention_impl="xla")
+    params = import_hf_bert(hf_model.params, cfg)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, (2, 16)).astype(np.int32)
+    attn = np.ones((2, 16), np.int32)
+    attn[1, 12:] = 0
+    ours = BertForMLM(cfg).apply(
+        {"params": params},
+        {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(attn)},
+        train=False)
+    theirs = hf_model(input_ids=ids, attention_mask=attn).logits
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(theirs),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hf_bert_export_round_trip():
+    from distributeddeeplearningspark_tpu.models.bert import BertConfig
+    from distributeddeeplearningspark_tpu.models.bert_io import (
+        export_hf_bert, import_hf_bert)
+
+    hf_model, _ = _tiny_hf_bert()
+    cfg = BertConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                     intermediate_size=128, max_position=64)
+    ours = import_hf_bert(hf_model.params, cfg)
+    back = export_hf_bert(ours, cfg)
+    again = import_hf_bert(back, cfg)
+    flat_a = jax.tree_util.tree_flatten_with_path(ours)[0]
+    flat_b = jax.tree_util.tree_flatten_with_path(again)[0]
+    assert len(flat_a) == len(flat_b)
+    for (pa, a), (pb, b) in zip(flat_a, flat_b):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hf_bert_torch_import_matches_flax_import():
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    HFBertConfig, BertForMaskedLM = transformers.BertConfig, transformers.BertForMaskedLM
+
+    from distributeddeeplearningspark_tpu.models.bert import BertConfig, BertForMLM
+    from distributeddeeplearningspark_tpu.models.bert_io import import_hf_bert_torch
+
+    hf_cfg = HFBertConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    tmodel = BertForMaskedLM(hf_cfg).eval()
+    cfg = BertConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                     intermediate_size=128, max_position=64,
+                     dropout_rate=0.0, dtype=jnp.float32, attention_impl="xla")
+    params = import_hf_bert_torch(tmodel.state_dict(), cfg)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 256, (2, 16)).astype(np.int32)
+    attn = np.ones((2, 16), np.int32)
+    ours = BertForMLM(cfg).apply(
+        {"params": params},
+        {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(attn)},
+        train=False)
+    with torch.no_grad():
+        theirs = tmodel(input_ids=torch.tensor(ids.astype(np.int64)),
+                        attention_mask=torch.tensor(attn.astype(np.int64))).logits
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               rtol=2e-4, atol=2e-4)
