@@ -17,6 +17,7 @@ from distributeddeeplearningspark_tpu.data.dataframe import (
     lit,
     log1p,
     read_csv,
+    read_parquet,
     when,
 )
 from distributeddeeplearningspark_tpu.data.prefetch import prefetch_to_device
@@ -37,5 +38,6 @@ __all__ = [
     "lit",
     "log1p",
     "read_csv",
+    "read_parquet",
     "when",
 ]

@@ -461,6 +461,33 @@ def from_dataset(ds: PartitionedDataset, columns: Sequence[str], *,
     return DataFrame(ds.map_partitions(chunker), names)
 
 
+def _expand_paths(paths: str | Sequence[str]) -> list[str]:
+    """Glob-or-literal path expansion shared by the readers.
+
+    A string containing glob metacharacters expands (sorted); a literal
+    string that exists is used as-is even if it contains ``[``/``?``
+    (e.g. ``data[1].parquet``); lists pass through with existence checks.
+    """
+    import glob as _glob
+    import os
+
+    if isinstance(paths, str):
+        if os.path.exists(paths):
+            expanded = [paths]
+        elif any(ch in paths for ch in "*?["):
+            expanded = sorted(_glob.glob(paths))
+        else:
+            expanded = [paths]
+    else:
+        expanded = list(paths)
+    if not expanded:
+        raise FileNotFoundError(f"no files match {paths!r}")
+    for p in expanded:
+        if not os.path.exists(p):
+            raise FileNotFoundError(p)
+    return expanded
+
+
 def read_csv(
     paths: str | Sequence[str],
     *,
@@ -476,19 +503,7 @@ def read_csv(
     ``num_partitions``. Missing fields parse as NaN (float columns) / ''
     (string columns). ``dtypes`` maps column → numpy dtype; default f4.
     """
-    import glob as _glob
-    import os
-
-    if isinstance(paths, str):
-        expanded = sorted(_glob.glob(paths)) if any(
-            ch in paths for ch in "*?[") else [paths]
-    else:
-        expanded = list(paths)
-    if not expanded:
-        raise FileNotFoundError(f"no files match {paths!r}")
-    for p in expanded:
-        if not os.path.exists(p):
-            raise FileNotFoundError(p)
+    expanded = _expand_paths(paths)
     names = list(names)
     dtypes = dict(dtypes or {})
     np_dtypes = {n: np.dtype(dtypes.get(n, np.float32)) for n in names}
@@ -564,8 +579,68 @@ def read_csv(
     return DataFrame(PartitionedDataset.from_generators(parts), names)
 
 
+def read_parquet(
+    paths: str | Sequence[str],
+    *,
+    columns: Sequence[str] | None = None,
+    num_partitions: int = 2,
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+) -> DataFrame:
+    """Parquet reader (``spark.read.parquet``-shaped), via pyarrow.
+
+    Files spread over partitions (one partition reads a contiguous file
+    group; a single file splits by row-group ranges). Column chunks stream
+    batch-at-a-time — a partition never materializes its whole file set.
+    """
+    import pyarrow.parquet as pq
+
+    expanded = _expand_paths(paths)
+    schema = pq.read_schema(expanded[0])
+    names = list(columns) if columns is not None else list(schema.names)
+
+    def batches_to_chunks(batches) -> Iterator[Chunk]:
+        for rb in batches:
+            yield {n: rb.column(n).to_numpy(zero_copy_only=False)
+                   for n in names}
+
+    if 1 < len(expanded) < num_partitions:
+        num_partitions = len(expanded)
+    if len(expanded) >= num_partitions:
+        groups = np.array_split(np.array(expanded, object), num_partitions)
+
+        def make_files(group) -> Callable[[], Iterator[Chunk]]:
+            def gen() -> Iterator[Chunk]:
+                for fname in group:
+                    f = pq.ParquetFile(fname)
+                    yield from batches_to_chunks(
+                        f.iter_batches(batch_size=chunk_rows, columns=names))
+            return gen
+
+        parts = [make_files(g) for g in groups if len(g)]
+    else:
+        f0 = pq.ParquetFile(expanded[0])
+        n_rg = f0.num_row_groups
+        rg_bounds = [(i * n_rg // num_partitions, (i + 1) * n_rg // num_partitions)
+                     for i in range(num_partitions)]
+
+        def make_rgs(lo: int, hi: int) -> Callable[[], Iterator[Chunk]]:
+            def gen() -> Iterator[Chunk]:
+                if lo >= hi:
+                    return
+                f = pq.ParquetFile(expanded[0])
+                yield from batches_to_chunks(
+                    f.iter_batches(batch_size=chunk_rows, columns=names,
+                                   row_groups=list(range(lo, hi))))
+            return gen
+
+        parts = [make_rgs(lo, hi) for lo, hi in rg_bounds]
+
+    return DataFrame(PartitionedDataset.from_generators(parts), names)
+
+
 class DataFrameReader:
-    """``session.read`` surface: ``.option(...).schema(...).csv(path)``."""
+    """``session.read`` surface: ``.option(...).schema(...).csv(path)`` /
+    ``.parquet(path)``."""
 
     def __init__(self, *, default_parallelism: int = 2):
         self._opts: dict[str, Any] = {"sep": ","}
@@ -591,3 +666,16 @@ class DataFrameReader:
             dtypes=self._dtypes,
             num_partitions=int(self._opts.get(
                 "num_partitions", self._parallelism)))
+
+    def parquet(self, path: str | Sequence[str]) -> DataFrame:
+        # schema travels in the file; .schema() narrows columns, and its
+        # dtypes (meaningful for text parsing in .csv) apply here as casts
+        # so the same .schema(...) pipeline behaves identically on parquet
+        df = read_parquet(
+            path, columns=self._names,
+            num_partitions=int(self._opts.get(
+                "num_partitions", self._parallelism)))
+        if self._dtypes:
+            df = df.withColumns(
+                {n: col(n).cast(dt) for n, dt in self._dtypes.items()})
+        return df

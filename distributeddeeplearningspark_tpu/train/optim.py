@@ -27,6 +27,14 @@ def adamw(learning_rate: float | optax.Schedule, *, b1: float = 0.9, b2: float =
     return optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
 
 
+def lamb(learning_rate: float | optax.Schedule, *, b1: float = 0.9, b2: float = 0.999,
+         eps: float = 1e-6, weight_decay: float = 0.01) -> optax.GradientTransformation:
+    """LAMB (layerwise-adaptive) — the large-batch BERT pretraining optimizer
+    (You et al., arXiv:1904.00962); lets config 3 scale the global batch
+    across a pod without retuning the LR the way plain AdamW requires."""
+    return optax.lamb(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+
+
 def warmup_linear(peak_lr: float, warmup_steps: int, total_steps: int,
                   end_lr: float = 0.0) -> optax.Schedule:
     """BERT-style linear warmup then linear decay."""

@@ -15,6 +15,7 @@ from distributeddeeplearningspark_tpu.data.dataframe import (
     lit,
     log1p,
     read_csv,
+    read_parquet,
     when,
 )
 
@@ -226,3 +227,56 @@ def test_column_repr_names():
     assert c.name == "b"
     assert (col("x") * col("y")).name == "(x * y)"
     assert df_mod.clip(col("x"), 0, 1).name == "clip(x)"
+
+
+def test_read_parquet_single_file_row_groups(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+
+    t = pa.table({"x": np.arange(100, dtype=np.float32),
+                  "s": [f"u{i % 5}" for i in range(100)]})
+    p = tmp_path / "t.parquet"
+    pq.write_table(t, p, row_group_size=25)  # 4 row groups
+    df = read_parquet(str(p), num_partitions=2)
+    assert df.columns == ["x", "s"]
+    assert df.num_partitions == 2
+    assert df.count() == 100
+    out = df.withColumn("x2", col("x") * 2).take(3)
+    assert out[2]["x2"] == 4.0
+    # column projection
+    dfx = read_parquet(str(p), columns=["x"], num_partitions=2)
+    assert dfx.columns == ["x"]
+
+
+def test_read_parquet_multi_file_and_reader_surface(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+
+    for i in range(3):
+        pq.write_table(pa.table({"v": np.full(4, i, np.int64)}),
+                       tmp_path / f"part-{i}.parquet")
+    df = (DataFrameReader(default_parallelism=8)
+          .parquet(str(tmp_path / "part-*.parquet")))
+    assert df.num_partitions == 3  # clamped to file count
+    assert sorted(np.unique([r["v"] for r in df.collect()]).tolist()) == [0, 1, 2]
+    with pytest.raises(FileNotFoundError):
+        read_parquet(str(tmp_path / "nope-*.parquet"))
+
+
+def test_reader_parquet_applies_schema_dtypes(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+
+    pq.write_table(pa.table({"x": np.array([1.7, 2.2])}), tmp_path / "d.parquet")
+    df = (DataFrameReader(default_parallelism=1)
+          .schema(["x"], {"x": np.int32}).parquet(str(tmp_path / "d.parquet")))
+    vals = [r["x"] for r in df.collect()]
+    assert all(isinstance(v, np.int32) for v in vals)
+    assert vals == [1, 2]
+
+
+def test_expand_paths_literal_with_glob_chars(tmp_path):
+    p = tmp_path / "data[1].csv"
+    p.write_text("5\n")
+    df = read_csv(str(p), names=["v"], num_partitions=1)
+    assert df.collect()[0]["v"] == 5.0

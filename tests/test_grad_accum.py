@@ -119,3 +119,20 @@ def test_trainer_fit_accum_wiring(eight_devices):
     )
     assert int(jax.device_get(state.step)) == 4
     assert np.isfinite(summary["loss"])
+
+
+def test_lamb_optimizer_steps():
+    """LAMB (large-batch BERT optimizer): params move, lr schedule works."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearningspark_tpu.train import optim
+
+    tx = optim.lamb(optim.warmup_linear(1e-2, 2, 10))
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    state = tx.init(params)
+    grads = jax.tree.map(jnp.ones_like, params)
+    for _ in range(3):
+        updates, state = tx.update(grads, state, params)
+        params = __import__("optax").apply_updates(params, updates)
+    assert float(jnp.abs(params["w"] - 1.0).max()) > 0
