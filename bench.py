@@ -343,6 +343,15 @@ def main(argv=None) -> int:
     extra["device"] = getattr(jax.devices()[0], "device_kind", jax.devices()[0].platform)
     extra["backend"] = backend
 
+    def hbm_stats() -> dict | None:
+        try:
+            s = jax.local_devices()[0].memory_stats() or {}
+            keep = {k: int(v) for k, v in s.items()
+                    if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")}
+            return keep or None
+        except Exception:  # noqa: BLE001 — stats are best-effort extras
+            return None
+
     want = {"all": ("resnet50", "bert_base_mlm", "llama_lora"),
             "resnet": ("resnet50",),
             "bert": ("bert_base_mlm",),
@@ -365,6 +374,11 @@ def main(argv=None) -> int:
             results[name] = runners[name]()
         except Exception as e:  # noqa: BLE001 — report, don't crash the round
             extra["errors"].append(f"{name}: {type(e).__name__}: {str(e)[:300]}")
+    # process-lifetime HBM watermark (peak_bytes_in_use is monotonic across
+    # the whole process, so per-workload attribution would be wrong)
+    mem = hbm_stats()
+    if mem:
+        extra["hbm_process"] = mem
 
     if not args.skip_smoke and backend == "tpu":
         extra["pallas_smoke"] = pallas_smoke()

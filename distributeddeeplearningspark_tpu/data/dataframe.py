@@ -592,11 +592,18 @@ def read_parquet(
     group; a single file splits by row-group ranges). Column chunks stream
     batch-at-a-time — a partition never materializes its whole file set.
     """
+    import os
+
     import pyarrow.parquet as pq
 
+    # Spark's canonical input is a directory of part files
+    if isinstance(paths, str) and os.path.isdir(paths):
+        paths = os.path.join(paths, "*.parquet")
     expanded = _expand_paths(paths)
-    schema = pq.read_schema(expanded[0])
-    names = list(columns) if columns is not None else list(schema.names)
+    if columns is not None:
+        names = list(columns)
+    else:
+        names = list(pq.read_schema(expanded[0]).names)
 
     def batches_to_chunks(batches) -> Iterator[Chunk]:
         for rb in batches:

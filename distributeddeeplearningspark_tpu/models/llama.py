@@ -168,8 +168,8 @@ class LlamaAttention(nn.Module):
         positions = jnp.arange(x.shape[1])[None, :]
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
-        # GQA K/V stay at nkv heads: the flash kernel indexes groups directly;
-        # xla/ring fallbacks broadcast inside dot_product_attention.
+        # GQA K/V stay at nkv heads: flash indexes groups directly, ring
+        # runs grouped einsums; only the xla fallback broadcasts.
         y = dot_product_attention(q, k, v, mask=mask, causal=True,
                                   impl=cfg.attention_impl)
         rank = cfg.lora_rank if "wo" in cfg.lora_targets else 0

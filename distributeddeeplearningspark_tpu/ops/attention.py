@@ -52,7 +52,7 @@ def dot_product_attention(
     if impl == "ring":
         from distributeddeeplearningspark_tpu.ops.ring_attention import ring_attention
 
-        k, v = _expand_gqa(q, k, v)
+        # GQA-native: grouped KV rides the ring at Hkv width, no repeat
         return ring_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=scale)
     k, v = _expand_gqa(q, k, v)
     return _xla_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=scale)

@@ -76,14 +76,19 @@ def _causal_allowed(my_idx, blk, sq, sk):
 def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
     """One ring revolution of online softmax; returns (o, lse).
 
-    o: [B, Sq, H, D] in q.dtype; lse: [B, H, Sq] f32 (log-sum-exp of the
-    scaled logits — the only residual the backward needs beyond q/k/v/o).
+    o: [B, Sq, H, D] in q.dtype; lse: [B, Hkv, G, Sq] f32 (log-sum-exp of
+    the scaled logits — the only residual the backward needs beyond
+    q/k/v/o). **GQA-native**: K/V may carry Hkv ≤ H heads; Q reshapes to
+    [B, Sq, Hkv, G, D] (contiguous head groups, same convention as the
+    flash kernel) and every einsum runs grouped — the KV blocks riding the
+    ring are never copied up to Q-head width.
     """
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
-    sk = k.shape[1]
-    qf = q.astype(jnp.float32) * jnp.float32(scale)
+    sk, hkv = k.shape[1], k.shape[2]
+    g = h // hkv
+    qf = q.astype(jnp.float32).reshape(b, sq, hkv, g, d) * jnp.float32(scale)
 
     # receive from right neighbor: after i hops this chip holds block my+i
     perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
@@ -93,20 +98,20 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
         o, l, m = acc
         blk = (my_idx + i) % axis_size
         logits = jnp.einsum(
-            "bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+            "bqhgd,bkhd->bhgqk", qf, k_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
-        )
+        )                                                     # [B,Hkv,G,Sq,Sk]
         if causal:
             allowed = _causal_allowed(my_idx, blk, sq, sk)
             logits = jnp.where(allowed, logits, _NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))          # [B, H, Sq]
-        p = jnp.exp(logits - m_new[..., None])               # [B, H, Sq, Sk]
+        m_new = jnp.maximum(m, logits.max(axis=-1))           # [B,Hkv,G,Sq]
+        p = jnp.exp(logits - m_new[..., None])
         if causal:
             p = jnp.where(allowed, p, 0.0)
-        corr = jnp.exp(m - m_new)                            # [B, H, Sq]
+        corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
-        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv  # [B, Sq, H, D]
+        pv = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_cur.astype(jnp.float32))
+        o_new = o * corr.transpose(0, 3, 1, 2)[..., None] + pv  # [B,Sq,Hkv,G,D]
         return o_new, l_new, m_new
 
     def block(carry, i):
@@ -117,9 +122,9 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
         return (*acc, k_nxt, v_nxt), None
 
     init_acc = (
-        jnp.zeros((b, sq, h, d), jnp.float32),
-        jnp.zeros((b, h, sq), jnp.float32),
-        jnp.full((b, h, sq), _NEG_INF),
+        jnp.zeros((b, sq, hkv, g, d), jnp.float32),
+        jnp.zeros((b, hkv, g, sq), jnp.float32),
+        jnp.full((b, hkv, g, sq), _NEG_INF),
     )
     if axis_size > 1:
         # scan the first N-1 blocks (each ends with the neighbor exchange)...
@@ -130,9 +135,9 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
     else:
         o, l, m = accumulate(init_acc, 0, k, v)
     # causal ⇒ every query attends at least to itself ⇒ l > 0
-    out = o / l.transpose(0, 2, 1)[..., None]
+    out = o / l.transpose(0, 3, 1, 2)[..., None]
     lse = m + jnp.log(l)
-    return out.astype(q.dtype), lse
+    return out.reshape(b, sq, h, d).astype(q.dtype), lse
 
 
 def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, scale):
@@ -147,11 +152,13 @@ def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, scale):
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
-    sk = k.shape[1]
-    qf = q.astype(jnp.float32) * jnp.float32(scale)
-    dof = do.astype(jnp.float32)
+    sk, hkv = k.shape[1], k.shape[2]
+    g = h // hkv
+    qf = q.astype(jnp.float32).reshape(b, sq, hkv, g, d) * jnp.float32(scale)
+    dof = do.astype(jnp.float32).reshape(b, sq, hkv, g, d)
+    of = o.astype(jnp.float32).reshape(b, sq, hkv, g, d)
     # delta_i = Σ_d dO_i · O_i (FlashAttention-2's backward shortcut)
-    delta = jnp.einsum("bqhd,bqhd->bhq", dof, o.astype(jnp.float32))
+    delta = jnp.einsum("bqhgd,bqhgd->bhgq", dof, of)
 
     perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
 
@@ -159,22 +166,24 @@ def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, scale):
         dq, k_cur, v_cur, dk, dv = carry
         blk = (my_idx + i) % axis_size
         kf = k_cur.astype(jnp.float32)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf,
                             preferred_element_type=jnp.float32)
         if causal:
             allowed = _causal_allowed(my_idx, blk, sq, sk)
             logits = jnp.where(allowed, logits, _NEG_INF)
-        p = jnp.exp(logits - lse[..., None])                 # [B, H, Sq, Sk]
+        p = jnp.exp(logits - lse[..., None])                 # [B,Hkv,G,Sq,Sk]
         if causal:
             p = jnp.where(allowed, p, 0.0)
         # dV_blk += Pᵀ dO ; dP = dO Vᵀ ; dS = P ∘ (dP - delta)
-        dv = dv + jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, v_cur.astype(jnp.float32),
+        # (einsums sum over G, folding every q head of the group into the
+        # shared KV gradient — no repeated-KV copies anywhere)
+        dv = dv + jnp.einsum("bhgqk,bqhgd->bkhd", p, dof)
+        dp = jnp.einsum("bqhgd,bkhd->bhgqk", dof, v_cur.astype(jnp.float32),
                         preferred_element_type=jnp.float32)
         ds = p * (dp - delta[..., None])
         # qf already carries `scale`, so dK needs no extra factor; dQ does.
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * jnp.float32(scale)
-        dk = dk + jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        dq = dq + jnp.einsum("bhgqk,bkhd->bqhgd", ds, kf) * jnp.float32(scale)
+        dk = dk + jnp.einsum("bhgqk,bqhgd->bkhd", ds, qf)
         # rotate the whole (K, V, dK, dV) bundle — after axis_size hops each
         # block's accumulated gradient is back on its home chip
         k_cur, v_cur, dk, dv = (
@@ -183,13 +192,14 @@ def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, scale):
         return (dq, k_cur, v_cur, dk, dv), None
 
     init = (
-        jnp.zeros((b, sq, h, d), jnp.float32),
+        jnp.zeros((b, sq, hkv, g, d), jnp.float32),
         k, v,
-        jnp.zeros((b, sk, h, d), jnp.float32),
-        jnp.zeros((b, sk, h, d), jnp.float32),
+        jnp.zeros((b, sk, hkv, d), jnp.float32),
+        jnp.zeros((b, sk, hkv, d), jnp.float32),
     )
     (dq, _, _, dk, dv), _ = lax.scan(hop, init, jnp.arange(axis_size))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (dq.reshape(b, sq, h, d).astype(q.dtype),
+            dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -253,11 +263,20 @@ def ring_attention(
                 "ring_attention needs a mesh: pass mesh=, create a Session, "
                 "or call ops.ring_attention.set_default_mesh(mesh)"
             )
-    if q.shape != k.shape or k.shape != v.shape:
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes must match: {k.shape} vs {v.shape}")
+    b, s, h, d = q.shape
+    bk, sk, hkv, dk = k.shape
+    if (bk, sk, dk) != (b, s, d):
+        raise ValueError(f"q/k shape mismatch: {q.shape} vs {k.shape}")
+    if h % hkv:
+        raise ValueError(f"q heads {h} must be a multiple of kv heads {hkv}")
+    tensor_deg = mesh.shape.get(AXIS_TENSOR, 1)
+    if hkv % tensor_deg:
         raise ValueError(
-            f"ring attention requires equal q/k/v shapes (repeat GQA KV heads "
-            f"first): {q.shape} vs {k.shape} vs {v.shape}"
-        )
+            f"GQA-native ring shards K/V heads over '{AXIS_TENSOR}': kv heads "
+            f"({hkv}) must divide by the tensor degree ({tensor_deg}) — "
+            f"reduce mesh.tensor or repeat KV heads before calling")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     spec = P(BATCH_AXES, AXIS_SEQ, AXIS_TENSOR, None)
     # custom_vjp nondiff args must be passed positionally (not via partial

@@ -280,3 +280,16 @@ def test_expand_paths_literal_with_glob_chars(tmp_path):
     p.write_text("5\n")
     df = read_csv(str(p), names=["v"], num_partitions=1)
     assert df.collect()[0]["v"] == 5.0
+
+
+def test_read_parquet_directory(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+
+    d = tmp_path / "out"
+    d.mkdir()
+    for i in range(2):
+        pq.write_table(pa.table({"v": np.full(3, i, np.int64)}),
+                       d / f"part-{i}.parquet")
+    df = read_parquet(str(d), num_partitions=2)
+    assert df.count() == 6

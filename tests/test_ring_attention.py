@@ -67,8 +67,11 @@ def test_ring_rejects_mask_and_uneven_shapes(eight_devices):
     q, k, v = _qkv()
     with pytest.raises(NotImplementedError):
         ring_attention(q, k, v, mesh=mesh, mask=jnp.ones((4, 1, 1, 32), bool))
-    with pytest.raises(ValueError, match="equal q/k/v"):
+    with pytest.raises(ValueError, match="k/v shapes must match"):
         ring_attention(q, k[:, :, :2], v, mesh=mesh)
+    # GQA with a non-dividing head count is rejected
+    with pytest.raises(ValueError, match="multiple"):
+        ring_attention(q, k[:, :, :3], v[:, :, :3], mesh=mesh)
 
 
 def test_llama_context_parallel_train_step(eight_devices):
@@ -162,3 +165,54 @@ def test_ring_backward_does_not_stack_per_hop_probabilities(eight_devices):
         f"backward materializes arrays of sizes {sorted(set(offenders))} "
         f"(> {limit} elems ≈ 2 probability blocks) inside shard_map — "
         f"per-hop residuals are being stacked again")
+
+
+def test_ring_gqa_matches_xla_repeat(eight_devices):
+    """GQA-native ring (grouped KV on the ring, no repeat) == XLA attention
+    with explicitly repeated KV — values and grads."""
+    import jax.numpy as jnp
+
+    from distributeddeeplearningspark_tpu.ops.attention import dot_product_attention
+    from distributeddeeplearningspark_tpu.ops.ring_attention import ring_attention
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+
+    mesh = MeshSpec(data=2, seq=4).build(eight_devices)
+    b, s, h, hkv, d = 2, 32, 4, 2, 16
+    key = jax.random.PRNGKey(3)
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, hkv, d), jnp.float32)
+
+    def ring_loss(q, k, v):
+        o = ring_attention(q, k, v, mesh=mesh, causal=True)
+        return jnp.sum(o ** 2), o
+
+    def xla_loss(q, k, v):
+        kk = jnp.repeat(k, h // hkv, axis=2)
+        vv = jnp.repeat(v, h // hkv, axis=2)
+        o = dot_product_attention(q, kk, vv, causal=True, impl="xla")
+        return jnp.sum(o ** 2), o
+
+    with jax.sharding.use_mesh(mesh) if hasattr(jax.sharding, "use_mesh") else __import__("contextlib").nullcontext():
+        (lv, o1), g1 = jax.jit(jax.value_and_grad(ring_loss, argnums=(0, 1, 2),
+                                                  has_aux=True))(q, k, v)
+    (lv2, o2), g2 = jax.jit(jax.value_and_grad(xla_loss, argnums=(0, 1, 2),
+                                               has_aux=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=2e-5, atol=2e-5)
+    for a, b2 in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_ring_gqa_rejects_undividable_tensor_degree(eight_devices):
+    """kv heads must divide the tensor degree — clear error, not a cryptic
+    shard_map failure."""
+    from distributeddeeplearningspark_tpu.ops.ring_attention import ring_attention
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+
+    mesh = MeshSpec(data=2, seq=2, tensor=2).build()
+    q = jnp.zeros((2, 16, 4, 8))
+    kv = jnp.zeros((2, 16, 1, 8))  # 1 kv head, tensor=2
+    with pytest.raises(ValueError, match="tensor degree"):
+        ring_attention(q, kv, kv, mesh=mesh, causal=True)
