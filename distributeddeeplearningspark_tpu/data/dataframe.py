@@ -596,9 +596,12 @@ def read_parquet(
 
     import pyarrow.parquet as pq
 
-    # Spark's canonical input is a directory of part files
+    # Spark's canonical input is a directory of part files (escape the
+    # directory name so its own glob metacharacters stay literal)
     if isinstance(paths, str) and os.path.isdir(paths):
-        paths = os.path.join(paths, "*.parquet")
+        import glob as _glob
+
+        paths = os.path.join(_glob.escape(paths), "*.parquet")
     expanded = _expand_paths(paths)
     if columns is not None:
         names = list(columns)

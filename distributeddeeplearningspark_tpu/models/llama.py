@@ -64,6 +64,13 @@ class LlamaConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: Sequence[str] = ("wq", "wv")
+    # Autoregressive decoding (models/llama_gen.py): static-config switch so
+    # the scanned-layer call signature never changes. decode=True gives each
+    # attention a KV cache ("cache" collection) of max_cache_len positions;
+    # every call appends its tokens at the cache index and attends over the
+    # cached prefix. Equal-length prompts per batch (prefill writes [0, T)).
+    decode: bool = False
+    max_cache_len: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -165,16 +172,54 @@ class LlamaAttention(nn.Module):
         q = proj("wq", nh)(x)                                   # [B,S,nh,hd]
         k = proj("wk", nkv)(x)
         v = proj("wv", nkv)(x)
-        positions = jnp.arange(x.shape[1])[None, :]
-        q = rotary_embedding(q, positions, cfg.rope_theta)
-        k = rotary_embedding(k, positions, cfg.rope_theta)
-        # GQA K/V stay at nkv heads: flash indexes groups directly, ring
-        # runs grouped einsums; only the xla fallback broadcasts.
-        y = dot_product_attention(q, k, v, mask=mask, causal=True,
-                                  impl=cfg.attention_impl)
+        if cfg.decode:
+            if mask is not None:
+                raise ValueError(
+                    "decode mode has no padding-mask support: the KV cache "
+                    "assumes equal-length prompts (drop attention_mask and "
+                    "bucket/pad prompts to one length upstream)")
+            y = self._decode_attend(q, k, v)
+        else:
+            positions = jnp.arange(x.shape[1])[None, :]
+            q = rotary_embedding(q, positions, cfg.rope_theta)
+            k = rotary_embedding(k, positions, cfg.rope_theta)
+            # GQA K/V stay at nkv heads: flash indexes groups directly, ring
+            # runs grouped einsums; only the xla fallback broadcasts.
+            y = dot_product_attention(q, k, v, mask=mask, causal=True,
+                                      impl=cfg.attention_impl)
         rank = cfg.lora_rank if "wo" in cfg.lora_targets else 0
         return LoRADenseGeneral(cfg.hidden_size, axis=(-2, -1), rank=rank,
                                 alpha=cfg.lora_alpha, dtype=cfg.dtype, name="wo")(y)
+
+    def _decode_attend(self, q, k, v):
+        """KV-cached attention: append the T new tokens at the cache index,
+        attend q over the cached prefix. One code path serves prefill (T =
+        prompt length at index 0) and decode (T = 1). Static shapes: the
+        cache is [B, max_cache_len, nkv, hd]; masking, not slicing, bounds
+        the attended positions (XLA-friendly — no dynamic shapes)."""
+        cfg = self.cfg
+        b, t = q.shape[0], q.shape[1]
+        max_len = cfg.max_cache_len or cfg.max_position
+        ck = self.variable("cache", "k", jnp.zeros,
+                           (b, max_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
+        cv = self.variable("cache", "v", jnp.zeros,
+                           (b, max_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
+        cidx = self.variable("cache", "index",
+                             lambda: jnp.zeros((), jnp.int32))
+        idx = cidx.value
+        positions = idx + jnp.arange(t, dtype=jnp.int32)[None, :]
+        q = rotary_embedding(q, positions, cfg.rope_theta)
+        k = rotary_embedding(k, positions, cfg.rope_theta)
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+        cidx.value = idx + t
+        kpos = jnp.arange(max_len, dtype=jnp.int32)[None, None, None, :]
+        qpos = positions[:, None, :, None]
+        attend = kpos <= qpos                     # causal over cached prefix
+        return dot_product_attention(q, ck.value, cv.value, mask=attend,
+                                     causal=False, impl="xla")
 
 
 class LlamaMLP(nn.Module):
@@ -233,9 +278,12 @@ class LlamaForCausalLM(nn.Module):
         if cfg.remat:
             layer_cls = nn.remat(layer_cls, prevent_cse=False)
         if cfg.scan_layers:
+            var_axes = {"params": 0}
+            if cfg.decode:
+                var_axes["cache"] = 0           # per-layer KV caches, stacked
             stacked = nn.scan(
                 layer_cls,
-                variable_axes={"params": 0},
+                variable_axes=var_axes,
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,           # mask is shared, not scanned
                 length=cfg.num_layers,

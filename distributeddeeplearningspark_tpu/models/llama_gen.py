@@ -1,0 +1,120 @@
+"""Autoregressive generation for Llama — KV-cached decode, fully jitted.
+
+The training contract (BASELINE.json config 5) ends at the fine-tune, but a
+usable flagship needs sampling; the reference world serves its tuned model
+via the same predict path it trains with. TPU-first decode design:
+
+- **Static shapes end-to-end**: the KV cache is ``[B, max_cache_len, ...]``
+  from the first call; masking (not slicing) bounds attention, and the
+  decode loop is one ``lax.scan`` of single-token steps — one compiled
+  program regardless of prompt/output lengths (pad prompts per bucket to
+  avoid recompiles).
+- **Prefill + decode share one cache path** (``LlamaAttention._decode_attend``):
+  prefill writes the whole prompt at index 0 in one MXU-sized pass, then
+  each scan step appends one token.
+- Sampling: greedy (``temperature=0``) or temperature softmax with optional
+  top-k truncation; an ``eos_id`` freezes finished rows (they emit ``pad_id``
+  thereafter) while the batch keeps stepping — SPMD-friendly, no early exit.
+
+Equal-length prompts per batch (left-pad or bucket upstream — documented
+limitation of the shared cache index).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from distributeddeeplearningspark_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _sample(logits: jax.Array, key: jax.Array, *, temperature: float,
+            top_k: int) -> jax.Array:
+    """[B, V] f32 logits → [B] int32 token ids."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.float32(temperature)
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def decode_model(cfg: LlamaConfig, max_cache_len: int) -> LlamaForCausalLM:
+    """The decode-mode twin of a training model (same params tree)."""
+    return LlamaForCausalLM(dataclasses.replace(
+        cfg, decode=True, max_cache_len=max_cache_len,
+        attention_impl="xla", remat=False))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k",
+                     "eos_id", "pad_id", "max_cache_len"),
+)
+def generate(
+    params: Any,
+    input_ids: jax.Array,
+    *,
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+    max_cache_len: int | None = None,
+) -> jax.Array:
+    """Generate ``[B, max_new_tokens]`` continuations of ``input_ids`` [B, T].
+
+    ``params`` is the training model's param tree (LoRA adapters, if any,
+    stay active — merge first via ``llama_io.merge_lora`` for merged-weight
+    speed). Deterministic for ``temperature=0`` (greedy).
+    """
+    b, t = input_ids.shape
+    total = max_cache_len or (t + max_new_tokens)
+    if t + max_new_tokens > total:
+        raise ValueError(
+            f"prompt {t} + new {max_new_tokens} exceeds max_cache_len {total} "
+            f"— cache writes would clamp and corrupt output")
+    if total > cfg.max_position:
+        raise ValueError(
+            f"cache length {total} exceeds max_position {cfg.max_position}")
+    model = decode_model(cfg, total)
+
+    # prefill: whole prompt in one pass; cache initialized by flax on first
+    # apply (mutable collection), so init+prefill are a single call
+    variables = {"params": params}
+    logits, mutated = model.apply(
+        variables, {"input_ids": input_ids}, train=False, mutable=["cache"])
+    key = jax.random.PRNGKey(seed)
+    key, sub = jax.random.split(key)
+    tok = _sample(logits[:, -1].astype(jnp.float32), sub,
+                  temperature=temperature, top_k=top_k)
+    done = jnp.zeros((b,), bool)
+    if eos_id is not None:
+        done = tok == eos_id
+
+    def step(carry, _):
+        cache, tok, key, done = carry
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            {"input_ids": tok[:, None]}, train=False, mutable=["cache"])
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits[:, -1].astype(jnp.float32), sub,
+                      temperature=temperature, top_k=top_k)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(pad_id), nxt)
+            done = done | (nxt == eos_id)
+        return (mutated["cache"], nxt, key, done), tok
+
+    (_, last, _, _), toks = jax.lax.scan(
+        step, (mutated["cache"], tok, key, done), None,
+        length=max_new_tokens - 1)
+    # toks holds tokens 0..N-2 (each step emits its INPUT token); append last
+    return jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)

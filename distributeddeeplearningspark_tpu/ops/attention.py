@@ -17,8 +17,8 @@ All implementations take/return ``[batch, seq, heads, head_dim]`` (BSHD
 layout — batch and sequence leading so (data, fsdp) batch sharding and
 ``seq``-axis context parallelism shard the first two dims without transposes).
 K/V may carry fewer heads than Q (GQA; ``num_heads % num_kv_heads == 0``) —
-the flash kernel indexes the grouped heads directly, the xla/ring paths
-broadcast them (an O(group) HBM copy the kernel path exists to avoid).
+the flash kernel and the ring path index/compute grouped heads directly;
+only the xla fallback broadcasts KV up (an O(group) HBM copy).
 """
 
 from __future__ import annotations

@@ -36,6 +36,9 @@ def main() -> None:
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--lora-rank", type=int, default=8)
+    p.add_argument("--sample-tokens", type=int, default=0,
+                   help="after training, sample this many tokens from the "
+                        "tuned model (KV-cached decode)")
     p.add_argument("--lora-alpha", type=float, default=16.0)
     p.add_argument("--fsdp", type=int, default=-1, help="FSDP axis size (-1: all devices)")
     p.add_argument("--tensor", type=int, default=1, help="tensor-parallel axis size")
@@ -125,6 +128,18 @@ def main() -> None:
         tokens_per_example=args.seq_len, log_every=10,
     )
     print({k: round(float(v), 4) for k, v in summary.items()})
+    if args.sample_tokens:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from distributeddeeplearningspark_tpu.models.llama_gen import generate
+
+        prompt = jnp.asarray(
+            np.tile(np.arange(8, dtype=np.int32)[None] % cfg.vocab_size, (2, 1)))
+        out = generate(state.params, prompt, cfg=cfg,
+                       max_new_tokens=args.sample_tokens, temperature=0.8,
+                       top_k=40, seed=0)
+        print("sampled continuations:", np.asarray(out).tolist())
     spark.stop()
 
 

@@ -1,0 +1,130 @@
+"""KV-cached Llama generation (models/llama_gen.py): the decode path must be
+numerically identical to the training forward, and the jitted sampler must
+match a naive full-recompute rollout."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu.models import LlamaConfig, LlamaForCausalLM
+from distributeddeeplearningspark_tpu.models.llama_gen import decode_model, generate
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    variables = model.init(jax.random.PRNGKey(0), {"input_ids": prompt},
+                           train=False)
+    return cfg, model, variables["params"], prompt
+
+
+def test_decode_logits_match_teacher_forcing(tiny):
+    """Prefill + per-token decode reproduce the full-forward logits exactly
+    (the KV cache holds the same K/V the training path recomputes)."""
+    cfg, model, params, _ = tiny
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    ref = model.apply({"params": params}, {"input_ids": ids}, train=False)
+    dmodel = decode_model(cfg, 12)
+    lo, mut = dmodel.apply({"params": params}, {"input_ids": ids[:, :8]},
+                           train=False, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(lo), np.asarray(ref[:, :8]),
+                               rtol=2e-4, atol=2e-4)
+    cache = mut["cache"]
+    for i in range(8, 12):
+        lo, mut = dmodel.apply({"params": params, "cache": cache},
+                               {"input_ids": ids[:, i:i + 1]},
+                               train=False, mutable=["cache"])
+        cache = mut["cache"]
+        np.testing.assert_allclose(np.asarray(lo[:, 0]), np.asarray(ref[:, i]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_greedy_generate_matches_full_recompute_rollout(tiny):
+    cfg, model, params, prompt = tiny
+    out = generate(params, jnp.asarray(prompt), cfg=cfg, max_new_tokens=6)
+    assert out.shape == (2, 6)
+    ids = prompt
+    for _ in range(6):
+        lg = model.apply({"params": params}, {"input_ids": jnp.asarray(ids)},
+                         train=False)
+        nxt = np.argmax(np.asarray(lg[:, -1]), -1).astype(np.int32)
+        ids = np.concatenate([ids, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), ids[:, 8:])
+
+
+def test_generate_unscanned_layers_matches_scanned(tiny):
+    cfg, _, params, prompt = tiny
+    out_scan = generate(params, jnp.asarray(prompt), cfg=cfg, max_new_tokens=4)
+    # same params flattened into the unscanned layout would differ in tree
+    # structure; instead just check the unscanned decode path runs and is
+    # self-consistent with its own training forward
+    cfg2 = dataclasses.replace(cfg, scan_layers=False)
+    model2 = LlamaForCausalLM(cfg2)
+    v2 = model2.init(jax.random.PRNGKey(1), {"input_ids": prompt}, train=False)
+    out2 = generate(v2["params"], jnp.asarray(prompt), cfg=cfg2,
+                    max_new_tokens=4)
+    ids = prompt
+    for _ in range(4):
+        lg = model2.apply({"params": v2["params"]},
+                          {"input_ids": jnp.asarray(ids)}, train=False)
+        nxt = np.argmax(np.asarray(lg[:, -1]), -1).astype(np.int32)
+        ids = np.concatenate([ids, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out2), ids[:, 8:])
+    assert out_scan.shape == out2.shape
+
+
+def test_eos_freezes_finished_rows(tiny):
+    """After a row emits eos, it pads; other rows keep generating."""
+    cfg, model, params, prompt = tiny
+    ref = generate(params, jnp.asarray(prompt), cfg=cfg, max_new_tokens=5)
+    eos = int(np.asarray(ref)[0, 1])  # force row 0 to 'finish' at step 1
+    out = np.asarray(generate(params, jnp.asarray(prompt), cfg=cfg,
+                              max_new_tokens=5, eos_id=eos, pad_id=0))
+    row = out[0]
+    hit = np.flatnonzero(row == eos)
+    assert hit.size, "eos token never appears in the row that produced it"
+    assert (row[hit[0] + 1:] == 0).all(), f"row not frozen after eos: {row}"
+
+
+def test_sampling_modes_are_valid(tiny):
+    cfg, _, params, prompt = tiny
+    out = generate(params, jnp.asarray(prompt), cfg=cfg, max_new_tokens=4,
+                   temperature=1.0, top_k=8, seed=3)
+    arr = np.asarray(out)
+    assert arr.shape == (2, 4)
+    assert (0 <= arr).all() and (arr < cfg.vocab_size).all()
+    # reproducible for a fixed seed
+    out2 = generate(params, jnp.asarray(prompt), cfg=cfg, max_new_tokens=4,
+                    temperature=1.0, top_k=8, seed=3)
+    np.testing.assert_array_equal(arr, np.asarray(out2))
+
+
+def test_cache_overflow_rejected(tiny):
+    cfg, _, params, prompt = tiny
+    with pytest.raises(ValueError, match="max_position"):
+        generate(params, jnp.asarray(prompt), cfg=cfg,
+                 max_new_tokens=cfg.max_position + 1)
+
+
+def test_explicit_cache_len_too_small_rejected(tiny):
+    cfg, _, params, prompt = tiny
+    with pytest.raises(ValueError, match="max_cache_len"):
+        generate(params, jnp.asarray(prompt), cfg=cfg, max_new_tokens=8,
+                 max_cache_len=10)  # 8 prompt + 8 new > 10
+
+
+def test_decode_rejects_padding_mask(tiny):
+    cfg, _, params, prompt = tiny
+    dmodel = decode_model(cfg, 16)
+    with pytest.raises(ValueError, match="equal-length prompts"):
+        dmodel.apply({"params": params},
+                     {"input_ids": prompt,
+                      "attention_mask": np.ones_like(prompt)},
+                     train=False, mutable=["cache"])
