@@ -177,3 +177,45 @@ def test_trainer_pp_fit(eight_devices):
     state, summary = trainer.fit(ds.repeat(), batch_size=8, steps=3, log_every=10)
     assert int(jax.device_get(state.step)) == 3
     assert np.isfinite(summary["loss"])
+
+
+def test_pp_composes_with_tp_and_dp(eight_devices):
+    """data=2 x pipe=2 x tensor=2: the GPipe ring, Megatron TP sharding, and
+    batch sharding in one step — loss equals the pure-DP loss."""
+    import optax
+
+    from distributeddeeplearningspark_tpu.data.feed import put_global, stack_examples
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig, LlamaForCausalLM, llama_rules)
+    from distributeddeeplearningspark_tpu.models.llama_pp import make_pp_apply
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+    from distributeddeeplearningspark_tpu.parallel.sharding import ShardingRules
+    from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    examples = [{"input_ids": np.arange(32, dtype=np.int32) + i,
+                 "loss_mask": np.ones((32,), np.float32)} for i in range(16)]
+    for e in examples:
+        e["input_ids"] %= cfg.vocab_size
+    batch = stack_examples(examples)
+    tx = optax.adamw(1e-3)
+
+    mesh = MeshSpec(data=2, pipe=2, tensor=2).build()
+    state, sh = step_lib.init_state(
+        model, tx, batch, mesh, llama_rules(cfg, fsdp=False, pipeline=True))
+    ts = step_lib.jit_train_step(
+        step_lib.make_train_step(make_pp_apply(cfg, mesh, 4), tx,
+                                 losses.causal_lm), mesh, sh)
+    _, met = ts(state, put_global(batch, mesh))
+
+    mesh_dp = MeshSpec(data=8).build()
+    state_dp, sh_dp = step_lib.init_state(model, tx, batch, mesh_dp,
+                                          ShardingRules())
+    ts_dp = step_lib.jit_train_step(
+        step_lib.make_train_step(model.apply, tx, losses.causal_lm),
+        mesh_dp, sh_dp)
+    _, met_dp = ts_dp(state_dp, put_global(batch, mesh_dp))
+    np.testing.assert_allclose(float(jax.device_get(met["loss"])),
+                               float(jax.device_get(met_dp["loss"])),
+                               rtol=1e-4)
