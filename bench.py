@@ -257,6 +257,43 @@ def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048) -> dict:
     return rec
 
 
+def bench_dlrm(iters: int, batch_size: int = 8192) -> dict:
+    """DLRM examples/sec/chip (config 4 shape: 13 dense + 26 embeddings).
+
+    Recommender steps are tiny-FLOP / gather-bound, so the headline here is
+    examples/sec, not MFU. Reported in ``extra`` only.
+    """
+    import optax
+
+    from distributeddeeplearningspark_tpu.data.feed import stack_examples
+    from distributeddeeplearningspark_tpu.models import DLRM
+    from distributeddeeplearningspark_tpu.models.dlrm import dlrm_rules
+    from distributeddeeplearningspark_tpu.train import losses
+
+    vocabs = (100_000,) * 26
+    model = DLRM(vocab_sizes=vocabs, embed_dim=64,
+                 bottom_mlp=(512, 256, 64))
+    rng = np.random.default_rng(3)
+    batch = stack_examples([
+        {"dense": rng.normal(0, 1, (13,)).astype(np.float32),
+         "sparse": np.array([rng.integers(0, v) for v in vocabs], np.int32),
+         "label": np.int32(rng.integers(0, 2))}
+        for _ in range(batch_size)])
+    mesh, state, step, gbatch, flops = _train_setup(
+        model, batch, losses.binary_xent, tx=optax.adagrad(1e-2),
+        rules=dlrm_rules())
+    n_chips = mesh.devices.size
+    step_time, _ = bench_steps(step, state, gbatch, iters=iters)
+    return {
+        "examples_per_sec_per_chip": round(batch_size / step_time / n_chips, 1),
+        "step_time_ms": round(step_time * 1e3, 3),
+        "mfu": 0.0,  # gather-bound; MFU is not the meaningful axis here
+        "batch_size": batch_size,
+        "embedding_rows": sum(vocabs),
+        "chips": n_chips,
+    }
+
+
 def pallas_smoke() -> dict:
     """Compile-and-run flash attention fwd+bwd on the real chip (Mosaic).
 
@@ -306,7 +343,8 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float, extra: dict) 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", choices=["all", "resnet", "bert", "llama"],
+    ap.add_argument("--model",
+                    choices=["all", "resnet", "bert", "llama", "dlrm"],
                     default="all")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--batch", type=int, default=0,
@@ -352,10 +390,11 @@ def main(argv=None) -> int:
         except Exception:  # noqa: BLE001 — stats are best-effort extras
             return None
 
-    want = {"all": ("resnet50", "bert_base_mlm", "llama_lora"),
+    want = {"all": ("resnet50", "bert_base_mlm", "llama_lora", "dlrm"),
             "resnet": ("resnet50",),
             "bert": ("bert_base_mlm",),
-            "llama": ("llama_lora",)}[args.model]
+            "llama": ("llama_lora",),
+            "dlrm": ("dlrm",)}[args.model]
     runners = {
         "resnet50": lambda: bench_resnet(
             args.iters, **({"batch_size": args.batch} if args.batch else {})),
@@ -367,6 +406,8 @@ def main(argv=None) -> int:
             max(5, args.iters // 2),
             **({"batch_size": args.batch} if args.batch else {}),
             **({"seq": args.seq} if args.seq else {})),
+        "dlrm": lambda: bench_dlrm(
+            args.iters, **({"batch_size": args.batch} if args.batch else {})),
     }
     results: dict = {}
     for name in want:

@@ -15,7 +15,17 @@ Schedule: classic GPipe. M microbatches flow through P stages in M + P - 1
 ticks (bubble fraction (P-1)/(M+P-1)); each tick every stage runs one
 microbatch and hands its activation to the next stage. Backward is plain
 autodiff through the scan (activations rematerialized per-tick under
-``jax.checkpoint`` if the caller wraps ``stage_fn``).
+``jax.checkpoint`` if the caller wraps ``stage_fn`` — models/llama_pp.py
+does, via ``cfg.remat``).
+
+Why GPipe-with-remat and not hand-interleaved 1F1B: 1F1B's advantage over
+GPipe is holding P (not M) microbatch activations live. Under XLA, remat
+already bounds the scan's saved state to the per-tick boundary activations
+(O(M + P) boundary tensors, recompute inside stages), and a hand-written
+interleaved forward/backward schedule would require a custom VJP that
+fights — instead of rides — XLA's scheduler and rematerialization. The
+compiler-friendly scan keeps the bubble identical ((P-1)/(M+P-1)); raise M
+to amortize it.
 """
 
 from __future__ import annotations

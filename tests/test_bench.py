@@ -72,6 +72,9 @@ def test_bench_failure_in_one_model_does_not_kill_the_other(monkeypatch, capsys)
         "tokens_per_sec_per_chip": 1.0, "mfu_lower_bound": 0.1,
         "step_time_ms": 1.0, "params": 1, "batch_size": 4, "seq_len": 2048,
         "chips": 1})
+    monkeypatch.setattr(bench, "bench_dlrm", lambda iters, **kw: {
+        "examples_per_sec_per_chip": 1.0, "mfu": 0.0, "step_time_ms": 1.0,
+        "batch_size": 8192, "embedding_rows": 1, "chips": 1})
     monkeypatch.setattr(bench, "pallas_smoke", lambda: {"causal_d128": "ok"})
     rc = bench.main([])
     assert rc == 0
@@ -123,6 +126,9 @@ def test_timing_suspect_zeroes_vs_baseline(monkeypatch, capsys):
         "tokens_per_sec_per_chip": 1.0, "mfu_lower_bound": 0.1,
         "step_time_ms": 1.0, "params": 1, "batch_size": 4, "seq_len": 2048,
         "chips": 1})
+    monkeypatch.setattr(bench, "bench_dlrm", lambda iters, **kw: {
+        "examples_per_sec_per_chip": 1.0, "mfu": 0.0, "step_time_ms": 1.0,
+        "batch_size": 8192, "embedding_rows": 1, "chips": 1})
     monkeypatch.setattr(bench, "pallas_smoke", lambda: {})
     assert bench.main([]) == 0
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
