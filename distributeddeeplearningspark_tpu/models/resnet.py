@@ -47,7 +47,10 @@ class BottleneckBlock(nn.Module):
         residual = x
         y = conv(self.filters, (1, 1))(x)
         y = nn.relu(norm()(y))
-        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        # explicit (1,1) padding = torch semantics; flax SAME pads (0,1) on
+        # stride-2, which would break pretrained-weight parity (resnet_io)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)])(y)
         y = nn.relu(norm()(y))
         y = conv(4 * self.filters, (1, 1))(y)
         # zero-init gamma on the last BN: each block starts as identity,
@@ -75,9 +78,11 @@ class BasicBlock(nn.Module):
             epsilon=1e-5, dtype=jnp.float32,
         )
         residual = x
-        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        # explicit (1,1) padding = torch semantics (see BottleneckBlock)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)])(x)
         y = nn.relu(norm()(y))
-        y = conv(self.filters, (3, 3))(y)
+        y = conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)])(y)
         y = norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = conv(self.filters, (1, 1), strides=(self.strides, self.strides),

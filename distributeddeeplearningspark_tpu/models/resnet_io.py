@@ -1,0 +1,129 @@
+"""ResNet ← torchvision-convention weight import.
+
+Config 2's operating mode in the reference world starts from the canonical
+ImageNet-pretrained ResNet-50 (`torchvision.models.resnet50().state_dict()`
+naming — the layout virtually every published ResNet checkpoint uses;
+SURVEY.md §2 'Models: ResNet-50' — "vendored or torchvision"). torchvision
+itself is not installed here, so this maps the *key convention* onto our
+flax tree; the numerical contract is proven in tests against the
+`transformers` torch ResNet (same v1.5 architecture, renamed keys).
+
+Layout bridged:
+
+- torch convs are OIHW → flax HWIO (transpose ``(2, 3, 1, 0)``).
+- torch ``fc.weight`` is [out, in] → flax ``head.kernel`` [in, out].
+- BatchNorm splits: ``weight``/``bias`` → params ``scale``/``bias``;
+  ``running_mean``/``running_var`` → **batch_stats** ``mean``/``var``
+  (returned separately — pass both to ``model.apply``).
+- ``layer{s}.{b}`` → the flat auto-named block index
+  ``{Bottleneck,Basic}Block_{sum(depths[:s-1]) + b}``; ``conv{i}``/``bn{i}``
+  → ``Conv_{i-1}``/``BatchNorm_{i-1}``; ``downsample.0/.1`` →
+  ``shortcut_conv``/``shortcut_bn``.
+
+The model's 3×3 convs use explicit (1, 1) padding (torch semantics) so the
+import is numerically exact — flax ``SAME`` would pad (0, 1) on stride-2.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+
+def import_torchvision_resnet(
+    state_dict: Mapping, *, stage_sizes, bottleneck: bool = True
+) -> tuple[dict, dict]:
+    """torchvision-convention ``state_dict`` → (params, batch_stats) trees.
+
+    ``stage_sizes``: blocks per stage, e.g. ``(3, 4, 6, 3)`` for ResNet-50
+    (must match the target model). ``bottleneck``: True for 50/101/152,
+    False for 18/34 (two convs per block instead of three).
+    """
+    sd = {k: np.asarray(v) for k, v in state_dict.items()
+          if not k.endswith("num_batches_tracked")}
+    block_name = "BottleneckBlock" if bottleneck else "BasicBlock"
+    n_convs = 3 if bottleneck else 2
+    params: dict = {}
+    stats: dict = {}
+
+    def conv(key):
+        return {"kernel": sd[key].transpose(2, 3, 1, 0)}
+
+    def bn(prefix):
+        return (
+            {"scale": sd[f"{prefix}.weight"], "bias": sd[f"{prefix}.bias"]},
+            {"mean": sd[f"{prefix}.running_mean"],
+             "var": sd[f"{prefix}.running_var"]},
+        )
+
+    params["stem_conv"] = conv("conv1.weight")
+    params["stem_bn"], stats["stem_bn"] = bn("bn1")
+
+    idx = 0
+    for s, depth in enumerate(stage_sizes, start=1):
+        for b in range(depth):
+            name = f"{block_name}_{idx}"
+            idx += 1
+            p_blk: dict = {}
+            s_blk: dict = {}
+            for c in range(n_convs):
+                p_blk[f"Conv_{c}"] = conv(f"layer{s}.{b}.conv{c + 1}.weight")
+                p_blk[f"BatchNorm_{c}"], s_blk[f"BatchNorm_{c}"] = bn(
+                    f"layer{s}.{b}.bn{c + 1}")
+            if f"layer{s}.{b}.downsample.0.weight" in sd:
+                p_blk["shortcut_conv"] = conv(f"layer{s}.{b}.downsample.0.weight")
+                p_blk["shortcut_bn"], s_blk["shortcut_bn"] = bn(
+                    f"layer{s}.{b}.downsample.1")
+            params[name] = p_blk
+            stats[name] = s_blk
+
+    params["head"] = {"kernel": sd["fc.weight"].T, "bias": sd["fc.bias"]}
+    return params, stats
+
+
+def hf_resnet_to_torchvision_keys(state_dict: Mapping) -> dict:
+    """``transformers`` torch ResNet ``state_dict`` → torchvision naming.
+
+    The HF graph is the same v1.5 ResNet with renamed modules
+    (``resnet.embedder...`` → ``conv1``/``bn1``, ``resnet.encoder.stages.S
+    .layers.B.layer.C`` → ``layerS+1.B.convC+1``, ``shortcut`` →
+    ``downsample``, ``classifier.1`` → ``fc``); used by the parity tests and
+    by anyone holding an HF-format ResNet checkpoint.
+    """
+    out = {}
+    skipped = []
+    for k, v in state_dict.items():
+        if k.endswith("num_batches_tracked"):
+            continue
+        parts = k.split(".")
+        if k.startswith("resnet.embedder"):
+            leaf = parts[-1]
+            kind = "conv1" if parts[-2] == "convolution" else "bn1"
+            if kind == "conv1":
+                out["conv1.weight"] = v
+            else:
+                out[f"bn1.{leaf}"] = v
+        elif k.startswith("resnet.encoder.stages."):
+            s, b = int(parts[3]), int(parts[5])
+            if parts[6] == "shortcut":
+                which = "0" if parts[7] == "convolution" else "1"
+                out[f"layer{s + 1}.{b}.downsample.{which}.{parts[-1]}"] = v
+            else:  # layer.C.{convolution|normalization}
+                c = int(parts[7])
+                if parts[8] == "convolution":
+                    out[f"layer{s + 1}.{b}.conv{c + 1}.weight"] = v
+                else:
+                    out[f"layer{s + 1}.{b}.bn{c + 1}.{parts[-1]}"] = v
+        elif k.startswith("classifier."):
+            out[f"fc.{parts[-1]}"] = v
+        else:
+            skipped.append(k)
+    if not out or len(skipped) > len(out):
+        raise ValueError(
+            f"state_dict does not look like a transformers "
+            f"ResNetForImageClassification checkpoint: matched {len(out)} "
+            f"keys, unrecognized {len(skipped)} (e.g. {skipped[:3]}) — a "
+            f"bare ResNetModel lacks the 'resnet.' prefix; wrap it or "
+            f"rename keys first")
+    return out
