@@ -155,7 +155,7 @@ class Trainer:
             f"{type(self.model).__name__} has no pipeline-parallel forward — "
             f"use a pipe=1 mesh or a pipeline-capable model (Llama)")
 
-    def load_pretrained(self, params, *, strict: bool = False,
+    def load_pretrained(self, params, *, batch_stats=None, strict: bool = False,
                         allow_uncovered: Sequence[str] = ("lora_",)) -> TrainState:
         """Overlay imported weights (e.g. a HF Llama safetensors tree) on state.
 
@@ -207,6 +207,32 @@ class Trainer:
             logger.warning("%d model params not covered by pretrained overlay "
                            "(e.g. %s)", len(uncovered), sorted(uncovered)[:3])
         self.state = self.state.replace(params=new_params)
+        if batch_stats is not None:
+            # pretrained running statistics (e.g. a torchvision ResNet's BN
+            # means/vars — resnet_io returns them alongside the params)
+            cur = self.state.mutable.get("batch_stats")
+            if cur is None:
+                raise ValueError(
+                    "batch_stats given but the model has no batch_stats "
+                    "collection")
+            stats_sh = self.state_shardings.mutable["batch_stats"]
+
+            def place(path, current, sharding):
+                node = batch_stats
+                try:
+                    for p in path:
+                        node = node[getattr(p, "key", getattr(p, "idx", None))]
+                except (KeyError, TypeError):
+                    return current
+                if tuple(np.shape(node)) != tuple(current.shape):
+                    raise ValueError(
+                        f"batch_stats {path_str(path)}: shape "
+                        f"{np.shape(node)} != model {current.shape}")
+                return jax.device_put(np.asarray(node, current.dtype), sharding)
+
+            new_stats = jax.tree_util.tree_map_with_path(place, cur, stats_sh)
+            self.state = self.state.replace(
+                mutable={**self.state.mutable, "batch_stats": new_stats})
         return self.state
 
     def restore(self, checkpointer=None, *, step: int | None = None):

@@ -11,6 +11,8 @@ SPMD step on the mesh::
 import argparse
 import logging
 
+import numpy as np
+
 from distributeddeeplearningspark_tpu import Session, Trainer
 from distributeddeeplearningspark_tpu.data import vision
 from distributeddeeplearningspark_tpu.data.sources import synthetic_images
@@ -34,6 +36,9 @@ def main() -> None:
     p.add_argument("--tensorboard-dir", default=None)
     p.add_argument("--mfu", action="store_true",
                    help="report achieved MFU (costs one extra compile)")
+    p.add_argument("--weights", default=None,
+                   help="pretrained backbone: a torch .pt/.pth state_dict in "
+                        "the torchvision resnet naming (fine-tune mode)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -63,6 +68,23 @@ def main() -> None:
         spark, model, losses.softmax_xent,
         optim.sgd(schedule, momentum=0.9, weight_decay=1e-4),
     )
+    if args.weights:
+        import torch
+
+        from distributeddeeplearningspark_tpu.models.resnet_io import (
+            import_torchvision_resnet)
+
+        sd = torch.load(args.weights, map_location="cpu", weights_only=True)
+        stage_sizes = (3, 4, 6, 3) if args.variant == "resnet50" else (2, 2, 2, 2)
+        params, stats = import_torchvision_resnet(
+            sd, stage_sizes=stage_sizes, bottleneck=args.variant == "resnet50")
+        if args.num_classes != np.shape(params["head"]["bias"])[0]:
+            # fine-tuning to a new label space: keep the fresh-init head
+            params.pop("head")
+        trainer.init(trainer._sample_batch(ds, args.batch_size))
+        trainer.load_pretrained(params, batch_stats=stats,
+                                allow_uncovered=("head",))
+
     profile = None
     if args.profile_dir:
         from distributeddeeplearningspark_tpu.utils.profiling import ProfileSpec

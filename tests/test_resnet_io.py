@@ -77,3 +77,36 @@ def test_translator_rejects_unrecognized_layout():
     with pytest.raises(ValueError, match="does not look like"):
         hf_resnet_to_torchvision_keys(
             {"embedder.convolution.weight": np.zeros((8, 3, 7, 7))})
+
+
+def test_trainer_load_pretrained_places_batch_stats(eight_devices):
+    """load_pretrained(batch_stats=...) lands running BN stats in
+    state.mutable; a fresh-head fine-tune keeps the init head."""
+    import optax
+
+    from distributeddeeplearningspark_tpu import Session, Trainer
+    from distributeddeeplearningspark_tpu.train import losses
+
+    depths, widths = (2, 2), (8, 16)
+    m = _hf_tiny(depths, widths, stem=8, classes=1000)
+    sd = hf_resnet_to_torchvision_keys(m.state_dict())
+    params, stats = import_torchvision_resnet(sd, stage_sizes=depths)
+    params.pop("head")  # new label space
+
+    spark = Session.builder.master("local[8]").appName("ft").getOrCreate()
+    model = ResNet(stage_sizes=depths, num_classes=5, width=widths[0],
+                   dtype=np.float32)
+    trainer = Trainer(spark, model, losses.softmax_xent, optax.sgd(0.1))
+    batch = {"image": np.zeros((8, 32, 32, 3), np.float32),
+             "label": np.zeros((8,), np.int32)}
+    trainer.init(batch)
+    trainer.load_pretrained(params, batch_stats=stats,
+                            allow_uncovered=("head",))
+    got = np.asarray(
+        trainer.state.mutable["batch_stats"]["stem_bn"]["mean"])
+    np.testing.assert_allclose(got, sd["bn1.running_mean"], rtol=1e-6)
+    got_w = np.asarray(trainer.state.params["stem_conv"]["kernel"])
+    np.testing.assert_allclose(
+        got_w, np.asarray(sd["conv1.weight"]).transpose(2, 3, 1, 0), rtol=1e-6)
+    assert trainer.state.params["head"]["bias"].shape == (5,)
+    spark.stop()
