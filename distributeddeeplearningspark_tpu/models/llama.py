@@ -60,6 +60,14 @@ class LlamaConfig:
     attention_impl: str = "auto"
     scan_layers: bool = True
     remat: bool = True
+    # What the per-layer remat may keep instead of recomputing (names map to
+    # jax.checkpoint_policies): None = save nothing (lowest memory, full
+    # recompute); "dots" = dots_with_no_batch_dims_saveable — keep matmul
+    # outputs so the backward pass skips recomputing the MXU-heavy ops and
+    # only replays the cheap elementwise chain. Memory sits between remat-off
+    # and full remat; the right default depends on whether the workload is
+    # HBM-bound (7B FSDP: None) or compute-bound (sub-chip-sized: "dots").
+    remat_policy: str | None = None
     # LoRA (rank 0 = disabled → plain full-parameter model)
     lora_rank: int = 0
     lora_alpha: float = 16.0
@@ -88,6 +96,21 @@ class LlamaConfig:
                     dtype=jnp.float32)
         base.update(kw)
         return LlamaConfig(**base)
+
+
+def _remat_policy(name: str | None):
+    """Map LlamaConfig.remat_policy to a jax.checkpoint policy (None = save
+    nothing)."""
+    if name is None:
+        return None
+    policies = {
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    }
+    if name not in policies:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; use None, 'dots', or 'dots_saveable'")
+    return policies[name]
 
 
 def rotary_embedding(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -276,7 +299,8 @@ class LlamaForCausalLM(nn.Module):
 
         layer_cls = DecoderLayer
         if cfg.remat:
-            layer_cls = nn.remat(layer_cls, prevent_cse=False)
+            layer_cls = nn.remat(layer_cls, prevent_cse=False,
+                                 policy=_remat_policy(cfg.remat_policy))
         if cfg.scan_layers:
             var_axes = {"params": 0}
             if cfg.decode:

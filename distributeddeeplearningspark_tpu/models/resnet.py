@@ -41,6 +41,11 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
+def _norm_dtype(norm_dtype, dtype):
+    """BN compute dtype: explicit override, else follow the activation dtype."""
+    return norm_dtype if norm_dtype is not None else dtype
+
+
 class BottleneckBlock(nn.Module):
     """1×1 → 3×3 → 1×1 bottleneck with projection shortcut when needed."""
 
@@ -54,8 +59,7 @@ class BottleneckBlock(nn.Module):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5,
-            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
+            epsilon=1e-5, dtype=_norm_dtype(self.norm_dtype, self.dtype),
         )
         residual = x
         y = conv(self.filters, (1, 1))(x)
@@ -89,8 +93,7 @@ class BasicBlock(nn.Module):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5,
-            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
+            epsilon=1e-5, dtype=_norm_dtype(self.norm_dtype, self.dtype),
         )
         residual = x
         # explicit (1,1) padding = torch semantics (see BottleneckBlock)
@@ -122,7 +125,7 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
-        ndtype = self.norm_dtype if self.norm_dtype is not None else self.dtype
+        ndtype = _norm_dtype(self.norm_dtype, self.dtype)
         x = batch["image"].astype(self.dtype)
         x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype, name="stem_conv")(x)

@@ -73,6 +73,34 @@ def test_scan_matches_loop():
     np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_loop), atol=2e-5)
 
 
+def test_remat_policy_dots_matches_full_remat_gradients():
+    """remat_policy changes what the backward keeps, never the math: grads
+    under 'dots' (keep matmul outputs) must equal full remat to fp tolerance.
+    A bad policy name raises at trace time."""
+    batch = make_batch()
+    cfg_full = LlamaConfig.tiny(remat=True)
+    cfg_dots = LlamaConfig.tiny(remat=True, remat_policy="dots")
+    model_full = LlamaForCausalLM(cfg_full)
+    params = model_full.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+
+    def loss(model):
+        def f(p):
+            logits = model.apply({"params": p}, batch, train=False)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+        return f
+
+    g_full = jax.grad(loss(model_full))(params)
+    g_dots = jax.grad(loss(LlamaForCausalLM(cfg_dots)))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5),
+        g_full, g_dots)
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        LlamaForCausalLM(LlamaConfig.tiny(remat_policy="bogus")).init(
+            jax.random.PRNGKey(0), batch, train=False)
+
+
 class TestLoRA:
     def test_zero_init_matches_base(self):
         """With B=0 at init, the adapted model must equal the base model."""
