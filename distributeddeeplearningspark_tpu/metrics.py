@@ -37,6 +37,32 @@ def device_peak_flops(device: jax.Device | None = None) -> float | None:
     return PEAK_FLOPS.get(getattr(d, "device_kind", ""), None)
 
 
+def attention_matmul_flops(
+    batch: int,
+    heads: int,
+    seq: int,
+    head_dim: int,
+    *,
+    causal: bool = False,
+    train: bool = True,
+) -> float:
+    """Model matmul FLOPs of ONE attention op, for MFU accounting.
+
+    XLA's cost analysis cannot see inside a Pallas custom call, so a step
+    whose attention runs the flash kernel under-reports FLOPs (and therefore
+    MFU) by exactly this amount per attention. Convention: model flops, not
+    implementation flops — the backward's in-kernel recompute of the score
+    matrix is NOT counted, matching how published MFU numbers are computed.
+
+    fwd = QKᵀ + PV = 2 matmuls = 2 · (2·B·H·S²·D); bwd adds dV, dP, dQ, dK =
+    4 more. GQA does not change this: both matmuls run at the q-head count.
+    Causal masking halves the useful score footprint.
+    """
+    one_matmul = 2.0 * batch * heads * seq * seq * head_dim
+    total = 2 * one_matmul + (4 * one_matmul if train else 0.0)
+    return total * (0.5 if causal else 1.0)
+
+
 def compiled_flops_per_step(compiled) -> float | None:
     """Total FLOPs of one compiled step from XLA cost analysis (global)."""
     try:

@@ -118,6 +118,50 @@ def trace_files(profile_dir: str) -> list[str]:
     )
 
 
+def op_breakdown(profile_dir_or_file: str, *, top: int = 25,
+                 timeout_s: float = 120.0) -> dict:
+    """Per-op device-time budget from a captured trace — "where did the step
+    go?" without TensorBoard (whose profile-plugin converter is broken by a
+    protobuf mismatch in common installs; see utils/xplane.py).
+
+    Accepts a profile directory (uses the newest ``.xplane.pb`` capture) or a
+    single xplane file. Returns ``{"plane", "line", "total_ms",
+    "event_count", "ops": [{"name", "ms", "pct", "count", "top_instance"}]}``
+    with ops aggregated by HLO op class and sorted by total time, or
+    ``{"error": ...}``.
+
+    Runs the parse in a subprocess under the pure-python protobuf runtime —
+    the env's stale generated protos cannot load under the C++ runtime, and
+    the runtime choice is frozen at first protobuf import, so it must happen
+    in a fresh interpreter.
+    """
+    import json
+    import subprocess
+    import sys
+
+    path = profile_dir_or_file
+    if os.path.isdir(path):
+        files = trace_files(path)
+        if not files:
+            return {"error": f"no .xplane.pb under {path}"}
+        path = max(files, key=os.path.getmtime)
+    env = dict(os.environ, PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION="python")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m",
+             "distributeddeeplearningspark_tpu.utils.xplane", path, str(top)],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"xplane parse exceeded {timeout_s:.0f}s"}
+    try:
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"xplane parser produced no JSON: "
+                         f"{(out.stderr or out.stdout)[-300:]}"}
+    return rec
+
+
 @contextlib.contextmanager
 def trace(profile_dir: str):
     """Context-manager capture: everything inside the block is traced."""

@@ -1,0 +1,131 @@
+"""XPlane (.xplane.pb) trace parsing → per-op device-time breakdown.
+
+``jax.profiler`` writes traces in the TensorBoard 'profile' plugin's XPlane
+format. The plugin's own converter is the intended reader, but it depends on
+a matched TensorFlow build — in mismatched environments (as shipped here:
+``tensorboard_plugin_profile`` generated against an older protobuf than the
+installed runtime) it dies with descriptor errors, leaving no way to see
+where device time went. This module is a self-contained fallback reader for
+the one question a training engineer always asks first: *which ops are
+eating the step?* — the TPU-native equivalent of reading Spark UI stage
+timings (SURVEY.md §5 'Tracing/profiling').
+
+Run as a subprocess (``python -m distributeddeeplearningspark_tpu.utils.xplane
+<trace.xplane.pb>``) — the stale generated protos only import under the
+pure-python protobuf runtime, which must be selected by env var *before* any
+protobuf import, so the parse is isolated from the caller's process. Use
+:func:`distributeddeeplearningspark_tpu.utils.profiling.op_breakdown` as the
+in-process API; it manages the subprocess.
+
+Output: one JSON object on stdout —
+``{"plane", "line", "total_ms", "event_count", "ops": [{"name", "ms",
+"pct", "count"}, ...]}``; ops are aggregated over occurrences and sorted by
+total time. HLO instruction names are reduced to ``opcode`` (text before
+``=``'s left operand dot suffixes), keeping fusion identity (``fusion.108``
+and ``fusion.109`` fold into ``fusion``) so the table reads as an op-class
+budget, with the full top instruction preserved per class in ``top_instance``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+
+def _import_xplane_pb2():
+    """Locate XPlane protos among known install locations."""
+    errors = []
+    for mod in (
+        "tensorflow.tsl.profiler.protobuf.xplane_pb2",
+        "tsl.profiler.protobuf.xplane_pb2",
+        "tensorflow.core.profiler.protobuf.xplane_pb2",
+    ):
+        try:
+            import importlib
+
+            return importlib.import_module(mod)
+        except Exception as e:  # noqa: BLE001 — try every known location
+            errors.append(f"{mod}: {type(e).__name__}: {e}")
+    raise ImportError("no xplane_pb2 available:\n" + "\n".join(errors))
+
+
+_INSTR = re.compile(r"^%?(?P<name>[A-Za-z0-9_.\-]+)")
+
+
+def _op_class(instruction: str) -> str:
+    """'%fusion.108 = bf16[...] fusion(...)' → 'fusion' (class identity)."""
+    m = _INSTR.match(instruction)
+    name = m.group("name") if m else instruction
+    return name.split(".")[0]
+
+
+def parse(path: str, *, top: int = 25) -> dict:
+    xplane_pb2 = _import_xplane_pb2()
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+
+    # Prefer a device plane's "XLA Ops" line (real per-op device intervals);
+    # fall back to the busiest line anywhere (e.g. host python on CPU runs).
+    best = None  # (priority, event_count, plane, line)
+    for plane in xs.planes:
+        for line in plane.lines:
+            if not line.events:
+                continue
+            prio = 1 if line.name == "XLA Ops" else 0
+            cand = (prio, len(line.events), plane, line)
+            if best is None or cand[:2] > best[:2]:
+                best = cand
+    if best is None:
+        return {"plane": None, "line": None, "total_ms": 0.0,
+                "event_count": 0, "ops": []}
+    _, _, plane, line = best
+
+    meta = plane.event_metadata
+    agg: dict[str, dict] = {}
+    total_ps = 0
+    for e in line.events:
+        full = meta[e.metadata_id].name
+        cls = _op_class(full)
+        rec = agg.setdefault(cls, {"ps": 0, "count": 0, "top_ps": 0, "top": ""})
+        rec["ps"] += e.duration_ps
+        rec["count"] += 1
+        if e.duration_ps > rec["top_ps"]:
+            rec["top_ps"], rec["top"] = e.duration_ps, full
+        total_ps += e.duration_ps
+    ops = sorted(agg.items(), key=lambda kv: -kv[1]["ps"])[:top]
+    return {
+        "plane": plane.name,
+        "line": line.name,
+        "total_ms": round(total_ps / 1e9, 3),
+        "event_count": len(line.events),
+        "ops": [
+            {
+                "name": cls,
+                "ms": round(rec["ps"] / 1e9, 3),
+                "pct": round(100.0 * rec["ps"] / total_ps, 2) if total_ps else 0.0,
+                "count": rec["count"],
+                "top_instance": rec["top"][:160],
+            }
+            for cls, rec in ops
+        ],
+    }
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) not in (2, 3):
+        print(json.dumps({"error": "usage: python -m ...utils.xplane "
+                                   "<trace.xplane.pb> [top_n]"}))
+        return 2
+    try:
+        top = int(argv[2]) if len(argv) == 3 else 25
+        print(json.dumps(parse(argv[1], top=top)))
+        return 0
+    except Exception as e:  # noqa: BLE001 — caller wants JSON, not a traceback
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

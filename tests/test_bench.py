@@ -69,7 +69,7 @@ def test_bench_failure_in_one_model_does_not_kill_the_other(monkeypatch, capsys)
 
     monkeypatch.setattr(bench, "bench_bert", boom)
     monkeypatch.setattr(bench, "bench_llama", lambda iters, **kw: {
-        "tokens_per_sec_per_chip": 1.0, "mfu_lower_bound": 0.1,
+        "tokens_per_sec_per_chip": 1.0, "mfu_approx": 0.1,
         "step_time_ms": 1.0, "params": 1, "batch_size": 4, "seq_len": 2048,
         "chips": 1})
     monkeypatch.setattr(bench, "bench_dlrm", lambda iters, **kw: {
@@ -123,7 +123,7 @@ def test_timing_suspect_zeroes_vs_baseline(monkeypatch, capsys):
         "tokens_per_sec_per_chip": 1.0, "mfu": 0.3, "step_time_ms": 1.0,
         "batch_size": 32, "seq_len": 512, "chips": 1})
     monkeypatch.setattr(bench, "bench_llama", lambda iters, **kw: {
-        "tokens_per_sec_per_chip": 1.0, "mfu_lower_bound": 0.1,
+        "tokens_per_sec_per_chip": 1.0, "mfu_approx": 0.1,
         "step_time_ms": 1.0, "params": 1, "batch_size": 4, "seq_len": 2048,
         "chips": 1})
     monkeypatch.setattr(bench, "bench_dlrm", lambda iters, **kw: {
@@ -143,3 +143,28 @@ def test_sanity_check_mfu_flags_impossible():
     rec2 = {"mfu": 0.35}
     bench._sanity_check_mfu(rec2)
     assert "timing_suspect" not in rec2
+
+
+def test_attention_matmul_flops_convention():
+    """Model-flops convention: fwd = 2 matmuls, bwd = 4, causal halves,
+    GQA/masking don't enter (both matmuls run at the q-head count)."""
+    from distributeddeeplearningspark_tpu.metrics import attention_matmul_flops
+
+    b, h, s, d = 2, 3, 64, 16
+    one = 2.0 * b * h * s * s * d
+    assert attention_matmul_flops(b, h, s, d, train=False) == 2 * one
+    assert attention_matmul_flops(b, h, s, d, train=True) == 6 * one
+    assert attention_matmul_flops(b, h, s, d, causal=True, train=True) == 3 * one
+
+
+def test_routes_to_flash_matches_router(monkeypatch):
+    """The bench's FLOPs adjustment must follow the real attention router:
+    off-TPU it reports False (XLA path), so no adjustment is applied."""
+    assert bench._routes_to_flash(b=2, s=512, h=12, d=64, masked=True) is False
+
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert bench._routes_to_flash(b=2, s=512, h=12, d=64, masked=True) is True
+    # sub-block sequence falls back to XLA even on TPU
+    assert bench._routes_to_flash(b=2, s=256, h=12, d=64, masked=True) is False

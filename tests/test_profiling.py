@@ -104,3 +104,29 @@ def test_fit_crash_mid_window_still_flushes_trace(tmp_path):
                            profile=profiling.ProfileSpec(str(tmp_path / "p2"),
                                                          start_step=1, num_steps=2))
     assert profiling.trace_files(str(tmp_path / "p2"))
+
+
+def test_op_breakdown_parses_cpu_trace(tmp_path):
+    """op_breakdown must read a real capture without TensorBoard's converter:
+    aggregate per-op times from the busiest line and report a sane budget
+    (CPU traces carry host/TFRT lines rather than a TPU 'XLA Ops' line —
+    the fallback path; the device path was exercised on the real chip, see
+    BASELINE.md r2 roofline entry)."""
+    d = str(tmp_path / "prof")
+    with profiling.trace(d):
+        x = jnp.ones((128, 128))
+        for _ in range(3):
+            x = jnp.dot(x, x)
+        jax.block_until_ready(x)
+    rec = profiling.op_breakdown(d, top=10)
+    assert "error" not in rec, rec
+    assert rec["event_count"] > 0
+    assert rec["ops"] and len(rec["ops"]) <= 10
+    total_pct = sum(o["pct"] for o in rec["ops"])
+    assert 0 < total_pct <= 100.5, rec["ops"]
+    assert rec["ops"] == sorted(rec["ops"], key=lambda o: -o["ms"])
+
+
+def test_op_breakdown_missing_dir(tmp_path):
+    rec = profiling.op_breakdown(str(tmp_path / "nothing_here"))
+    assert "error" in rec
