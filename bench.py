@@ -308,10 +308,15 @@ def bench_dlrm(iters: int, batch_size: int = 8192) -> dict:
     """
     import optax
 
-    from distributeddeeplearningspark_tpu.data.feed import stack_examples
+    from distributeddeeplearningspark_tpu.data.feed import put_global, stack_examples
     from distributeddeeplearningspark_tpu.models import DLRM
-    from distributeddeeplearningspark_tpu.models.dlrm import dlrm_rules
-    from distributeddeeplearningspark_tpu.train import losses
+    from distributeddeeplearningspark_tpu.models.dlrm import (
+        dlrm_rules,
+        sparse_embed_specs,
+    )
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+    from distributeddeeplearningspark_tpu.train import embed, losses, optim
+    from distributeddeeplearningspark_tpu.train import step as step_lib
 
     vocabs = (100_000,) * 26
     model = DLRM(vocab_sizes=vocabs, embed_dim=64,
@@ -322,9 +327,19 @@ def bench_dlrm(iters: int, batch_size: int = 8192) -> dict:
          "sparse": np.array([rng.integers(0, v) for v in vocabs], np.int32),
          "label": np.int32(rng.integers(0, 2))}
         for _ in range(batch_size)])
-    mesh, state, step, gbatch, flops = _train_setup(
-        model, batch, losses.binary_xent, tx=optax.adagrad(1e-2),
-        rules=dlrm_rules())
+    # tables train row-sparsely (train/embed.py): the dense step spent 93%
+    # of device time on full-table gradient/optimizer/layout traffic
+    # (op_breakdown, BASELINE.md r2)
+    specs = sparse_embed_specs(model, lr=1e-2)
+    tx = optim.masked(optax.adagrad(1e-2), embed.dense_trainable(specs))
+    mesh = MeshSpec(data=-1).build()
+    state, shardings = step_lib.init_state(
+        model, tx, batch, mesh, dlrm_rules(), sparse_embed=specs)
+    step = step_lib.jit_train_step(
+        embed.make_sparse_embed_train_step(
+            model.apply, tx, losses.binary_xent, specs),
+        mesh, shardings)
+    gbatch = put_global(batch, mesh)
     n_chips = mesh.devices.size
     step_time, _ = bench_steps(step, state, gbatch, iters=iters)
     return {
@@ -480,6 +495,10 @@ def main(argv=None) -> int:
         name, r = "llama_lora", results["llama_lora"]
         value, unit = r["tokens_per_sec_per_chip"], "tokens/sec/chip"
         metric = "llama_lora_tokens_per_sec_per_chip"
+    elif "dlrm" in results:
+        name, r = "dlrm", results["dlrm"]
+        value, unit = r["examples_per_sec_per_chip"], "examples/sec/chip"
+        metric = "dlrm_examples_per_sec_per_chip"
     else:
         emit("bench_failed", 0.0, "none", 0.0, extra)
         return 0

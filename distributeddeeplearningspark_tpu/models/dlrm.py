@@ -42,29 +42,40 @@ CRITEO_DENSE = 13
 CRITEO_SPARSE = 26
 
 
+def fused_flat_ids(vocab_sizes: Sequence[int], sparse_ids: jax.Array) -> jax.Array:
+    """Per-feature local ids [B, N] → fused-table row ids (static offsets)."""
+    offsets = np.concatenate([[0], np.cumsum(vocab_sizes)[:-1]]).astype(np.int32)
+    return sparse_ids + jnp.asarray(offsets)[None, :]
+
+
 class FusedEmbedding(nn.Module):
     """N categorical features → one row-sharded table + static offsets.
 
     ``vocab_sizes[i]`` rows are reserved for feature i; lookup index is
     ``local_id + offset[i]``. The table param path matches
     :data:`EMBEDDING_RULE` so the vocab dim shards over the ``expert`` axis.
+
+    ``override``: pre-gathered vectors [B, N, D] from the row-sparse training
+    path (train/embed.py) — the param is still created (trees/checkpoints
+    unchanged) but the lookup is skipped, so no dense table gradient exists.
     """
 
     vocab_sizes: Sequence[int]
     embed_dim: int
 
     @nn.compact
-    def __call__(self, sparse_ids: jax.Array) -> jax.Array:  # [B, N] → [B, N, D]
+    def __call__(self, sparse_ids: jax.Array,
+                 override: jax.Array | None = None) -> jax.Array:
         total = int(sum(self.vocab_sizes))
-        offsets = np.concatenate([[0], np.cumsum(self.vocab_sizes)[:-1]]).astype(np.int32)
         table = self.param(
             "embedding_table",
             nn.initializers.normal(stddev=1.0 / np.sqrt(self.embed_dim)),
             (total, self.embed_dim),
             jnp.float32,
         )
-        flat_ids = sparse_ids + jnp.asarray(offsets)[None, :]
-        return jnp.take(table, flat_ids, axis=0)
+        if override is not None:
+            return override
+        return jnp.take(table, fused_flat_ids(self.vocab_sizes, sparse_ids), axis=0)
 
 
 class MLP(nn.Module):
@@ -105,18 +116,20 @@ class DLRM(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
+    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False,
+                 overrides: dict[str, jax.Array] | None = None) -> jax.Array:
         if self.bottom_mlp[-1] != self.embed_dim:
             raise ValueError(
                 f"bottom_mlp output {self.bottom_mlp[-1]} must equal embed_dim "
                 f"{self.embed_dim} for dot interaction"
             )
+        overrides = overrides or {}
         # log-transform dense counters in f32 (Criteo counts reach 1e7 —
         # bf16 before the log would quantize them), then cast for the MXU
         dense = jnp.log1p(jnp.maximum(batch["dense"].astype(jnp.float32), 0.0))
         bottom = MLP(self.bottom_mlp, self.dtype, name="bottom_mlp")(dense.astype(self.dtype))
         emb = FusedEmbedding(self.vocab_sizes, self.embed_dim, name="embedding")(
-            batch["sparse"]
+            batch["sparse"], override=overrides.get("embedding")
         )
         feats = dot_interaction(bottom.astype(jnp.float32), emb)
         logit = MLP(self.top_mlp, self.dtype, final_activation=False, name="top_mlp")(
@@ -134,16 +147,19 @@ class WideAndDeep(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
+    def __call__(self, batch: dict[str, jax.Array], *, train: bool = False,
+                 overrides: dict[str, jax.Array] | None = None) -> jax.Array:
+        overrides = overrides or {}
         dense = jnp.log1p(jnp.maximum(batch["dense"].astype(jnp.float32), 0.0))
         # wide: per-category scalar weights == embed_dim-1 fused table
-        wide = FusedEmbedding(self.vocab_sizes, 1, name="wide_table")(batch["sparse"])
+        wide = FusedEmbedding(self.vocab_sizes, 1, name="wide_table")(
+            batch["sparse"], override=overrides.get("wide_table"))
         wide_logit = wide[..., 0].sum(-1) + nn.Dense(1, dtype=jnp.float32, name="wide_dense")(
             dense
         )[:, 0]
         # deep: embeddings + dense → MLP
         emb = FusedEmbedding(self.vocab_sizes, self.embed_dim, name="embedding")(
-            batch["sparse"]
+            batch["sparse"], override=overrides.get("embedding")
         )
         deep_in = jnp.concatenate(
             [emb.reshape(emb.shape[0], -1), dense], axis=1
@@ -157,7 +173,37 @@ class WideAndDeep(nn.Module):
 #: other large params when enabled.
 EMBEDDING_RULE = ("embedding_table", P(AXIS_EXPERT, None))
 
+#: Row-accumulator of the sparse optimizer (train/embed.py): [vocab_rows]
+#: rank-1, sharded like the table's rows. Lives at
+#: ``embed_state/<spec_name>/row_accum`` in the TrainState, so the leaf-name
+#: match cannot collide with the rank-2 table rule.
+ROW_ACCUM_RULE = ("row_accum$", P(AXIS_EXPERT))
+
 
 def dlrm_rules(*, fsdp: bool = False) -> ShardingRules:
     """Canned sharding for config 4: row-sharded tables (+ optional FSDP)."""
-    return ShardingRules(rules=(EMBEDDING_RULE,), fsdp=fsdp)
+    return ShardingRules(rules=(ROW_ACCUM_RULE, EMBEDDING_RULE), fsdp=fsdp)
+
+
+def sparse_embed_specs(model, *, lr: float = 1e-2) -> tuple:
+    """Row-sparse training specs (train/embed.py) for DLRM / WideAndDeep.
+
+    The returned specs carry each fused table's param path, its batch→row-ids
+    function, and the row-wise AdaGrad hyperparameters; hand them to
+    ``Trainer(sparse_embed=...)`` (or ``make_sparse_embed_train_step``).
+    """
+    from distributeddeeplearningspark_tpu.train.embed import SparseEmbedSpec
+
+    vocab = tuple(model.vocab_sizes)
+
+    def ids_fn(batch):
+        return fused_flat_ids(vocab, batch["sparse"])
+
+    specs = [SparseEmbedSpec(
+        name="embedding", param_path="embedding/embedding_table",
+        ids_fn=ids_fn, lr=lr)]
+    if isinstance(model, WideAndDeep):
+        specs.append(SparseEmbedSpec(
+            name="wide_table", param_path="wide_table/embedding_table",
+            ids_fn=ids_fn, lr=lr))
+    return tuple(specs)

@@ -34,16 +34,23 @@ class TrainState:
     opt_state: Any
     mutable: dict[str, Any]
     rng: jax.Array
+    #: Row-sparse embedding optimizer state (train/embed.py): ``{spec_name:
+    #: {"row_accum": [vocab_rows] f32}}``. Empty for every non-recommender
+    #: workload — an empty dict contributes no pytree leaves, so existing
+    #: checkpoints and shardings are unaffected.
+    embed_state: dict[str, Any] = struct.field(default_factory=dict)
 
     @classmethod
     def create(cls, *, params: Any, opt_state: Any, mutable: dict[str, Any] | None = None,
-               rng: jax.Array | None = None) -> "TrainState":
+               rng: jax.Array | None = None,
+               embed_state: dict[str, Any] | None = None) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=opt_state,
             mutable=mutable or {},
             rng=rng if rng is not None else jax.random.PRNGKey(0),
+            embed_state=embed_state or {},
         )
 
     @property

@@ -229,6 +229,7 @@ def init_state(
     rules: ShardingRules,
     *,
     seed: int = 0,
+    sparse_embed: Sequence[Any] = (),
 ) -> tuple[TrainState, Any]:
     """Initialize a sharded TrainState directly on the mesh.
 
@@ -236,6 +237,9 @@ def init_state(
     so a 7B-param FSDP state materializes already sharded — each chip only
     ever holds its slice (no host-side full copy, unlike the reference's
     driver-held ``state_dict``). Returns (state, sharding pytree).
+
+    ``sparse_embed``: row-sparse table specs (train/embed.py) — allocates
+    their per-row accumulators in ``embed_state`` (sharded by the rules).
     """
     init_rng = jax.random.PRNGKey(seed)
 
@@ -246,7 +250,13 @@ def init_state(
         params = variables.pop("params")
         mutable = {k: v for k, v in variables.items()}
         opt_state = tx.init(params)
-        return TrainState.create(params=params, opt_state=opt_state, mutable=mutable, rng=state_rng)
+        embed_state = {}
+        if sparse_embed:
+            from distributeddeeplearningspark_tpu.train.embed import init_embed_state
+
+            embed_state = init_embed_state(sparse_embed, params)
+        return TrainState.create(params=params, opt_state=opt_state, mutable=mutable,
+                                 rng=state_rng, embed_state=embed_state)
 
     abstract = jax.eval_shape(init_fn, init_rng)
     shardings = state_shardings(abstract, mesh, rules)

@@ -78,11 +78,23 @@ class Trainer:
         context_parallel: bool = False,
         accum_steps: int = 1,
         pipeline_microbatches: int | None = None,
+        sparse_embed: Sequence[Any] = (),
     ):
         self.session = session or Session.get_or_default()
         self.mesh = self.session.mesh
         self.model = model
         self.loss_fn = loss_fn
+        self.sparse_embed = tuple(sparse_embed)
+        if self.sparse_embed and accum_steps != 1:
+            raise ValueError("accum_steps is not supported with sparse_embed")
+        if self.sparse_embed:
+            # tables train through the row-sparse path (train/embed.py); the
+            # main optimizer must be masked off them or its dense "no-op"
+            # updates re-introduce the full-table traffic
+            from distributeddeeplearningspark_tpu.train import optim
+            from distributeddeeplearningspark_tpu.train.embed import dense_trainable
+
+            optimizer = optim.masked(optimizer, dense_trainable(self.sparse_embed))
         self.tx = optimizer
         self.rules = rules
         self.mutable_keys = tuple(mutable_keys)
@@ -110,15 +122,26 @@ class Trainer:
     def init(self, sample_batch: dict[str, Any]) -> TrainState:
         """Initialize sharded state from one host example batch."""
         self.state, self.state_shardings = step_lib.init_state(
-            self.model, self.tx, sample_batch, self.mesh, self.rules, seed=self.seed
+            self.model, self.tx, sample_batch, self.mesh, self.rules,
+            seed=self.seed, sparse_embed=self.sparse_embed,
         )
         if self.mutable_keys == () and self.state.mutable:
             self.mutable_keys = tuple(self.state.mutable.keys())
-        train = step_lib.make_train_step(
-            self._apply_fn(), self.tx, self.loss_fn,
-            mutable_keys=self.mutable_keys, rng_names=self.rng_names,
-            accum_steps=self.accum_steps,
-        )
+        if self.sparse_embed:
+            from distributeddeeplearningspark_tpu.train.embed import (
+                make_sparse_embed_train_step,
+            )
+
+            train = make_sparse_embed_train_step(
+                self._apply_fn(), self.tx, self.loss_fn, self.sparse_embed,
+                rng_names=self.rng_names,
+            )
+        else:
+            train = step_lib.make_train_step(
+                self._apply_fn(), self.tx, self.loss_fn,
+                mutable_keys=self.mutable_keys, rng_names=self.rng_names,
+                accum_steps=self.accum_steps,
+            )
         self._train_step = step_lib.jit_train_step(
             train, self.mesh, self.state_shardings, seq_sharded=self.context_parallel
         )
@@ -300,6 +323,11 @@ class Trainer:
         device except at metric log points — steps dispatch asynchronously.
         """
         if accum_steps is not None and accum_steps != self.accum_steps:
+            if self.sparse_embed:
+                raise ValueError(
+                    "accum_steps is not supported with sparse_embed tables "
+                    "(train/embed.py) — recommender batches are already large; "
+                    "scale batch_size instead")
             self.accum_steps = accum_steps
             if self.state is not None:
                 # rebuild the jitted step with the new microbatching

@@ -13,7 +13,12 @@ import logging
 
 from distributeddeeplearningspark_tpu import Session, Trainer
 from distributeddeeplearningspark_tpu.data.sources import synthetic_criteo
-from distributeddeeplearningspark_tpu.models.dlrm import DLRM, WideAndDeep, dlrm_rules
+from distributeddeeplearningspark_tpu.models.dlrm import (
+    DLRM,
+    WideAndDeep,
+    dlrm_rules,
+    sparse_embed_specs,
+)
 from distributeddeeplearningspark_tpu.train import losses, optim
 
 
@@ -31,6 +36,8 @@ def main() -> None:
                    help="ways to row-shard the embedding table (expert mesh axis)")
     p.add_argument("--data-dir", default=None,
                    help="Criteo TSV file or directory of day_* shards; synthetic if unset")
+    p.add_argument("--dense-tables", action="store_true",
+                   help="disable row-sparse embedding training (train/embed.py)")
     p.add_argument("--sql-features", action="store_true",
                    help="engineer features through the DataFrame plane "
                         "(spark.read.csv -> fillna/log1p/hash_bucket), the "
@@ -88,9 +95,13 @@ def main() -> None:
     else:
         model = WideAndDeep(vocab_sizes=vocabs, embed_dim=args.embed_dim)
 
+    # tables train through the row-sparse path (touched rows only, row-wise
+    # AdaGrad) — the dense step spends >90% of device time on full-table
+    # traffic (train/embed.py); --dense-tables restores the old behavior
+    specs = () if args.dense_tables else sparse_embed_specs(model, lr=args.lr)
     trainer = Trainer(
         spark, model, losses.binary_xent, optim.adamw(args.lr, weight_decay=0.0),
-        rules=dlrm_rules(),
+        rules=dlrm_rules(), sparse_embed=specs,
     )
     state, summary = trainer.fit(
         ds, batch_size=args.batch_size, steps=args.steps, log_every=25
