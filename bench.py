@@ -91,7 +91,7 @@ def bench_steps(step_fn, state, batch, *, warmup: int = 3, iters: int = 20):
     return (time.perf_counter() - t0) / iters, state
 
 
-def _train_setup(model, batch, loss_fn, *, tx=None, rules=None):
+def _train_setup(model, batch, loss_fn, *, tx=None, rules=None, trainable=None):
     """Shared: mesh, sharded state, jitted step, global batch, flops."""
     import optax
 
@@ -108,6 +108,7 @@ def _train_setup(model, batch, loss_fn, *, tx=None, rules=None):
     train_step = step_lib.jit_train_step(
         step_lib.make_train_step(
             model.apply, tx, loss_fn, mutable_keys=tuple(state.mutable.keys()),
+            trainable=trainable,
         ),
         mesh, shardings,
     )
@@ -270,7 +271,11 @@ def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048) -> dict:
     mesh, state, step, gbatch, flops = _train_setup(
         model, batch, losses.causal_lm,
         tx=optim.masked(optax.adamw(1e-4), lora_trainable),
-        rules=llama_rules(cfg))
+        rules=llama_rules(cfg),
+        # LoRA: freeze base weights out of autodiff entirely — their dW
+        # matmuls and stacked f32 grad buffers are pure waste (step.py
+        # `trainable` docstring)
+        trainable=lora_trainable)
     n_chips = mesh.devices.size
     step_time, _ = bench_steps(step, state, gbatch, iters=iters)
     peak = device_peak_flops()

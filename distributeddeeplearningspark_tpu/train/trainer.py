@@ -79,6 +79,7 @@ class Trainer:
         accum_steps: int = 1,
         pipeline_microbatches: int | None = None,
         sparse_embed: Sequence[Any] = (),
+        trainable: Callable[[str], bool] | None = None,
     ):
         self.session = session or Session.get_or_default()
         self.mesh = self.session.mesh
@@ -87,6 +88,12 @@ class Trainer:
         self.sparse_embed = tuple(sparse_embed)
         if self.sparse_embed and accum_steps != 1:
             raise ValueError("accum_steps is not supported with sparse_embed")
+        if self.sparse_embed and trainable is not None:
+            raise ValueError(
+                "trainable is not supported with sparse_embed: the sparse "
+                "step already keeps tables out of autodiff, and silently "
+                "ignoring the predicate for other params would skip the "
+                "frozen-weight exclusion the caller asked for")
         if self.sparse_embed:
             # tables train through the row-sparse path (train/embed.py); the
             # main optimizer must be masked off them or its dense "no-op"
@@ -106,6 +113,10 @@ class Trainer:
         self.context_parallel = context_parallel
         self.accum_steps = accum_steps
         self.pipeline_microbatches = pipeline_microbatches
+        # path predicate for partial training (LoRA): frozen params are
+        # stop_gradient'ed out of autodiff — pass the SAME predicate used
+        # to mask the optimizer (step.py `trainable` docstring)
+        self.trainable = trainable
         if context_parallel:
             from distributeddeeplearningspark_tpu.ops import ring_attention
 
@@ -140,7 +151,7 @@ class Trainer:
             train = step_lib.make_train_step(
                 self._apply_fn(), self.tx, self.loss_fn,
                 mutable_keys=self.mutable_keys, rng_names=self.rng_names,
-                accum_steps=self.accum_steps,
+                accum_steps=self.accum_steps, trainable=self.trainable,
             )
         self._train_step = step_lib.jit_train_step(
             train, self.mesh, self.state_shardings, seq_sharded=self.context_parallel
@@ -332,9 +343,9 @@ class Trainer:
             if self.state is not None:
                 # rebuild the jitted step with the new microbatching
                 train = step_lib.make_train_step(
-                    self.model.apply, self.tx, self.loss_fn,
+                    self._apply_fn(), self.tx, self.loss_fn,
                     mutable_keys=self.mutable_keys, rng_names=self.rng_names,
-                    accum_steps=self.accum_steps,
+                    accum_steps=self.accum_steps, trainable=self.trainable,
                 )
                 self._train_step = step_lib.jit_train_step(
                     train, self.mesh, self.state_shardings,

@@ -119,6 +119,9 @@ def main() -> None:
         context_parallel=args.seq_parallel > 1,
         accum_steps=args.accum_steps,
         pipeline_microbatches=args.microbatches or None,
+        # base weights leave autodiff entirely (no dW matmuls, no stacked
+        # f32 grad buffers): measured +30% tokens/s on the bench shape
+        trainable=lora_trainable,
     )
     trainer.init(trainer._sample_batch(ds, args.batch_size))
     if args.weights:

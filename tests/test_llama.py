@@ -73,6 +73,75 @@ def test_scan_matches_loop():
     np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_loop), atol=2e-5)
 
 
+def test_trainable_filter_grads_match_and_frozen_are_zero():
+    """make_train_step(trainable=...) must not change the math: LoRA-leaf
+    grads equal the unfiltered step's, frozen base grads are exactly zero
+    (they were stop_gradient'ed out of the backward), and the two steps land
+    on identical adapters after an update."""
+    import optax
+
+    from distributeddeeplearningspark_tpu.train import losses, optim, step as step_lib
+
+    cfg = LlamaConfig.tiny(lora_rank=2)
+    model = LlamaForCausalLM(cfg)
+    batch = make_batch()
+    mesh = MeshSpec(data=1).build(jax.devices()[:1])
+    tx = optim.masked(optax.sgd(0.1), lora_trainable)
+
+    def run(trainable):
+        state, sh = step_lib.init_state(model, tx, batch, mesh, llama_rules(cfg))
+        step = step_lib.jit_train_step(
+            step_lib.make_train_step(model.apply, tx, losses.causal_lm,
+                                     trainable=trainable),
+            mesh, sh)
+        return step(state, put_global(batch, mesh))
+
+    state_full, m_full = run(None)
+    state_filt, m_filt = run(lora_trainable)
+    # same loss; grad_norm must DROP by exactly the discarded base grads
+    np.testing.assert_allclose(float(m_full["loss"]), float(m_filt["loss"]),
+                               rtol=1e-6)
+    assert float(m_filt["grad_norm"]) < float(m_full["grad_norm"]), (
+        m_filt["grad_norm"], m_full["grad_norm"])
+    params_full = jax.device_get(state_full.params)
+    params_filt = jax.device_get(state_filt.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+        params_full, params_filt)
+
+    # gradient-level proof (not masked by the optimizer): frozen leaves get
+    # exactly-zero grads under the filter, LoRA leaves identical grads
+    from distributeddeeplearningspark_tpu.parallel.sharding import path_str
+
+    params = model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+
+    def loss_fn_of(filtered):
+        def f(p):
+            if filtered:
+                p = jax.tree_util.tree_map_with_path(
+                    lambda path, x: x if lora_trainable(path_str(path))
+                    else jax.lax.stop_gradient(x), p)
+            logits = model.apply({"params": p}, batch, train=False)
+            return losses.causal_lm(logits, batch)[0]
+        return f
+
+    g_full = jax.grad(loss_fn_of(False))(params)
+    g_filt = jax.grad(loss_fn_of(True))(params)
+
+    def check(path, a, b):
+        if lora_trainable(path_str(path)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, err_msg=path_str(path))
+        else:
+            np.testing.assert_array_equal(np.asarray(b), 0.0,
+                                          err_msg=path_str(path))
+            assert np.abs(np.asarray(a)).max() > 0, (
+                f"{path_str(path)}: full grad unexpectedly zero — "
+                "the 'frozen grads are zero' check would be vacuous")
+
+    jax.tree_util.tree_map_with_path(check, g_full, g_filt)
+
+
 def test_remat_policy_dots_matches_full_remat_gradients():
     """remat_policy changes what the backward keeps, never the math: grads
     under 'dots' (keep matmul outputs) must equal full remat to fp tolerance.
