@@ -65,6 +65,16 @@ class Session:
         self.mesh = mesh
         self.spec = spec
         self._stopped = False
+        # Persistent XLA compilation cache: spark-submit-shaped jobs re-run
+        # the same step graphs constantly and a TPU compile is tens of
+        # seconds — the reference relies on the warm JVM across rounds, the
+        # cache file plays that role here. Opt-in; prior value restored on
+        # stop() so one session's job-scoped dir can't leak into the next.
+        self._prev_cache_dir = None
+        cache_dir = conf.get("spark.jax.compilationCache.dir")
+        if cache_dir:
+            self._prev_cache_dir = (jax.config.jax_compilation_cache_dir, )
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
 
     # -- SparkSession-shaped surface ----------------------------------------
 
@@ -175,6 +185,9 @@ class Session:
 
     def stop(self) -> None:
         self._stopped = True
+        if self._prev_cache_dir is not None:
+            jax.config.update("jax_compilation_cache_dir", self._prev_cache_dir[0])
+            self._prev_cache_dir = None
         if Session._active is self:
             Session._active = None
 

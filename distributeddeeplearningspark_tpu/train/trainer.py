@@ -450,6 +450,9 @@ class Trainer:
                             "batch_size": batch_size},
             )
             self.checkpointer.wait()
+        # timing laps are closed — safe to wait for the async device-time
+        # budget log so short jobs still surface it before returning
+        profiler.join_breakdown()
         return self.state, summary
 
     def evaluate(self, dataset: PartitionedDataset, *, batch_size: int) -> dict[str, float]:

@@ -62,6 +62,7 @@ class StepProfiler:
         self._sync = sync
         self._active = False
         self._done = spec is None
+        self._breakdown_thread = None
 
     def observe(self, step: int) -> None:
         if self._done:
@@ -86,7 +87,39 @@ class StepProfiler:
             jax.profiler.stop_trace()
             self._active = False
             logger.info("profiler: trace written to %s", self.spec.dir)
+            # Spark-UI moment: surface where the captured steps' device time
+            # went without requiring TensorBoard (whose profile converter is
+            # broken in mismatched installs — see op_breakdown/xplane.py).
+            # In a DAEMON THREAD: the parse is a subprocess that can take
+            # seconds, and stop() fires mid-training-loop — a synchronous
+            # parse would stall the loop and corrupt the enclosing metrics
+            # lap's step timing.
+            import threading
+
+            def _log_budget(d: str) -> None:
+                rec = op_breakdown(d, top=5)
+                if rec.get("ops"):
+                    budget = ", ".join(
+                        f"{o['name']} {o['pct']:.1f}%" for o in rec["ops"])
+                    logger.info("profiler: device-time budget (%s, %.1f ms): %s",
+                                rec.get("line"), rec.get("total_ms", 0.0), budget)
+                else:
+                    logger.info("profiler: no device-time budget: %s",
+                                rec.get("error", "trace had no op events"))
+
+            self._breakdown_thread = threading.Thread(
+                target=_log_budget, args=(self.spec.dir,), daemon=True,
+                name="op-breakdown",
+            )
+            self._breakdown_thread.start()
         self._done = True
+
+    def join_breakdown(self, timeout_s: float = 60.0) -> None:
+        """Wait for the async device-time-budget log (call AFTER the training
+        loop — e.g. Trainer does, once timing laps are closed — so short jobs
+        still surface the budget without the parse ever stalling a step)."""
+        if self._breakdown_thread is not None:
+            self._breakdown_thread.join(timeout_s)
 
 
 def annotate(name: str):

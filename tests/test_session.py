@@ -62,3 +62,21 @@ def test_context_manager(eight_devices):
         assert spark.num_devices == 2
     with pytest.raises(RuntimeError):
         Session.active()
+
+
+def test_compilation_cache_conf_key(tmp_path):
+    """spark.jax.compilationCache.dir enables the persistent XLA cache for
+    the session's lifetime and restores the prior value on stop()."""
+    import jax
+
+    from distributeddeeplearningspark_tpu.session import Session
+
+    before = jax.config.jax_compilation_cache_dir
+    cache = str(tmp_path / "xla_cache")
+    sess = (Session.builder.master("local[1]").appName("cache")
+            .config("spark.jax.compilationCache.dir", cache).getOrCreate())
+    try:
+        assert jax.config.jax_compilation_cache_dir == cache
+    finally:
+        sess.stop()
+    assert jax.config.jax_compilation_cache_dir == before
