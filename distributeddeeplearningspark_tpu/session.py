@@ -30,6 +30,9 @@ Recognized ``.config()`` keys (Spark names kept where they exist):
 - ``mesh.data`` / ``mesh.fsdp`` / ``mesh.pipe`` / ``mesh.tensor`` /
   ``mesh.seq`` / ``mesh.expert`` → mesh axis sizes (one may be -1 = wildcard;
                                 ``spark.executor.instances`` overrides ``mesh.data``)
+- ``spark.jax.compilationCache.dir`` → persistent XLA compilation cache
+                                directory for the session's lifetime
+                                (restored on ``stop()``)
 """
 
 from __future__ import annotations
@@ -71,9 +74,15 @@ class Session:
         # cache file plays that role here. Opt-in; prior value restored on
         # stop() so one session's job-scoped dir can't leak into the next.
         self._prev_cache_dir = None
-        cache_dir = conf.get("spark.jax.compilationCache.dir")
-        if cache_dir:
-            self._prev_cache_dir = (jax.config.jax_compilation_cache_dir, )
+        self._apply_cache_conf()
+
+    def _apply_cache_conf(self) -> None:
+        """Point jax at ``spark.jax.compilationCache.dir`` if configured
+        (idempotent; also called when conf is merged into a live session)."""
+        cache_dir = self.conf.get("spark.jax.compilationCache.dir")
+        if cache_dir and jax.config.jax_compilation_cache_dir != cache_dir:
+            if self._prev_cache_dir is None:
+                self._prev_cache_dir = (jax.config.jax_compilation_cache_dir, )
             jax.config.update("jax_compilation_cache_dir", cache_dir)
 
     # -- SparkSession-shaped surface ----------------------------------------
@@ -106,6 +115,10 @@ class Session:
             with _LOCK:
                 if Session._active is not None and not Session._active._stopped:
                     Session._active.conf.update(self._conf)
+                    # conf merged into a live session must still take effect
+                    # where it can (the cache key otherwise silently lands in
+                    # .conf without ever reaching jax.config)
+                    Session._active._apply_cache_conf()
                     return Session._active
                 # dlsubmit launch flags arrive via env and lose to explicit
                 # .config()/.master() calls in the driver script.

@@ -114,12 +114,22 @@ class StepProfiler:
             self._breakdown_thread.start()
         self._done = True
 
-    def join_breakdown(self, timeout_s: float = 60.0) -> None:
+    def join_breakdown(self, timeout_s: float = 150.0) -> None:
         """Wait for the async device-time-budget log (call AFTER the training
         loop — e.g. Trainer does, once timing laps are closed — so short jobs
-        still surface the budget without the parse ever stalling a step)."""
+        still surface the budget without the parse ever stalling a step).
+
+        Default exceeds op_breakdown's 120 s subprocess timeout so the wait
+        can't silently abandon a parse that was about to finish; if the
+        thread is somehow still alive afterwards, say so instead of letting
+        the promised budget line vanish without a trace."""
         if self._breakdown_thread is not None:
             self._breakdown_thread.join(timeout_s)
+            if self._breakdown_thread.is_alive():
+                logger.warning(
+                    "profiler: device-time budget parse still running after "
+                    "%.0fs — abandoning (trace remains at %s)",
+                    timeout_s, self.spec.dir)
 
 
 def annotate(name: str):
@@ -193,6 +203,37 @@ def op_breakdown(profile_dir_or_file: str, *, top: int = 25,
         return {"error": f"xplane parser produced no JSON: "
                          f"{(out.stderr or out.stdout)[-300:]}"}
     return rec
+
+
+def profile_cli(argv=None) -> int:
+    """``dlprofile <trace-dir-or-xplane.pb>`` — print the device-time budget.
+
+    The terminal counterpart of the Spark UI stage table: point it at any
+    ``--profile-dir`` capture (or a bare ``.xplane.pb``) and read where the
+    step went, without TensorBoard.
+    """
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(prog="dlprofile", description=profile_cli.__doc__)
+    ap.add_argument("path", help="profile dir (newest capture used) or .xplane.pb")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+    rec = op_breakdown(args.path, top=args.top)
+    if args.json:
+        print(json.dumps(rec))
+        return 0 if rec.get("ops") else 1
+    if not rec.get("ops"):
+        print(f"error: {rec.get('error', 'trace contains no op events')}")
+        return 1
+    print(f"{rec['plane']}  [{rec['line']}]  total {rec['total_ms']:.1f} ms "
+          f"over {rec['event_count']} events")
+    for o in rec["ops"]:
+        print(f"{o['pct']:6.2f}%  {o['ms']:9.2f} ms  x{o['count']:<6d} {o['name']}")
+        if o.get("top_instance"):
+            print(f"         └─ {o['top_instance'][:100]}")
+    return 0
 
 
 @contextlib.contextmanager

@@ -130,3 +130,13 @@ def test_op_breakdown_parses_cpu_trace(tmp_path):
 def test_op_breakdown_missing_dir(tmp_path):
     rec = profiling.op_breakdown(str(tmp_path / "nothing_here"))
     assert "error" in rec
+
+
+def test_profile_cli_prints_budget(tmp_path, capsys):
+    d = str(tmp_path / "prof")
+    with profiling.trace(d):
+        jax.block_until_ready(jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))))
+    assert profiling.profile_cli([d, "--top", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "total" in out and "%" in out
+    assert profiling.profile_cli([str(tmp_path / "missing"), "--json"]) == 1

@@ -80,3 +80,23 @@ def test_compilation_cache_conf_key(tmp_path):
     finally:
         sess.stop()
     assert jax.config.jax_compilation_cache_dir == before
+
+
+def test_compilation_cache_applies_to_live_session(tmp_path):
+    """Merging the cache key into an already-active session must still reach
+    jax.config (not just sit in session.conf)."""
+    import jax
+
+    from distributeddeeplearningspark_tpu.session import Session
+
+    before = jax.config.jax_compilation_cache_dir
+    sess = Session.builder.master("local[1]").appName("live").getOrCreate()
+    try:
+        cache = str(tmp_path / "late_cache")
+        again = (Session.builder
+                 .config("spark.jax.compilationCache.dir", cache).getOrCreate())
+        assert again is sess
+        assert jax.config.jax_compilation_cache_dir == cache
+    finally:
+        sess.stop()
+    assert jax.config.jax_compilation_cache_dir == before
