@@ -183,6 +183,8 @@ def op_breakdown(profile_dir_or_file: str, *, top: int = 25,
     import sys
 
     path = profile_dir_or_file
+    if not os.path.exists(path):
+        return {"error": f"no such file or directory: {path}"}
     if os.path.isdir(path):
         files = trace_files(path)
         if not files:

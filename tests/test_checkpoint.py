@@ -167,3 +167,47 @@ def test_resume_exhausted_feed_raises(tmp_path):
     with pytest.raises(RuntimeError, match="fast-forward"):
         t.fit(ds, batch_size=16, steps=100, log_every=100,
               data_state={"examples_seen": 64, "batch_size": 16})
+
+
+def test_roundtrip_preserves_sparse_embed_state(tmp_path, eight_devices):
+    """embed_state (row accumulators of the sparse embedding optimizer) must
+    survive save→restore with its expert-axis sharding, and a restored state
+    must continue training sparsely from the same accumulators."""
+    from distributeddeeplearningspark_tpu.data.feed import put_global, stack_examples
+    from distributeddeeplearningspark_tpu.models import DLRM
+    from distributeddeeplearningspark_tpu.models.dlrm import dlrm_rules, sparse_embed_specs
+    from distributeddeeplearningspark_tpu.train import embed, optim
+
+    mesh = MeshSpec(data=4, expert=2).build()
+    model = DLRM(vocab_sizes=(16, 8), embed_dim=8, bottom_mlp=(16, 8),
+                 top_mlp=(8, 1))
+    rng = np.random.default_rng(0)
+    batch = stack_examples([
+        {"dense": rng.normal(0, 1, (13,)).astype(np.float32),
+         "sparse": np.array([rng.integers(0, v) for v in (16, 8)], np.int32),
+         "label": np.int32(rng.integers(0, 2))}
+        for _ in range(16)])
+    specs = sparse_embed_specs(model)
+    tx = optim.masked(optax.adagrad(1e-2), embed.dense_trainable(specs))
+    state, shardings = step_lib.init_state(
+        model, tx, batch, mesh, dlrm_rules(), sparse_embed=specs)
+    step = step_lib.jit_train_step(
+        embed.make_sparse_embed_train_step(model.apply, tx, losses.binary_xent, specs),
+        mesh, shardings)
+    state, _ = step(state, put_global(batch, mesh))
+    acc_before = np.asarray(jax.device_get(
+        state.embed_state["embedding"]["row_accum"]))
+    assert acc_before.max() > 0  # training actually touched rows
+
+    with Checkpointer(tmp_path / "ckpt", async_save=True) as ckpt:
+        ckpt.save(1, state, data_state={"examples_seen": 16})
+        ckpt.wait()
+        restored, _ = ckpt.restore(state, shardings=shardings)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored.embed_state["embedding"]["row_accum"])),
+        acc_before)
+    acc_sh = restored.embed_state["embedding"]["row_accum"].sharding
+    assert "expert" in str(acc_sh.spec), acc_sh
+    # restored state keeps training through the sparse path
+    restored, metrics = step(restored, put_global(batch, mesh))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
