@@ -202,27 +202,210 @@ def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, scale):
             dk.astype(k.dtype), dv.astype(v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_attention_local(q, k, v, axis_name, causal, scale):
-    """Per-shard ring attention (inside shard_map); blockwise custom VJP."""
-    o, _ = _ring_fwd_local(q, k, v, axis_name=axis_name, causal=causal,
-                           scale=scale)
+# ---------------------------------------------------------------------------
+# flash-backed hop compute (Pallas kernel per ring hop)
+# ---------------------------------------------------------------------------
+#
+# The einsum path above materializes one [B,Hkv,G,Sq,Sk] logits block per hop
+# — O(s_local²) live memory, which becomes the per-chip context ceiling on
+# real pods (s_local is still thousands of positions per chip). These
+# variants run the blockwise flash kernel (ops/flash_attention) for each
+# hop's local compute instead, so per-hop live memory drops to the kernel's
+# O(s_local·block) tiles and the MXU sees the same tuned kernel as the
+# single-chip path.
+#
+# Why the composition is clean: in a causal ring, hop 0 is exactly the
+# diagonal block (same global offsets for q and k → the kernel's local
+# ``causal=True`` mask is the correct global mask), and every hop i ≥ 1
+# holds block (my+i) mod N, which is either *entirely* allowed
+# (my + i ≥ N, i.e. a lower block) or *entirely* masked — a scalar gate
+# applied after a ``causal=False`` kernel call, never a per-position mask.
+
+
+def _flat_heads(x):
+    """[B, S, H, D] → [B·H, S, D] (head-major, the flash kernels' layout)."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unflat_heads(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _hop_active(my_idx, i, axis_size, causal):
+    """Does hop i's K/V block contribute at all? (f32 0/1 scalar.)"""
+    if not causal:
+        return jnp.float32(1.0)
+    return (my_idx + i >= axis_size).astype(jnp.float32)
+
+
+def _ring_fwd_flash(q, k, v, *, axis_name, causal, scale, interpret):
+    """Ring revolution with the flash kernel per hop; returns (o, lse).
+
+    lse: [B·H, Sq] f32 — flat-head layout (the backward consumes it as-is).
+    Partial outputs are merged online in f32 via the standard normalized
+    combine: lse' = logaddexp(lse, lse_i), o' = o·e^{lse−lse'} + o_i·e^{lse_i−lse'}.
+    """
+    from distributeddeeplearningspark_tpu.ops import flash_attention as fa
+
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    qf, kf, vf = _flat_heads(q), _flat_heads(k), _flat_heads(v)
+    block = min(fa.DEFAULT_BLOCK, sq)
+    run = functools.partial(fa._flash_fwd, scale=scale, group=group,
+                            block_q=block, block_k=block, interpret=interpret)
+
+    o0, lse0 = run(qf, kf, vf, None, causal=causal)  # hop 0 = diagonal block
+    o0 = o0.astype(jnp.float32)
+
+    perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
+
+    def hop(carry, i):
+        o, lse, k_cur, v_cur = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        oi, lsei = run(qf, k_cur, v_cur, None, causal=False)
+        active = _hop_active(my_idx, i, axis_size, causal)
+        # inactive hop: SELECT the contribution away (never scale by 0 — an
+        # unmasked kernel output can carry inf/NaN for fully-masked future
+        # blocks, and inf × 0 = NaN), and send lse_i → -inf so the merge is
+        # a no-op (lse stays finite — hop 0 always contributed)
+        oi = jnp.where(active > 0, oi.astype(jnp.float32), 0.0)
+        lsei = jnp.where(active > 0, lsei, _NEG_INF)
+        new_lse = jnp.logaddexp(lse, lsei)
+        o = (o * jnp.exp(lse - new_lse)[..., None]
+             + oi * jnp.exp(lsei - new_lse)[..., None])
+        return (o, new_lse, k_cur, v_cur), None
+
+    o, lse = o0, lse0
+    if axis_size > 1:
+        (o, lse, _, _), _ = lax.scan(
+            hop, (o0, lse0, kf, vf), jnp.arange(1, axis_size))
+    return _unflat_heads(o, b, h).astype(q.dtype), lse
+
+
+def _ring_bwd_flash(q, k, v, o, lse, do, *, axis_name, causal, scale,
+                    interpret):
+    """Reverse revolution with the flash backward kernels per hop.
+
+    Mirrors :func:`_ring_bwd_local`'s rotation bookkeeping: hop 0 handles the
+    local (diagonal) block with the causal kernels, then (K, V, dK, dV)
+    rotate together so each block's accumulated gradient is home after a
+    full revolution. Per-hop dK/dV contributions use the FULL output's LSE
+    (FlashAttention-2 backward), gated by the same all-or-nothing scalar as
+    the forward.
+    """
+    from distributeddeeplearningspark_tpu.ops import flash_attention as fa
+
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    qf, kf, vf = _flat_heads(q), _flat_heads(k), _flat_heads(v)
+    of, dof = _flat_heads(o), _flat_heads(do)
+    block = min(fa.DEFAULT_BLOCK, sq)
+    run = functools.partial(fa._flash_bwd, scale=scale, group=group,
+                            block_q=block, block_k=block, interpret=interpret)
+
+    dq0, dk0, dv0 = run((qf, kf, vf, None, of, lse), dof, causal=causal)
+    if axis_size == 1:
+        return (_unflat_heads(dq0.astype(jnp.float32), b, h).astype(q.dtype),
+                _unflat_heads(dk0.astype(jnp.float32), b, hkv).astype(k.dtype),
+                _unflat_heads(dv0.astype(jnp.float32), b, hkv).astype(v.dtype))
+
+    perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
+
+    def rotate(*xs):
+        return tuple(lax.ppermute(x, axis_name, perm) for x in xs)
+
+    def hop(carry, i):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        k_cur, v_cur, dk_cur, dv_cur = rotate(k_cur, v_cur, dk_cur, dv_cur)
+        dqi, dki, dvi = run((qf, k_cur, v_cur, None, of, lse), dof,
+                            causal=False)
+        active = _hop_active(my_idx, i, axis_size, causal)
+        # SELECT, never multiply: an inactive (fully-masked future) hop runs
+        # the kernel unmasked, where a large future logit makes
+        # p = exp(s − lse) overflow to inf — and inf × 0 is NaN. where()
+        # discards the poisoned contribution outright.
+        gate = lambda x: jnp.where(active > 0, x.astype(jnp.float32), 0.0)
+        dq = dq + gate(dqi)
+        dk_cur = dk_cur + gate(dki)
+        dv_cur = dv_cur + gate(dvi)
+        return (dq, k_cur, v_cur, dk_cur, dv_cur), None
+
+    init = (dq0.astype(jnp.float32), kf, vf,
+            dk0.astype(jnp.float32), dv0.astype(jnp.float32))
+    (dq, _, _, dk, dv), _ = lax.scan(hop, init, jnp.arange(1, axis_size))
+    # one final rotation brings each block's gradient back to its home chip
+    dk, dv = rotate(dk, dv)
+    return (_unflat_heads(dq, b, h).astype(q.dtype),
+            _unflat_heads(dk, b, hkv).astype(k.dtype),
+            _unflat_heads(dv, b, hkv).astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_attention_local(q, k, v, axis_name, causal, scale, impl):
+    """Per-shard ring attention (inside shard_map); blockwise custom VJP.
+
+    ``impl``: ("einsum",) — XLA per-hop compute — or ("flash", interpret) —
+    Pallas kernel per hop (static tuple so it can ride nondiff_argnums).
+    """
+    o, _ = _ring_fwd(q, k, v, axis_name=axis_name, causal=causal,
+                     scale=scale, impl=impl)
     return o
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal, scale):
-    o, lse = _ring_fwd_local(q, k, v, axis_name=axis_name, causal=causal,
-                             scale=scale)
+def _ring_fwd(q, k, v, *, axis_name, causal, scale, impl):
+    if impl[0] == "flash":
+        return _ring_fwd_flash(q, k, v, axis_name=axis_name, causal=causal,
+                               scale=scale, interpret=impl[1])
+    return _ring_fwd_local(q, k, v, axis_name=axis_name, causal=causal,
+                           scale=scale)
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, impl):
+    o, lse = _ring_fwd(q, k, v, axis_name=axis_name, causal=causal,
+                       scale=scale, impl=impl)
     return o, (q, k, v, o, lse)
 
 
-def _ring_vjp_bwd(axis_name, causal, scale, res, g):
+def _ring_vjp_bwd(axis_name, causal, scale, impl, res, g):
     q, k, v, o, lse = res
+    if impl[0] == "flash":
+        return _ring_bwd_flash(q, k, v, o, lse, g, axis_name=axis_name,
+                               causal=causal, scale=scale, interpret=impl[1])
     return _ring_bwd_local(q, k, v, o, lse, g, axis_name=axis_name,
                            causal=causal, scale=scale)
 
 
 _ring_attention_local.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def _flash_hop_qualifies(s_local: int, d: int, *, on_tpu: bool) -> bool:
+    """May the per-hop compute use the Pallas kernel for these local shapes?
+
+    The gate must use the SAME block choice as the runtime paths
+    (min(DEFAULT_BLOCK, s_local)) — the kernels have no divisibility check
+    of their own, so a gate/kernel divergence would silently drop positions.
+    On real TPU the head dim must additionally be sublane-aligned (d % 8;
+    the block itself is always either whole or DEFAULT_BLOCK, both legal).
+    """
+    from distributeddeeplearningspark_tpu.ops import flash_attention as fa
+
+    if s_local < 1:
+        return False
+    block = min(fa.DEFAULT_BLOCK, s_local)
+    if s_local % block:
+        return False
+    if on_tpu and d % 8:
+        return False
+    return True
 
 
 def ring_attention(
@@ -235,6 +418,7 @@ def ring_attention(
     scale: float | None = None,
     mask: Any = None,
     bias: Any = None,
+    use_flash: bool | None = None,
 ) -> jax.Array:
     """Exact attention over sequence-sharded BSHD tensors (global view).
 
@@ -245,6 +429,13 @@ def ring_attention(
     can use ``impl="ring"`` unconditionally.
 
     ``mesh=None`` resolves to the active :class:`~...session.Session`'s mesh.
+
+    ``use_flash``: run each hop's local attention through the Pallas flash
+    kernel instead of XLA einsums — per-hop live memory drops from one
+    [B,H,Sq,Sk] logits block (the per-chip context ceiling at pod scale) to
+    the kernel's O(Sq·block) tiles. ``None`` = auto: on TPU whenever the
+    local shapes satisfy the kernel's tiling rules; off-TPU the einsum path
+    (tests opt in explicitly and get interpret-mode kernels).
     """
     if mask is not None or bias is not None:
         raise NotImplementedError(
@@ -278,11 +469,27 @@ def ring_attention(
             f"({hkv}) must divide by the tensor degree ({tensor_deg}) — "
             f"reduce mesh.tensor or repeat KV heads before calling")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    seq_deg = mesh.shape.get(AXIS_SEQ, 1)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    qualifies = (s % seq_deg == 0
+                 and _flash_hop_qualifies(s // seq_deg, d, on_tpu=on_tpu))
+    if use_flash and not qualifies:
+        # explicit opt-in must not silently downgrade: the user asked for
+        # flash exactly to avoid the einsum path's O(s_local²) logits block
+        raise ValueError(
+            f"use_flash=True but local shapes don't satisfy the kernel "
+            f"tiling rules (s={s} over seq degree {seq_deg} → s_local="
+            f"{s // seq_deg if s % seq_deg == 0 else f'{s}/{seq_deg} uneven'}, "
+            f"d={d}); pad the sequence or pass use_flash=None/False")
+    if use_flash is None:
+        use_flash = on_tpu and qualifies
+    impl = ("flash", not on_tpu) if use_flash else ("einsum",)
     spec = P(BATCH_AXES, AXIS_SEQ, AXIS_TENSOR, None)
     # custom_vjp nondiff args must be passed positionally (not via partial
     # keywords) or jax rejects the call under differentiation
     fn = jax.shard_map(
-        lambda qq, kk, vv: _ring_attention_local(qq, kk, vv, AXIS_SEQ, causal, scale),
+        lambda qq, kk, vv: _ring_attention_local(
+            qq, kk, vv, AXIS_SEQ, causal, scale, impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -216,3 +216,124 @@ def test_ring_gqa_rejects_undividable_tensor_degree(eight_devices):
     kv = jnp.zeros((2, 16, 1, 8))  # 1 kv head, tensor=2
     with pytest.raises(ValueError, match="tensor degree"):
         ring_attention(q, kv, kv, mesh=mesh, causal=True)
+
+
+class TestFlashHops:
+    """Flash-kernel-per-hop ring (use_flash=True → interpret kernels on CPU)
+    must match the einsum ring and dense attention exactly — forward and
+    gradients, causal and not, GQA included."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_dense(self, causal, eight_devices):
+        mesh = MeshSpec(data=2, seq=4).build()
+        q, k, v = _qkv(b=2, s=32, h=4, d=16, seed=11)
+        want = _xla_attention(q, k, v, bias=None, mask=None, causal=causal,
+                              scale=None)
+        got = jax.jit(lambda a, b_, c: ring_attention(
+            a, b_, c, mesh=mesh, causal=causal, use_flash=True))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match_einsum_ring_and_dense(self, eight_devices):
+        mesh = MeshSpec(data=2, seq=4).build()
+        q, k, v = _qkv(b=2, s=16, h=2, d=8, seed=13)
+
+        def loss(fn):
+            return jax.jit(jax.grad(
+                lambda a, b_, c: jnp.sum(fn(a, b_, c) ** 2), argnums=(0, 1, 2)))
+
+        g_flash = loss(lambda a, b_, c: ring_attention(
+            a, b_, c, mesh=mesh, causal=True, use_flash=True))(q, k, v)
+        g_einsum = loss(lambda a, b_, c: ring_attention(
+            a, b_, c, mesh=mesh, causal=True, use_flash=False))(q, k, v)
+        g_dense = loss(lambda a, b_, c: _xla_attention(
+            a, b_, c, bias=None, mask=None, causal=True, scale=None))(q, k, v)
+        for gf, ge, gd in zip(g_flash, g_einsum, g_dense):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(ge),
+                                       atol=2e-5, rtol=2e-5)
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_gqa_forward_and_grads(self, eight_devices):
+        mesh = MeshSpec(data=1, seq=4, tensor=2).build()
+        rng = np.random.default_rng(17)
+        b, s, h, hkv, d = 2, 32, 8, 4, 16
+        q = jnp.asarray(rng.normal(0, 1, (b, s, h, d)).astype(np.float32))
+        k = jnp.asarray(rng.normal(0, 1, (b, s, hkv, d)).astype(np.float32))
+        v = jnp.asarray(rng.normal(0, 1, (b, s, hkv, d)).astype(np.float32))
+        kr = jnp.repeat(k, h // hkv, axis=2)
+        vr = jnp.repeat(v, h // hkv, axis=2)
+        want = _xla_attention(q, kr, vr, bias=None, mask=None, causal=True,
+                              scale=None)
+        got = jax.jit(lambda a, b_, c: ring_attention(
+            a, b_, c, mesh=mesh, causal=True, use_flash=True))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+        g = jax.jit(jax.grad(lambda a, b_, c: jnp.sum(ring_attention(
+            a, b_, c, mesh=mesh, causal=True, use_flash=True) ** 2),
+            argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.jit(jax.grad(lambda a, b_, c: jnp.sum(ring_attention(
+            a, b_, c, mesh=mesh, causal=True, use_flash=False) ** 2),
+            argnums=(0, 1, 2)))(q, k, v)
+        for gf, ge in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(ge),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_odd_small_local_blocks(self, eight_devices):
+        """s_local=6 (block == whole local seq) still runs and matches —
+        the whole-block case of the kernel tiling rules."""
+        mesh = MeshSpec(data=2, seq=4).build()
+        q, k, v = _qkv(b=2, s=24, h=2, d=8, seed=19)  # s_local = 6
+        want = _xla_attention(q, k, v, bias=None, mask=None, causal=True,
+                              scale=None)
+        got = jax.jit(lambda a, b_, c: ring_attention(
+            a, b_, c, mesh=mesh, causal=True, use_flash=True))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_large_future_logit_does_not_nan_gradients(self, eight_devices):
+        """Inactive (fully-masked future) hops run the kernel unmasked; a
+        large future logit overflows exp(s − lse) to inf there, and the gate
+        must SELECT the contribution away (inf × 0 would be NaN). Regression
+        for the confirmed repro: q[0,0] = k[0, future] = 10·1⃗ → all-NaN
+        grads under the multiply gate."""
+        mesh = MeshSpec(data=4, seq=2).build()
+        rng = np.random.default_rng(23)
+        b, s, h, d = 4, 16, 2, 8
+        q = rng.normal(0, 1, (b, s, h, d)).astype(np.float32)
+        k = rng.normal(0, 1, (b, s, h, d)).astype(np.float32)
+        v = rng.normal(0, 1, (b, s, h, d)).astype(np.float32)
+        q[0, 0] = 10.0   # early query...
+        k[0, 14] = 10.0  # ...against a huge key in the FUTURE block
+        q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+        grads = jax.jit(jax.grad(lambda a, b_, c: jnp.sum(ring_attention(
+            a, b_, c, mesh=mesh, causal=True, use_flash=True) ** 2),
+            argnums=(0, 1, 2)))(q, k, v)
+        ref = jax.jit(jax.grad(lambda a, b_, c: jnp.sum(ring_attention(
+            a, b_, c, mesh=mesh, causal=True, use_flash=False) ** 2),
+            argnums=(0, 1, 2)))(q, k, v)
+        for g, r in zip(grads, ref):
+            assert np.isfinite(np.asarray(g)).all()
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_explicit_use_flash_with_bad_shapes_raises(self, eight_devices):
+        mesh = MeshSpec(data=2, seq=4).build()
+        q, k, v = _qkv(b=1, s=30, h=2, d=8)  # 30 % 4 != 0
+        with pytest.raises(ValueError, match="use_flash"):
+            ring_attention(q, k, v, mesh=mesh, causal=True, use_flash=True)
+
+    def test_qualification_gate(self):
+        from distributeddeeplearningspark_tpu.ops.ring_attention import (
+            _flash_hop_qualifies,
+        )
+
+        # whole-block local sequences always tile; >512 must tile by 512
+        assert _flash_hop_qualifies(6, 8, on_tpu=True)
+        assert _flash_hop_qualifies(512, 64, on_tpu=True)
+        assert _flash_hop_qualifies(1024, 128, on_tpu=True)
+        assert not _flash_hop_qualifies(768, 128, on_tpu=True)  # 768 % 512
+        assert not _flash_hop_qualifies(512, 12, on_tpu=True)   # d % 8
+        assert _flash_hop_qualifies(512, 12, on_tpu=False)      # interpret: ok
+        assert not _flash_hop_qualifies(0, 8, on_tpu=False)
