@@ -68,6 +68,13 @@ class LlamaConfig:
     # and full remat; the right default depends on whether the workload is
     # HBM-bound (7B FSDP: None) or compute-bound (sub-chip-sized: "dots").
     remat_policy: str | None = None
+    # Fuse the LM-head matmul into the loss (train/fused_ce.py): the model
+    # returns {"hidden", "lm_head"} instead of [B,S,V] f32 logits, so the
+    # logits and their backward cotangent (~2×B·S·V f32 — 2.1 GB at the
+    # config-5 bench shape) never materialize. Pair with
+    # ``losses.causal_lm_fused``. Ignored in decode mode (generation needs
+    # real logits).
+    fused_head_loss: bool = False
     # LoRA (rank 0 = disabled → plain full-parameter model)
     lora_rank: int = 0
     lora_alpha: float = 16.0
@@ -277,6 +284,24 @@ class DecoderLayer(nn.Module):
         return x, None
 
 
+class _LMHead(nn.Module):
+    """Untied LM head with the exact param path/init/compute of
+    ``nn.Dense(vocab, use_bias=False, name="lm_head")`` — replaced only so
+    the fused-loss path can read the kernel without applying it (param tree,
+    TP rule ``lm_head/kernel`` and HF interchange stay byte-identical)."""
+
+    vocab: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, return_kernel: bool = False):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.vocab), jnp.float32)
+        if return_kernel:
+            return kernel
+        return jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+
+
 class LlamaForCausalLM(nn.Module):
     """Decoder-only LM; logits [B,S,vocab] f32 (untied head, as in Llama-2)."""
 
@@ -318,9 +343,12 @@ class LlamaForCausalLM(nn.Module):
                 x, _ = layer_cls(cfg, name=f"layers_{i}")(x, mask)
 
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                          name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        head = _LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")
+        if cfg.fused_head_loss and not cfg.decode:
+            # hand the pieces to losses.causal_lm_fused; the [B,S,V] f32
+            # logits (and their cotangent) never exist
+            return {"hidden": x, "lm_head": head(x, return_kernel=True)}
+        return head(x).astype(jnp.float32)
 
 
 def llama2_7b(**kw) -> LlamaForCausalLM:

@@ -51,6 +51,11 @@ def make_pp_apply(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int | None = N
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires scan_layers=True "
                          "(stacked [L, ...] params are what stages reshape)")
+    if cfg.fused_head_loss:
+        raise ValueError(
+            "fused_head_loss is not supported with pipeline parallelism: "
+            "the GPipe forward emits real logits — pair PP with "
+            "losses.causal_lm (or drop the config flag)")
     if cfg.num_layers % p:
         raise ValueError(f"num_layers {cfg.num_layers} must divide by pipe {p}")
     m = num_microbatches or p

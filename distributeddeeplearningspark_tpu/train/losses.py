@@ -59,11 +59,11 @@ def binary_xent(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, di
     return loss, {"loss": loss, "accuracy": acc}
 
 
-def causal_lm(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, dict]:
-    """Next-token CE (Llama-2 LoRA fine-tune); respects ``loss_mask`` if given."""
-    labels = batch["input_ids"][:, 1:]
-    logits = logits[:, :-1]
-    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+def _reduce_next_token(per_tok: jax.Array, batch: dict[str, Any]
+                       ) -> tuple[jax.Array, dict]:
+    """Shared LM reduction: optional shifted loss_mask, weighted mean,
+    (loss, perplexity, weight) metrics — one definition for both the
+    materialized and the fused head path."""
     mask = batch.get("loss_mask")
     if mask is not None:
         mask = mask[:, 1:].astype(jnp.float32)
@@ -73,3 +73,41 @@ def causal_lm(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, dict
         denom = jnp.float32(per_tok.size)
         loss = per_tok.mean()
     return loss, {"loss": loss, "perplexity": jnp.exp(loss), "weight": denom}
+
+
+def causal_lm_fused(outputs: dict[str, jax.Array], batch: dict[str, Any]
+                    ) -> tuple[jax.Array, dict]:
+    """Next-token CE fused with the LM head (train/fused_ce.py).
+
+    ``outputs`` is the ``{"hidden", "lm_head"}`` dict a model configured
+    with ``fused_head_loss=True`` returns — the [B,S,V] logits (and their
+    backward cotangent) never materialize. Same metrics contract as
+    :func:`causal_lm`.
+    """
+    from distributeddeeplearningspark_tpu.train.fused_ce import (
+        chunked_softmax_xent,
+    )
+
+    if not (isinstance(outputs, dict) and "hidden" in outputs
+            and "lm_head" in outputs):
+        raise TypeError(
+            "causal_lm_fused needs the {'hidden', 'lm_head'} dict a model "
+            "with fused_head_loss=True returns; this model produced "
+            f"{type(outputs).__name__} — either set the config flag or use "
+            "losses.causal_lm")
+    hidden = outputs["hidden"][:, :-1]
+    labels = batch["input_ids"][:, 1:]
+    per_tok = chunked_softmax_xent(hidden, outputs["lm_head"], labels)
+    return _reduce_next_token(per_tok, batch)
+
+
+def causal_lm(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, dict]:
+    """Next-token CE (Llama-2 LoRA fine-tune); respects ``loss_mask`` if given."""
+    if isinstance(logits, dict):
+        raise TypeError(
+            "model returned the fused-head dict (fused_head_loss=True) — "
+            "pair it with losses.causal_lm_fused")
+    labels = batch["input_ids"][:, 1:]
+    logits = logits[:, :-1]
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return _reduce_next_token(per_tok, batch)

@@ -50,6 +50,9 @@ def main() -> None:
                    help="pipeline microbatches per step (default: the pipe degree)")
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient-accumulation micro-steps per optimizer step")
+    p.add_argument("--fused-head-loss", action="store_true",
+                   help="fuse the LM-head matmul into the loss: the [B,S,V] "
+                        "f32 logits never materialize (train/fused_ce.py)")
     p.add_argument("--corpus", default=None, help="text file (one doc per line); synthetic if unset")
     p.add_argument("--tokenizer", default=None,
                    help="HF tokenizer dir matching --weights (required with --weights: "
@@ -99,6 +102,13 @@ def main() -> None:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, attention_impl="ring")
+    if args.fused_head_loss:
+        import dataclasses
+
+        if args.pipeline > 1:
+            p.error("--fused-head-loss is not supported with --pipeline "
+                    "(the GPipe forward emits real logits)")
+        cfg = dataclasses.replace(cfg, fused_head_loss=True)
     model = LlamaForCausalLM(cfg)
 
     ds = text_lib.lm_dataset(docs, tok, seq_len=args.seq_len).repeat()
@@ -114,7 +124,9 @@ def main() -> None:
         lora_trainable,
     )
     trainer = Trainer(
-        spark, model, losses.causal_lm, tx,
+        spark, model,
+        losses.causal_lm_fused if args.fused_head_loss else losses.causal_lm,
+        tx,
         rules=llama_rules(cfg, pipeline=args.pipeline > 1),
         context_parallel=args.seq_parallel > 1,
         accum_steps=args.accum_steps,

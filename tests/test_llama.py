@@ -319,3 +319,55 @@ def test_parity_with_transformers(tmp_path):
     with torch.no_grad():
         theirs = hf_model(torch.from_numpy(batch["input_ids"].astype(np.int64))).logits.numpy()
     np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_fused_head_loss_matches_plain_path():
+    """fused_head_loss=True + causal_lm_fused ≡ plain logits + causal_lm:
+    identical param tree (lm_head/kernel preserved for TP/IO), identical
+    loss, identical grads — only the [B,S,V] materialization differs."""
+    from distributeddeeplearningspark_tpu.train import losses
+
+    cfg_plain = LlamaConfig.tiny()
+    cfg_fused = LlamaConfig.tiny(fused_head_loss=True)
+    batch = make_batch()
+    batch["loss_mask"] = np.ones_like(batch["input_ids"], np.float32)
+    m_plain = LlamaForCausalLM(cfg_plain)
+    m_fused = LlamaForCausalLM(cfg_fused)
+    params = m_plain.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+    params_f = m_fused.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+    assert jax.tree.structure(params) == jax.tree.structure(params_f)
+    assert params["lm_head"]["kernel"].shape == params_f["lm_head"]["kernel"].shape
+
+    def loss_plain(p):
+        return losses.causal_lm(
+            m_plain.apply({"params": p}, batch, train=True), batch)[0]
+
+    def loss_fused(p):
+        return losses.causal_lm_fused(
+            m_fused.apply({"params": p}, batch, train=True), batch)[0]
+
+    lp, gp = jax.value_and_grad(loss_plain)(params)
+    lf, gf = jax.value_and_grad(loss_fused)(params)
+    np.testing.assert_allclose(float(lp), float(lf), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5),
+        gp, gf)
+
+
+def test_fused_head_loss_ignored_in_decode_mode():
+    """Generation needs real logits: decode=True overrides the fused flag."""
+    import dataclasses
+
+    cfg = LlamaConfig.tiny(fused_head_loss=True)
+    batch = make_batch()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+    dcfg = dataclasses.replace(cfg, decode=True, max_cache_len=32)
+    dmodel = LlamaForCausalLM(dcfg)
+    variables = dmodel.init(jax.random.PRNGKey(0), batch, train=False)
+    out, _ = dmodel.apply(
+        {"params": params, "cache": variables["cache"]}, batch, train=False,
+        mutable=["cache"])
+    assert isinstance(out, jax.Array)  # logits, not the fused dict
+    assert out.shape[-1] == cfg.vocab_size
