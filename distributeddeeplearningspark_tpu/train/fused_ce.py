@@ -29,6 +29,22 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def is_fused_output(out) -> bool:
+    """Is ``out`` the {"hidden", "lm_head"} dict of a fused-head model?
+    (One predicate shared by the loss and predict paths.)"""
+    return isinstance(out, dict) and "hidden" in out and "lm_head" in out
+
+
+def materialize_logits(out: dict) -> jax.Array:
+    """Fused-head output → real logits, with the head's exact compute
+    convention (inputs cast to the hidden dtype, result f32 — mirrors
+    ``models.llama._LMHead``). Prediction is the one consumer that
+    genuinely wants the [.., V] materialization."""
+    hidden = out["hidden"]
+    return jnp.dot(hidden, out["lm_head"].astype(hidden.dtype)).astype(
+        jnp.float32)
+
+
 def _chunk_geometry(vocab: int, requested: int) -> tuple[int, int]:
     """(num_chunks, padded_vocab): the vocab is padded up to a chunk multiple
     so EVERY vocab size — including primes like GPT-2's 50257 — gets real

@@ -86,10 +86,10 @@ def causal_lm_fused(outputs: dict[str, jax.Array], batch: dict[str, Any]
     """
     from distributeddeeplearningspark_tpu.train.fused_ce import (
         chunked_softmax_xent,
+        is_fused_output,
     )
 
-    if not (isinstance(outputs, dict) and "hidden" in outputs
-            and "lm_head" in outputs):
+    if not is_fused_output(outputs):
         raise TypeError(
             "causal_lm_fused needs the {'hidden', 'lm_head'} dict a model "
             "with fused_head_loss=True returns; this model produced "

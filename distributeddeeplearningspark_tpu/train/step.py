@@ -187,8 +187,16 @@ def make_predict_step(apply_fn: Callable) -> Callable:
     """
 
     def predict_step(state: TrainState, batch: dict[str, Any]):
+        from distributeddeeplearningspark_tpu.train.fused_ce import (
+            is_fused_output,
+            materialize_logits,
+        )
+
         variables = {"params": state.params, **state.mutable}
-        return apply_fn(variables, batch, train=False)
+        out = apply_fn(variables, batch, train=False)
+        if is_fused_output(out):
+            return materialize_logits(out)
+        return out
 
     return predict_step
 

@@ -371,3 +371,28 @@ def test_fused_head_loss_ignored_in_decode_mode():
         mutable=["cache"])
     assert isinstance(out, jax.Array)  # logits, not the fused dict
     assert out.shape[-1] == cfg.vocab_size
+
+
+def test_predict_on_fused_model_returns_logits():
+    """Trainer.predict is the one consumer that wants real logits — a
+    fused-head model must still produce them there (train/step.py
+    make_predict_step materializes hidden @ kernel)."""
+    from distributeddeeplearningspark_tpu.train.step import make_predict_step
+
+    cfg = LlamaConfig.tiny(fused_head_loss=True)
+    model = LlamaForCausalLM(cfg)
+    batch = make_batch()
+    params = model.init(jax.random.PRNGKey(0), batch, train=False)["params"]
+
+    class S:  # minimal TrainState stand-in
+        pass
+
+    state = S()
+    state.params, state.mutable = params, {}
+    logits = make_predict_step(model.apply)(state, batch)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    # matches the plain model's logits
+    plain = LlamaForCausalLM(LlamaConfig.tiny()).apply(
+        {"params": params}, batch, train=False)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(plain),
+                               atol=2e-5, rtol=2e-5)
