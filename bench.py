@@ -357,6 +357,59 @@ def bench_dlrm(iters: int, batch_size: int = 8192) -> dict:
     }
 
 
+def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
+                size: int = 500) -> dict:
+    """HOST input-pipeline throughput: JPEG decode → train augment → batch.
+
+    SURVEY §7 hard-part #2: the device consumes ~2.5k images/sec/chip
+    (ResNet-50 row above), so the per-host decode+augment rate bounds how
+    many chips one host can feed. Synthetic JPEGs (PIL-encoded, ~real
+    ImageNet dimensions) through the REAL path: ``imagenet_folder`` →
+    ``imagenet_train`` (native C++ decode/crop/flip/normalize kernels
+    with PIL/numpy fallbacks) → ``host_batches``. CPU-only — runs even
+    when the TPU is down.
+    """
+    import tempfile
+
+    from PIL import Image
+
+    from distributeddeeplearningspark_tpu.data.feed import host_batches
+    from distributeddeeplearningspark_tpu.data.sources import imagenet_folder
+    from distributeddeeplearningspark_tpu.data.vision import imagenet_train
+    from distributeddeeplearningspark_tpu.utils import native
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as root:
+        import os
+
+        for cls in range(4):
+            d = os.path.join(root, f"class_{cls:03d}")
+            os.makedirs(d)
+            for i in range(n_images // 4):
+                arr = rng.integers(0, 255, (size, size, 3), np.uint8)
+                Image.fromarray(arr).save(
+                    os.path.join(d, f"img_{i:04d}.jpg"), quality=90)
+        # decode=False + repeat=True: decode runs inside the parallel
+        # transform, and one thread pool lives across epoch boundaries
+        ds = imagenet_train(
+            imagenet_folder(root, num_partitions=4, decode=False),
+            seed=0, repeat=True)
+        feed = host_batches(ds, batch_size)
+        next(feed)  # warm caches / lazy imports
+        t0 = time.perf_counter()
+        seen = 0
+        for _ in range(max(2, iters // 4)):
+            b = next(feed)
+            seen += len(b["label"])
+        dt = time.perf_counter() - t0
+    return {
+        "host_images_per_sec": round(seen / dt, 1),
+        "native_kernels": native.available(),
+        "image_px": size,
+        "batch_size": batch_size,
+    }
+
+
 def pallas_smoke() -> dict:
     """Compile-and-run flash attention fwd+bwd on the real chip (Mosaic).
 
@@ -407,7 +460,7 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float, extra: dict) 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model",
-                    choices=["all", "resnet", "bert", "llama", "dlrm"],
+                    choices=["all", "resnet", "bert", "llama", "dlrm", "input"],
                     default="all")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--batch", type=int, default=0,
@@ -422,6 +475,22 @@ def main(argv=None) -> int:
 
     extra: dict = {"errors": []}
     backend = "tpu"
+    if args.model == "input":
+        # host-only workload: never touch the accelerator (jax.devices() on
+        # a downed TPU tunnel hangs indefinitely — the exact failure this
+        # harness exists to survive). The env var alone loses to the site
+        # hook's pre-registered TPU plugin; apply_env_platform_config
+        # re-asserts it through jax.config (utils/env.py).
+        import os
+
+        from distributeddeeplearningspark_tpu.utils.env import (
+            apply_env_platform_config,
+        )
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        apply_env_platform_config()
+        backend = "host"
+        args.skip_probe = args.skip_smoke = True
     if not args.skip_probe:
         ok, probe_errors = probe_backend()
         extra["errors"].extend(probe_errors)
@@ -453,11 +522,13 @@ def main(argv=None) -> int:
         except Exception:  # noqa: BLE001 — stats are best-effort extras
             return None
 
-    want = {"all": ("resnet50", "bert_base_mlm", "llama_lora", "dlrm"),
+    want = {"all": ("resnet50", "bert_base_mlm", "llama_lora", "dlrm",
+                    "input_pipeline"),
             "resnet": ("resnet50",),
             "bert": ("bert_base_mlm",),
             "llama": ("llama_lora",),
-            "dlrm": ("dlrm",)}[args.model]
+            "dlrm": ("dlrm",),
+            "input": ("input_pipeline",)}[args.model]
     runners = {
         "resnet50": lambda: bench_resnet(
             args.iters, **({"batch_size": args.batch} if args.batch else {})),
@@ -469,6 +540,8 @@ def main(argv=None) -> int:
             max(5, args.iters // 2),
             **({"batch_size": args.batch} if args.batch else {}),
             **({"seq": args.seq} if args.seq else {})),
+        "input_pipeline": lambda: bench_input(
+            args.iters, **({"batch_size": args.batch} if args.batch else {})),
         "dlrm": lambda: bench_dlrm(
             args.iters, **({"batch_size": args.batch} if args.batch else {})),
     }
@@ -504,6 +577,10 @@ def main(argv=None) -> int:
         name, r = "dlrm", results["dlrm"]
         value, unit = r["examples_per_sec_per_chip"], "examples/sec/chip"
         metric = "dlrm_examples_per_sec_per_chip"
+    elif "input_pipeline" in results:
+        name, r = "input_pipeline", results["input_pipeline"]
+        value, unit = r["host_images_per_sec"], "images/sec/host"
+        metric = "input_pipeline_host_images_per_sec"
     else:
         emit("bench_failed", 0.0, "none", 0.0, extra)
         return 0

@@ -155,6 +155,7 @@ def train_transform(size: int = 224, seed: int = 0) -> Callable[[dict], dict]:
     """
 
     def apply(example: dict) -> dict:
+        example = _decode_if_bytes(example)
         img = example["image"]
         rng = np.random.default_rng((seed * 2654435761 + _content_seed(img)) & 0xFFFFFFFF)
         needs_crop = img.shape[0] != size or img.shape[1] != size
@@ -180,10 +181,23 @@ def train_transform(size: int = 224, seed: int = 0) -> Callable[[dict], dict]:
     return apply
 
 
+def _decode_if_bytes(example: dict) -> dict:
+    """``{"jpeg": bytes}`` (imagenet_folder(decode=False)) → decoded
+    ``{"image": ...}``. Decoding INSIDE the transform is what lets
+    ``map_parallel`` spread it over cores — decode in the source iterator
+    runs on the single consumer thread no matter the pool size."""
+    if "jpeg" not in example:
+        return example
+    out = {k: v for k, v in example.items() if k != "jpeg"}
+    out["image"] = decode_jpeg(example["jpeg"])
+    return out
+
+
 def eval_transform(size: int = 224) -> Callable[[dict], dict]:
     """uint8 → scale+standardize (see train_transform contract); float → crop only."""
 
     def apply(example: dict) -> dict:
+        example = _decode_if_bytes(example)
         img = example["image"]
         needs_crop = img.shape[0] != size or img.shape[1] != size
         if img.dtype == np.uint8:
@@ -200,10 +214,28 @@ def eval_transform(size: int = 224) -> Callable[[dict], dict]:
     return apply
 
 
-def imagenet_train(dataset: PartitionedDataset, *, size: int = 224, seed: int = 0) -> PartitionedDataset:
-    """RDD-shaped pipeline: shuffle → augment, per partition on the host."""
-    return dataset.shuffle(seed).map(train_transform(size, seed))
+def imagenet_train(dataset: PartitionedDataset, *, size: int = 224, seed: int = 0,
+                   num_threads: int | None = None,
+                   repeat: bool = False) -> PartitionedDataset:
+    """RDD-shaped pipeline: shuffle → (repeat) → decode+augment.
+
+    Feed it ``imagenet_folder(root, decode=False)`` so JPEG decode happens
+    INSIDE the (optionally parallel) transform — decode in the source
+    iterator would stay on the single consumer thread and cap a host at one
+    core's ~50–100 img/s while a chip consumes thousands (``bench.py
+    --model input``). ``num_threads``: thread-pool decode/augment (the
+    Spark task-slots-per-executor analog; 0/1 = serial; augmentation is
+    content-seeded per example, so scheduling cannot change the output).
+    ``repeat=True`` makes the stream infinite HERE — shuffle must precede
+    repeat, and repeating before the parallel map keeps one thread pool
+    alive across epochs instead of respawning per pass.
+    """
+    ds = dataset.shuffle(seed)
+    if repeat:
+        ds = ds.repeat()
+    return ds.map_parallel(train_transform(size, seed), num_threads=num_threads)
 
 
-def imagenet_eval(dataset: PartitionedDataset, *, size: int = 224) -> PartitionedDataset:
-    return dataset.map(eval_transform(size))
+def imagenet_eval(dataset: PartitionedDataset, *, size: int = 224,
+                  num_threads: int | None = None) -> PartitionedDataset:
+    return dataset.map_parallel(eval_transform(size), num_threads=num_threads)

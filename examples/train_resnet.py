@@ -49,9 +49,12 @@ def main() -> None:
     if args.data_dir:
         from distributeddeeplearningspark_tpu.data.sources import imagenet_folder
 
+        # decode=False: JPEG decode runs inside imagenet_train's (parallel)
+        # transform, not on the single partition-iterator thread
         ds = imagenet_folder(
-            args.data_dir, num_partitions=max(spark.default_parallelism, 1)
-        ).repeat()
+            args.data_dir, num_partitions=max(spark.default_parallelism, 1),
+            decode=False,
+        )
     else:
         ds = synthetic_images(
             args.batch_size * max(args.steps, 1),
@@ -59,7 +62,7 @@ def main() -> None:
             num_classes=args.num_classes,
             num_partitions=max(spark.default_parallelism, 1),
         )
-    ds = vision.imagenet_train(ds, size=args.image_size)
+    ds = vision.imagenet_train(ds, size=args.image_size, repeat=True)
 
     model = (ResNet50 if args.variant == "resnet50" else ResNet18)(num_classes=args.num_classes)
     schedule = optim.warmup_cosine(args.lr, warmup_steps=min(args.steps // 10, 500),
@@ -91,7 +94,7 @@ def main() -> None:
 
         profile = ProfileSpec(args.profile_dir, start_step=min(10, args.steps // 2))
     state, summary = trainer.fit(
-        ds.repeat(), batch_size=args.batch_size, steps=args.steps, log_every=10,
+        ds, batch_size=args.batch_size, steps=args.steps, log_every=10,
         profile=profile, measure_flops=args.mfu, tensorboard_dir=args.tensorboard_dir,
     )
     print(f"train summary: {summary}")
