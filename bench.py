@@ -475,12 +475,12 @@ def main(argv=None) -> int:
 
     extra: dict = {"errors": []}
     backend = "tpu"
-    if args.model == "input":
-        # host-only workload: never touch the accelerator (jax.devices() on
-        # a downed TPU tunnel hangs indefinitely — the exact failure this
-        # harness exists to survive). The env var alone loses to the site
-        # hook's pre-registered TPU plugin; apply_env_platform_config
-        # re-asserts it through jax.config (utils/env.py).
+
+    def force_cpu_platform() -> None:
+        """Point jax at the host CPU so jax.devices() cannot hang on a
+        downed TPU tunnel. The env var alone loses to the site hook's
+        pre-registered TPU plugin; apply_env_platform_config re-asserts it
+        through jax.config (utils/env.py)."""
         import os
 
         from distributeddeeplearningspark_tpu.utils.env import (
@@ -489,6 +489,10 @@ def main(argv=None) -> int:
 
         os.environ["JAX_PLATFORMS"] = "cpu"
         apply_env_platform_config()
+
+    if args.model == "input":
+        # host-only workload: never touch the accelerator
+        force_cpu_platform()
         backend = "host"
         args.skip_probe = args.skip_smoke = True
     if not args.skip_probe:
@@ -496,10 +500,19 @@ def main(argv=None) -> int:
         extra["errors"].extend(probe_errors)
         if not ok:
             if args.allow_cpu:
-                import os
-
-                os.environ["JAX_PLATFORMS"] = "cpu"
+                # explicit debug request wins over the all-mode degrade
+                force_cpu_platform()
                 backend = "cpu-fallback"
+            elif args.model == "all":
+                # the round's artifact shouldn't be empty just because the
+                # chip is down: degrade to the host-only input-pipeline
+                # workload and say exactly what happened
+                force_cpu_platform()
+                backend = "host"
+                extra["errors"].append(
+                    "TPU unavailable after retries; device workloads skipped "
+                    "— reporting host input-pipeline rate only")
+                args.model = "input"
             else:
                 emit("backend_unavailable", 0.0, "none", 0.0, {
                     **extra,

@@ -48,7 +48,9 @@ def test_backend_unavailable_emits_structured_json(monkeypatch, capsys):
     exactly the r1 failure mode that produced BENCH_r01.json rc=1)."""
     monkeypatch.setattr(bench, "probe_backend",
                         lambda **kw: (False, ["probe 1/3: hung past 150s (killed)"]))
-    rc = bench.main([])
+    # single device workload: all-mode now degrades to the host input bench
+    # instead (see test_all_mode_degrades_to_host_input_when_tpu_down)
+    rc = bench.main(["--model", "resnet"])
     assert rc == 0
     line = capsys.readouterr().out.strip().splitlines()[-1]
     rec = json.loads(line)
@@ -168,3 +170,19 @@ def test_routes_to_flash_matches_router(monkeypatch):
     assert bench._routes_to_flash(b=2, s=512, h=12, d=64, masked=True) is True
     # sub-block sequence falls back to XLA even on TPU
     assert bench._routes_to_flash(b=2, s=256, h=12, d=64, masked=True) is False
+
+
+def test_all_mode_degrades_to_host_input_when_tpu_down(monkeypatch, capsys):
+    """A downed TPU must not empty the round artifact: --model all falls back
+    to the host-only input-pipeline workload with the outage recorded."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")  # contain the env mutation
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda **kw: (False, ["probe 1/1: hung (killed)"]))
+    monkeypatch.setattr(bench, "bench_input",
+                        lambda iters, **kw: {"host_images_per_sec": 42.0})
+    rc = bench.main(["--model", "all"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "input_pipeline_host_images_per_sec"
+    assert rec["value"] == 42.0
+    assert any("device workloads skipped" in e for e in rec["extra"]["errors"])
