@@ -139,6 +139,21 @@ def synthetic_criteo(
     return PartitionedDataset([make_partition(i) for i in range(num_partitions)])
 
 
+def folder_classes(root: str) -> dict[str, int]:
+    """Class→index mapping of a class-per-subdir tree (sorted-name order,
+    the torchvision convention). Use to PIN one mapping across splits —
+    letting train and eval dirs each derive their own silently misaligns
+    labels whenever the directory sets differ."""
+    root = os.path.abspath(root)
+    names = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d)) and not d.startswith(".")
+    )
+    if not names:
+        raise FileNotFoundError(f"no class directories under {root}")
+    return {n: i for i, n in enumerate(names)}
+
+
 def imagenet_folder(
     root: str,
     *,
@@ -159,15 +174,7 @@ def imagenet_folder(
     ``"jpeg"`` for pipelines that want decode inside a later ``.map``.
     """
     root = os.path.abspath(root)
-    classes = class_to_index
-    if classes is None:
-        names = sorted(
-            d for d in os.listdir(root)
-            if os.path.isdir(os.path.join(root, d)) and not d.startswith(".")
-        )
-        if not names:
-            raise FileNotFoundError(f"no class directories under {root}")
-        classes = {n: i for i, n in enumerate(names)}
+    classes = class_to_index if class_to_index is not None else folder_classes(root)
     files: list[tuple[str, int]] = []
     exts = (".jpeg", ".jpg", ".JPEG", ".JPG")
     for name, idx in sorted(classes.items()):

@@ -194,7 +194,14 @@ def _decode_if_bytes(example: dict) -> dict:
 
 
 def eval_transform(size: int = 224) -> Callable[[dict], dict]:
-    """uint8 → scale+standardize (see train_transform contract); float → crop only."""
+    """uint8 → scale+standardize (see train_transform contract); float → crop only.
+
+    The shorter-side resize scales with the crop (ratio 0.875 — the standard
+    256→224 ImageNet recipe generalized): a fixed 256 would be a zoom for any
+    other crop size (e.g. size=64 would evaluate on the central 24×24 of the
+    original image — measured as a 1.0-train / 0.28-eval accuracy split on a
+    memorized toy set before this scaled)."""
+    resize_shorter = int(round(size / 0.875))
 
     def apply(example: dict) -> dict:
         example = _decode_if_bytes(example)
@@ -206,9 +213,10 @@ def eval_transform(size: int = 224) -> Callable[[dict], dict]:
 
                 return {**example, "image": native.normalize_u8_batch(
                     img[None], IMAGENET_MEAN, IMAGENET_STD)[0]}
-            img = normalize(center_crop(img.astype(np.float32) / 255.0, size))
+            img = normalize(center_crop(img.astype(np.float32) / 255.0, size,
+                                        resize_shorter))
         elif needs_crop:
-            img = center_crop(img, size)
+            img = center_crop(img, size, resize_shorter)
         return {**example, "image": np.ascontiguousarray(img, np.float32)}
 
     return apply

@@ -30,6 +30,9 @@ def main() -> None:
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--data-dir", default=None,
                    help="ImageNet root (class-per-subdir of JPEGs); synthetic if unset")
+    p.add_argument("--eval-dir", default=None,
+                   help="validation root (same layout); reports top-1/top-5 "
+                        "after training via the exact tail-inclusive evaluator")
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace window into this dir")
@@ -98,6 +101,27 @@ def main() -> None:
         profile=profile, measure_flops=args.mfu, tensorboard_dir=args.tensorboard_dir,
     )
     print(f"train summary: {summary}")
+    if args.eval_dir:
+        from distributeddeeplearningspark_tpu.data.sources import (
+            folder_classes,
+            imagenet_folder,
+        )
+
+        eval_ds = vision.imagenet_eval(
+            imagenet_folder(
+                args.eval_dir, num_partitions=max(spark.default_parallelism, 1),
+                decode=False,
+                # pin the TRAINING mapping: an eval dir with a different
+                # class-directory set would otherwise silently renumber
+                # labels and report confident garbage
+                class_to_index=(folder_classes(args.data_dir)
+                                if args.data_dir else None),
+            ),
+            size=args.image_size,
+        )
+        emetrics = trainer.evaluate(eval_ds, batch_size=args.batch_size)
+        print(f"eval metrics: "
+              f"{ {k: round(float(v), 4) for k, v in emetrics.items()} }")
     spark.stop()
 
 

@@ -311,3 +311,22 @@ def test_clean_wikitext_handles_nested_templates():
     s = "Keep {{outer {{inner}} more}} this and {{a|b}} that."
     out = clean_wikitext(s)
     assert "{{" not in out and "Keep" in out and "this and" in out
+
+
+def test_eval_transform_resize_scales_with_crop_size():
+    """The shorter-side resize must track the crop (ratio 0.875, the 256→224
+    recipe generalized). A fixed 256 would zoom a 64-crop onto the central
+    24×24 of the source — measured as a 1.0-train/0.28-eval accuracy split
+    on a memorized set before the fix."""
+    import numpy as np
+
+    from distributeddeeplearningspark_tpu.data.vision import eval_transform
+
+    # image with a bright left half: a correct 64/73 resize+center-crop keeps
+    # roughly half the crop bright; a 256 resize would see only the center
+    img = np.zeros((96, 96, 3), np.uint8)
+    img[:, :48] = 255
+    out = eval_transform(size=64)({"image": img, "label": 0})["image"]
+    assert out.shape == (64, 64, 3)
+    bright = (out[:, :, 0] > 0.0).mean()  # normalized: bright ≫ dark
+    assert 0.35 < bright < 0.65, bright  # ~half, not all-or-nothing
