@@ -30,9 +30,13 @@ Design (TPU-first):
   and contributes zero when ``j > i`` (computed-and-masked; SPMD lockstep
   means skipping would not save wall-clock on the critical path).
 
-``mask=None`` only: padding is expected to be handled by loss masking in CP
-training (documented limitation; the reference's own BERT pads to fixed 512
-and masks in the loss the same way).
+Key-padding masks (VERDICT r2 #6): a key-only mask ([B, Sk] or the BERT
+[B, 1, 1, Sk] broadcast form) is sharded over ``seq`` like K/V and **rides the
+ring with its K/V block** — each hop masks its local logits (einsum path) or
+streams the mask block into the flash kernel (which takes key-only masks
+natively), so padded-batch models (BERT-style) can use CP. Q-dependent masks
+remain unsupported (use ``impl='xla'``); fully-masked rows emit zero output,
+matching the flash kernel's convention.
 """
 
 from __future__ import annotations
@@ -73,7 +77,23 @@ def _causal_allowed(my_idx, blk, sq, sk):
     return q_pos >= k_pos
 
 
-def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
+def _hop_allowed(my_idx, blk, sq, sk, causal, mask_cur):
+    """Combined attend-permission for one hop, broadcastable over
+    [B, Hkv, G, Sq, Sk] logits, or None when nothing is masked.
+
+    ``mask_cur``: this hop's key-padding block [B, Sk] (int, 0 = pad) — the
+    mask shard that arrived with the K/V block riding the ring.
+    """
+    allowed = None
+    if causal:
+        allowed = _causal_allowed(my_idx, blk, sq, sk)        # [Sq, Sk]
+    if mask_cur is not None:
+        pad_ok = (mask_cur != 0)[:, None, None, None, :]      # [B,1,1,1,Sk]
+        allowed = pad_ok if allowed is None else jnp.logical_and(allowed, pad_ok)
+    return allowed
+
+
+def _ring_fwd_local(q, k, v, mask, *, axis_name, causal, scale):
     """One ring revolution of online softmax; returns (o, lse).
 
     o: [B, Sq, H, D] in q.dtype; lse: [B, Hkv, G, Sq] f32 (log-sum-exp of
@@ -93,7 +113,7 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
     # receive from right neighbor: after i hops this chip holds block my+i
     perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
 
-    def accumulate(acc, i, k_cur, v_cur):
+    def accumulate(acc, i, k_cur, v_cur, mask_cur):
         """Online-softmax update of (o, l, m) with K/V block (my_idx+i)."""
         o, l, m = acc
         blk = (my_idx + i) % axis_size
@@ -101,12 +121,15 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
             "bqhgd,bkhd->bhgqk", qf, k_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )                                                     # [B,Hkv,G,Sq,Sk]
-        if causal:
-            allowed = _causal_allowed(my_idx, blk, sq, sk)
+        allowed = _hop_allowed(my_idx, blk, sq, sk, causal, mask_cur)
+        if allowed is not None:
             logits = jnp.where(allowed, logits, _NEG_INF)
+            # a fully-masked row's max IS the mask value, so exp(s - m) = 1
+            # there — the explicit re-zero below is load-bearing, not belt
+            # and braces
         m_new = jnp.maximum(m, logits.max(axis=-1))           # [B,Hkv,G,Sq]
         p = jnp.exp(logits - m_new[..., None])
-        if causal:
+        if allowed is not None:
             p = jnp.where(allowed, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
@@ -115,11 +138,18 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
         return o_new, l_new, m_new
 
     def block(carry, i):
-        o, l, m, k_cur, v_cur = carry
-        acc = accumulate((o, l, m), i, k_cur, v_cur)
+        if mask is None:
+            o, l, m, k_cur, v_cur = carry
+            mask_cur = None
+        else:
+            o, l, m, k_cur, v_cur, mask_cur = carry
+        acc = accumulate((o, l, m), i, k_cur, v_cur, mask_cur)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (*acc, k_nxt, v_nxt), None
+        if mask is None:
+            return (*acc, k_nxt, v_nxt), None
+        return (*acc, k_nxt, v_nxt,
+                lax.ppermute(mask_cur, axis_name, perm)), None
 
     init_acc = (
         jnp.zeros((b, sq, hkv, g, d), jnp.float32),
@@ -128,19 +158,24 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale):
     )
     if axis_size > 1:
         # scan the first N-1 blocks (each ends with the neighbor exchange)...
-        carry, _ = lax.scan(block, (*init_acc, k, v), jnp.arange(axis_size - 1))
-        o, l, m, k_last, v_last = carry
+        ring = (k, v) if mask is None else (k, v, mask)
+        carry, _ = lax.scan(block, (*init_acc, *ring), jnp.arange(axis_size - 1))
+        o, l, m, k_last, v_last = carry[:5]
+        mask_last = carry[5] if mask is not None else None
         # ...and fold in the final block WITHOUT the (discarded) last rotation
-        o, l, m = accumulate((o, l, m), axis_size - 1, k_last, v_last)
+        o, l, m = accumulate((o, l, m), axis_size - 1, k_last, v_last, mask_last)
     else:
-        o, l, m = accumulate(init_acc, 0, k, v)
-    # causal ⇒ every query attends at least to itself ⇒ l > 0
-    out = o / l.transpose(0, 3, 1, 2)[..., None]
-    lse = m + jnp.log(l)
+        o, l, m = accumulate(init_acc, 0, k, v, mask)
+    # causal ⇒ every query attends at least to itself ⇒ l > 0; under a
+    # padding mask a row may have NO valid keys anywhere — emit zero output
+    # and a finite mask-value LSE (the flash kernel's convention), never NaN
+    l_safe = jnp.where(l > 0, l, 1.0)
+    out = o / l_safe.transpose(0, 3, 1, 2)[..., None]
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), _NEG_INF)
     return out.reshape(b, sq, h, d).astype(q.dtype), lse
 
 
-def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, scale):
+def _ring_bwd_local(q, k, v, mask, o, lse, do, *, axis_name, causal, scale):
     """Reverse ring pass: recompute per-block probabilities from the saved
     LSE, accumulate dQ locally and ride (K, V, dK, dV) around the ring so
     each block's gradient returns home after a full revolution.
@@ -163,16 +198,22 @@ def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, scale):
     perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
 
     def hop(carry, i):
-        dq, k_cur, v_cur, dk, dv = carry
+        if mask is None:
+            dq, k_cur, v_cur, dk, dv = carry
+            mask_cur = None
+        else:
+            dq, k_cur, v_cur, dk, dv, mask_cur = carry
         blk = (my_idx + i) % axis_size
         kf = k_cur.astype(jnp.float32)
         logits = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf,
                             preferred_element_type=jnp.float32)
-        if causal:
-            allowed = _causal_allowed(my_idx, blk, sq, sk)
+        allowed = _hop_allowed(my_idx, blk, sq, sk, causal, mask_cur)
+        if allowed is not None:
             logits = jnp.where(allowed, logits, _NEG_INF)
         p = jnp.exp(logits - lse[..., None])                 # [B,Hkv,G,Sq,Sk]
-        if causal:
+        if allowed is not None:
+            # fully-masked rows carry the finite sentinel LSE, so exp() gives
+            # 1.0 under the mask there — the re-zero is load-bearing
             p = jnp.where(allowed, p, 0.0)
         # dV_blk += Pᵀ dO ; dP = dO Vᵀ ; dS = P ∘ (dP - delta)
         # (einsums sum over G, folding every q head of the group into the
@@ -189,15 +230,19 @@ def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, causal, scale):
         k_cur, v_cur, dk, dv = (
             lax.ppermute(x, axis_name, perm) for x in (k_cur, v_cur, dk, dv)
         )
-        return (dq, k_cur, v_cur, dk, dv), None
+        if mask is None:
+            return (dq, k_cur, v_cur, dk, dv), None
+        return (dq, k_cur, v_cur, dk, dv,
+                lax.ppermute(mask_cur, axis_name, perm)), None
 
     init = (
         jnp.zeros((b, sq, hkv, g, d), jnp.float32),
         k, v,
         jnp.zeros((b, sk, hkv, d), jnp.float32),
         jnp.zeros((b, sk, hkv, d), jnp.float32),
-    )
-    (dq, _, _, dk, dv), _ = lax.scan(hop, init, jnp.arange(axis_size))
+    ) + (() if mask is None else (mask,))
+    carry, _ = lax.scan(hop, init, jnp.arange(axis_size))
+    dq, _, _, dk, dv = carry[:5]
     return (dq.reshape(b, sq, h, d).astype(q.dtype),
             dk.astype(k.dtype), dv.astype(v.dtype))
 
@@ -240,12 +285,16 @@ def _hop_active(my_idx, i, axis_size, causal):
     return (my_idx + i >= axis_size).astype(jnp.float32)
 
 
-def _ring_fwd_flash(q, k, v, *, axis_name, causal, scale, interpret):
+def _ring_fwd_flash(q, k, v, mask, *, axis_name, causal, scale, interpret):
     """Ring revolution with the flash kernel per hop; returns (o, lse).
 
     lse: [B·H, Sq] f32 — flat-head layout (the backward consumes it as-is).
     Partial outputs are merged online in f32 via the standard normalized
     combine: lse' = logaddexp(lse, lse_i), o' = o·e^{lse−lse'} + o_i·e^{lse_i−lse'}.
+    ``mask`` ([B, Sk] key-padding block, or None) rides the ring with K/V and
+    streams into the kernel per hop; a hop whose block is fully padded emits
+    zero output with a finite mask-value LSE, so the merge needs no extra
+    gating.
     """
     from distributeddeeplearningspark_tpu.ops import flash_attention as fa
 
@@ -259,16 +308,21 @@ def _ring_fwd_flash(q, k, v, *, axis_name, causal, scale, interpret):
     run = functools.partial(fa._flash_fwd, scale=scale, group=group,
                             block_q=block, block_k=block, interpret=interpret)
 
-    o0, lse0 = run(qf, kf, vf, None, causal=causal)  # hop 0 = diagonal block
+    o0, lse0 = run(qf, kf, vf, mask, causal=causal)  # hop 0 = diagonal block
     o0 = o0.astype(jnp.float32)
 
     perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
 
     def hop(carry, i):
-        o, lse, k_cur, v_cur = carry
+        if mask is None:
+            o, lse, k_cur, v_cur = carry
+            mask_cur = None
+        else:
+            o, lse, k_cur, v_cur, mask_cur = carry
+            mask_cur = lax.ppermute(mask_cur, axis_name, perm)
         k_cur = lax.ppermute(k_cur, axis_name, perm)
         v_cur = lax.ppermute(v_cur, axis_name, perm)
-        oi, lsei = run(qf, k_cur, v_cur, None, causal=False)
+        oi, lsei = run(qf, k_cur, v_cur, mask_cur, causal=False)
         active = _hop_active(my_idx, i, axis_size, causal)
         # inactive hop: SELECT the contribution away (never scale by 0 — an
         # unmasked kernel output can carry inf/NaN for fully-masked future
@@ -279,16 +333,19 @@ def _ring_fwd_flash(q, k, v, *, axis_name, causal, scale, interpret):
         new_lse = jnp.logaddexp(lse, lsei)
         o = (o * jnp.exp(lse - new_lse)[..., None]
              + oi * jnp.exp(lsei - new_lse)[..., None])
-        return (o, new_lse, k_cur, v_cur), None
+        if mask is None:
+            return (o, new_lse, k_cur, v_cur), None
+        return (o, new_lse, k_cur, v_cur, mask_cur), None
 
     o, lse = o0, lse0
     if axis_size > 1:
-        (o, lse, _, _), _ = lax.scan(
-            hop, (o0, lse0, kf, vf), jnp.arange(1, axis_size))
+        ring = (kf, vf) if mask is None else (kf, vf, mask)
+        carry, _ = lax.scan(hop, (o0, lse0, *ring), jnp.arange(1, axis_size))
+        o, lse = carry[:2]
     return _unflat_heads(o, b, h).astype(q.dtype), lse
 
 
-def _ring_bwd_flash(q, k, v, o, lse, do, *, axis_name, causal, scale,
+def _ring_bwd_flash(q, k, v, mask, o, lse, do, *, axis_name, causal, scale,
                     interpret):
     """Reverse revolution with the flash backward kernels per hop.
 
@@ -312,7 +369,7 @@ def _ring_bwd_flash(q, k, v, o, lse, do, *, axis_name, causal, scale,
     run = functools.partial(fa._flash_bwd, scale=scale, group=group,
                             block_q=block, block_k=block, interpret=interpret)
 
-    dq0, dk0, dv0 = run((qf, kf, vf, None, of, lse), dof, causal=causal)
+    dq0, dk0, dv0 = run((qf, kf, vf, mask, of, lse), dof, causal=causal)
     if axis_size == 1:
         return (_unflat_heads(dq0.astype(jnp.float32), b, h).astype(q.dtype),
                 _unflat_heads(dk0.astype(jnp.float32), b, hkv).astype(k.dtype),
@@ -324,9 +381,14 @@ def _ring_bwd_flash(q, k, v, o, lse, do, *, axis_name, causal, scale,
         return tuple(lax.ppermute(x, axis_name, perm) for x in xs)
 
     def hop(carry, i):
-        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        if mask is None:
+            dq, k_cur, v_cur, dk_cur, dv_cur = carry
+            mask_cur = None
+        else:
+            dq, k_cur, v_cur, dk_cur, dv_cur, mask_cur = carry
+            (mask_cur,) = rotate(mask_cur)
         k_cur, v_cur, dk_cur, dv_cur = rotate(k_cur, v_cur, dk_cur, dv_cur)
-        dqi, dki, dvi = run((qf, k_cur, v_cur, None, of, lse), dof,
+        dqi, dki, dvi = run((qf, k_cur, v_cur, mask_cur, of, lse), dof,
                             causal=False)
         active = _hop_active(my_idx, i, axis_size, causal)
         # SELECT, never multiply: an inactive (fully-masked future) hop runs
@@ -337,11 +399,15 @@ def _ring_bwd_flash(q, k, v, o, lse, do, *, axis_name, causal, scale,
         dq = dq + gate(dqi)
         dk_cur = dk_cur + gate(dki)
         dv_cur = dv_cur + gate(dvi)
-        return (dq, k_cur, v_cur, dk_cur, dv_cur), None
+        if mask is None:
+            return (dq, k_cur, v_cur, dk_cur, dv_cur), None
+        return (dq, k_cur, v_cur, dk_cur, dv_cur, mask_cur), None
 
     init = (dq0.astype(jnp.float32), kf, vf,
-            dk0.astype(jnp.float32), dv0.astype(jnp.float32))
-    (dq, _, _, dk, dv), _ = lax.scan(hop, init, jnp.arange(1, axis_size))
+            dk0.astype(jnp.float32), dv0.astype(jnp.float32)) + (
+        () if mask is None else (mask,))
+    carry, _ = lax.scan(hop, init, jnp.arange(1, axis_size))
+    dq, _, _, dk, dv = carry[:5]
     # one final rotation brings each block's gradient back to its home chip
     dk, dv = rotate(dk, dv)
     return (_unflat_heads(dq, b, h).astype(q.dtype),
@@ -349,39 +415,46 @@ def _ring_bwd_flash(q, k, v, o, lse, do, *, axis_name, causal, scale,
             _unflat_heads(dv, b, hkv).astype(v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_attention_local(q, k, v, axis_name, causal, scale, impl):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_attention_local(q, k, v, mask, axis_name, causal, scale, impl):
     """Per-shard ring attention (inside shard_map); blockwise custom VJP.
 
+    ``mask``: this shard's key-padding block [B, Sk] int32, or None. A
+    regular (non-static) argument with a None cotangent — the same pattern
+    the flash kernel's VJP uses.
     ``impl``: ("einsum",) — XLA per-hop compute — or ("flash", interpret) —
     Pallas kernel per hop (static tuple so it can ride nondiff_argnums).
     """
-    o, _ = _ring_fwd(q, k, v, axis_name=axis_name, causal=causal,
+    o, _ = _ring_fwd(q, k, v, mask, axis_name=axis_name, causal=causal,
                      scale=scale, impl=impl)
     return o
 
 
-def _ring_fwd(q, k, v, *, axis_name, causal, scale, impl):
+def _ring_fwd(q, k, v, mask, *, axis_name, causal, scale, impl):
     if impl[0] == "flash":
-        return _ring_fwd_flash(q, k, v, axis_name=axis_name, causal=causal,
-                               scale=scale, interpret=impl[1])
-    return _ring_fwd_local(q, k, v, axis_name=axis_name, causal=causal,
+        return _ring_fwd_flash(q, k, v, mask, axis_name=axis_name,
+                               causal=causal, scale=scale, interpret=impl[1])
+    return _ring_fwd_local(q, k, v, mask, axis_name=axis_name, causal=causal,
                            scale=scale)
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, impl):
-    o, lse = _ring_fwd(q, k, v, axis_name=axis_name, causal=causal,
+def _ring_vjp_fwd(q, k, v, mask, axis_name, causal, scale, impl):
+    o, lse = _ring_fwd(q, k, v, mask, axis_name=axis_name, causal=causal,
                        scale=scale, impl=impl)
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, mask, o, lse)
 
 
 def _ring_vjp_bwd(axis_name, causal, scale, impl, res, g):
-    q, k, v, o, lse = res
+    q, k, v, mask, o, lse = res
     if impl[0] == "flash":
-        return _ring_bwd_flash(q, k, v, o, lse, g, axis_name=axis_name,
-                               causal=causal, scale=scale, interpret=impl[1])
-    return _ring_bwd_local(q, k, v, o, lse, g, axis_name=axis_name,
-                           causal=causal, scale=scale)
+        dq, dk, dv = _ring_bwd_flash(
+            q, k, v, mask, o, lse, g, axis_name=axis_name, causal=causal,
+            scale=scale, interpret=impl[1])
+    else:
+        dq, dk, dv = _ring_bwd_local(
+            q, k, v, mask, o, lse, g, axis_name=axis_name, causal=causal,
+            scale=scale)
+    return dq, dk, dv, None
 
 
 _ring_attention_local.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
@@ -436,12 +509,17 @@ def ring_attention(
     the kernel's O(Sq·block) tiles. ``None`` = auto: on TPU whenever the
     local shapes satisfy the kernel's tiling rules; off-TPU the einsum path
     (tests opt in explicitly and get interpret-mode kernels).
+
+    ``mask``: key-only padding mask ([B, Sk], [Sk], or the broadcastable
+    BERT [B, 1, 1, Sk] form — :func:`..flash_attention.as_kv_mask`). It is
+    sharded over ``seq`` exactly like K and rides the ring with its K/V
+    block, so padded-batch (BERT-style) models can context-parallelize
+    (VERDICT r2 #6). Masks that vary over queries/heads are rejected — use
+    ``impl='xla'``.
     """
-    if mask is not None or bias is not None:
+    if bias is not None:
         raise NotImplementedError(
-            "ring attention handles padding via loss masking; per-position "
-            "mask/bias tensors are not supported (use impl='xla')"
-        )
+            "ring attention does not take additive bias; use impl='xla'")
     if mesh is None:
         from distributeddeeplearningspark_tpu.session import Session
 
@@ -487,12 +565,27 @@ def ring_attention(
     spec = P(BATCH_AXES, AXIS_SEQ, AXIS_TENSOR, None)
     # custom_vjp nondiff args must be passed positionally (not via partial
     # keywords) or jax rejects the call under differentiation
+    if mask is None:
+        fn = jax.shard_map(
+            lambda qq, kk, vv: _ring_attention_local(
+                qq, kk, vv, None, AXIS_SEQ, causal, scale, impl),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+    from distributeddeeplearningspark_tpu.ops.flash_attention import as_kv_mask
+
+    # [B, Sk] int32, sharded like K's (batch, seq) dims — each chip's mask
+    # block rides the ring with its K/V block
+    kv_mask = as_kv_mask(mask, b, s)
     fn = jax.shard_map(
-        lambda qq, kk, vv: _ring_attention_local(
-            qq, kk, vv, AXIS_SEQ, causal, scale, impl),
+        lambda qq, kk, vv, mm: _ring_attention_local(
+            qq, kk, vv, mm, AXIS_SEQ, causal, scale, impl),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, P(BATCH_AXES, AXIS_SEQ)),
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, kv_mask)

@@ -118,6 +118,13 @@ def rowwise_adagrad_update(
     # sorted unique ids padded with the OOB sentinel `v`; inverse indices
     # fold duplicates into one segment per distinct row
     uniq, inv = jnp.unique(flat, return_inverse=True, size=k, fill_value=v)
+    # The pad slots all carry the same sentinel, but `unique_indices=True`
+    # below promises XLA collision-free indices — duplicate indices under
+    # that hint are documented UB, and relying on mode="drop" to discard
+    # them before the hint matters is backend-dependent (ADVICE r2). Spread
+    # the pads over v+0, v+1, ... : still OOB (every pad ≥ v), still sorted
+    # (pads are the trailing run and arange increases), now genuinely unique.
+    uniq = jnp.where(uniq == v, v + jnp.arange(k, dtype=uniq.dtype), uniq)
     row_g = jax.ops.segment_sum(g, inv.reshape(-1), num_segments=k)  # [K, D]
     acc_rows = jnp.take(accum, uniq, axis=0, mode="fill", fill_value=0.0)
     new_acc_rows = acc_rows + jnp.mean(row_g * row_g, axis=1)
