@@ -169,6 +169,58 @@ void dls_resize_bilinear(const float* in, int h, int w, int c, int oh, int ow,
   });
 }
 
+// Fused random-resized-crop: crop (y0,x0,ch,cw) of a uint8 HWC image,
+// bilinear-resize the crop to (oh,ow) (half-pixel centers, edge-clamped
+// within the crop), optional horizontal flip, then (x/255 - mean)/std —
+// all in one pass with no float intermediate image. Interpolating raw u8
+// then scaling is the same linear map as scaling-then-interpolating, so
+// this matches the Python crop→resize→normalize chain to fp rounding.
+// Parallel over output rows.
+void dls_rrc_flip_normalize(const uint8_t* in, int h, int w, int c,
+                            int y0, int x0, int ch, int cw, int flip,
+                            int oh, int ow, const float* mean,
+                            const float* std, float* out) {
+  (void)h;
+  std::vector<float> inv_std(c);
+  for (int k = 0; k < c; ++k) inv_std[k] = (1.0f / 255.0f) / std[k];
+  std::vector<float> bias(c);
+  for (int k = 0; k < c; ++k) bias[k] = mean[k] * 255.0f;
+  std::vector<int> x0s(ow), x1s(ow);
+  std::vector<float> wxs(ow);
+  for (int x = 0; x < ow; ++x) {
+    double src = (static_cast<double>(x) + 0.5) * cw / ow - 0.5;
+    int cx0 = std::clamp(static_cast<int>(std::floor(src)), 0, cw - 1);
+    x0s[x] = x0 + cx0;
+    x1s[x] = x0 + std::min(cx0 + 1, cw - 1);
+    // weight relative to the CLAMPED tap — same convention as
+    // dls_resize_bilinear / vision.resize_bilinear
+    wxs[x] = static_cast<float>(std::clamp(src - static_cast<double>(cx0), 0.0, 1.0));
+  }
+  parallel_for(oh, [&](int64_t y) {
+    double src = (static_cast<double>(y) + 0.5) * ch / oh - 0.5;
+    int cy0 = std::clamp(static_cast<int>(std::floor(src)), 0, ch - 1);
+    int cy1 = std::min(cy0 + 1, ch - 1);
+    float wy = static_cast<float>(
+        std::clamp(src - static_cast<double>(cy0), 0.0, 1.0));
+    const uint8_t* top = in + (static_cast<int64_t>(y0 + cy0) * w) * c;
+    const uint8_t* bot = in + (static_cast<int64_t>(y0 + cy1) * w) * c;
+    float* orow = out + y * ow * c;
+    for (int x = 0; x < ow; ++x) {
+      const float wx = wxs[x];
+      const uint8_t* tl = top + x0s[x] * c;
+      const uint8_t* tr = top + x1s[x] * c;
+      const uint8_t* bl = bot + x0s[x] * c;
+      const uint8_t* br = bot + x1s[x] * c;
+      const int xo = flip ? (ow - 1 - x) : x;
+      for (int k = 0; k < c; ++k) {
+        float t = tl[k] * (1.0f - wx) + tr[k] * wx;
+        float b = bl[k] * (1.0f - wx) + br[k] * wx;
+        orow[xo * c + k] = (t * (1.0f - wy) + b * wy - bias[k]) * inv_std[k];
+      }
+    }
+  });
+}
+
 // dst += src elementwise — the host gradient-aggregation primitive behind the
 // PR1 treeAggregate parity path (SURVEY.md §3.1). Parallel over chunks.
 void dls_sum_into_f32(float* dst, const float* src, int64_t n) {

@@ -21,6 +21,11 @@ from distributeddeeplearningspark_tpu.data.dataframe import (
     when,
 )
 from distributeddeeplearningspark_tpu.data.prefetch import prefetch_to_device
+from distributeddeeplearningspark_tpu.data.records import (
+    array_records,
+    write_array_records,
+    write_imagenet_records,
+)
 
 __all__ = [
     "device_batches",
@@ -28,6 +33,9 @@ __all__ = [
     "put_global",
     "stack_examples",
     "prefetch_to_device",
+    "array_records",
+    "write_array_records",
+    "write_imagenet_records",
     "Column",
     "DataFrame",
     "DataFrameReader",

@@ -64,11 +64,14 @@ def _resize(image: np.ndarray, size: tuple[int, int]) -> np.ndarray:
     return native.resize_bilinear(np.asarray(image, np.float32), size)
 
 
-def random_resized_crop(image: np.ndarray, rng: np.random.Generator, size: int = 224,
-                        scale: tuple[float, float] = (0.08, 1.0),
-                        ratio: tuple[float, float] = (3 / 4, 4 / 3)) -> np.ndarray:
-    """Inception-style crop: random area/aspect, resized to ``size``."""
-    h, w = image.shape[:2]
+def sample_crop_region(h: int, w: int, rng: np.random.Generator,
+                       scale: tuple[float, float] = (0.08, 1.0),
+                       ratio: tuple[float, float] = (3 / 4, 4 / 3),
+                       ) -> tuple[int, int, int, int] | None:
+    """Inception-style crop sampling: (y, x, ch, cw), or None when 10 draws
+    of random area/aspect never fit (extreme aspect ratios) — callers fall
+    back to a center crop. Split from :func:`random_resized_crop` so the
+    fused native path consumes the SAME rng stream and picks the same crop."""
     area = h * w
     for _ in range(10):
         target = area * rng.uniform(*scale)
@@ -78,8 +81,20 @@ def random_resized_crop(image: np.ndarray, rng: np.random.Generator, size: int =
         if cw <= w and ch <= h:
             y = int(rng.integers(0, h - ch + 1))
             x = int(rng.integers(0, w - cw + 1))
-            return _resize(image[y:y + ch, x:x + cw], (size, size))
-    return center_crop(image, size)  # fallback
+            return y, x, ch, cw
+    return None
+
+
+def random_resized_crop(image: np.ndarray, rng: np.random.Generator, size: int = 224,
+                        scale: tuple[float, float] = (0.08, 1.0),
+                        ratio: tuple[float, float] = (3 / 4, 4 / 3)) -> np.ndarray:
+    """Inception-style crop: random area/aspect, resized to ``size``."""
+    h, w = image.shape[:2]
+    region = sample_crop_region(h, w, rng, scale, ratio)
+    if region is None:
+        return center_crop(image, size)  # fallback
+    y, x, ch, cw = region
+    return _resize(image[y:y + ch, x:x + cw], (size, size))
 
 
 def center_crop(image: np.ndarray, size: int = 224, resize_shorter: int = 256) -> np.ndarray:
@@ -170,8 +185,27 @@ def train_transform(size: int = 224, seed: int = 0) -> Callable[[dict], dict]:
                     IMAGENET_MEAN, IMAGENET_STD,
                 )[0]
                 return {**example, "image": img}
-            img = random_resized_crop(img.astype(np.float32) / 255.0, rng, size)
-            img = normalize(random_flip(img, rng))
+            # uint8 + crop (the record input path): one fused native pass —
+            # crop→resize→flip→normalize with no float intermediate frame.
+            # Same rng stream as the numpy chain, so native/numpy pick the
+            # same crop and agree to fp tolerance.
+            from distributeddeeplearningspark_tpu.utils import native
+
+            region = sample_crop_region(img.shape[0], img.shape[1], rng)
+            flip = bool(rng.random() < 0.5)
+            fused = (
+                native.rrc_flip_normalize(
+                    img, region, flip, (size, size), IMAGENET_MEAN, IMAGENET_STD)
+                if region is not None else None)
+            if fused is not None:
+                return {**example, "image": fused}
+            if region is not None:
+                y, x, ch, cw = region
+                img = _resize(img[y:y + ch, x:x + cw].astype(np.float32) / 255.0,
+                              (size, size))
+            else:
+                img = center_crop(img.astype(np.float32) / 255.0, size)
+            img = normalize(img[:, ::-1] if flip else img)
         else:
             if needs_crop:
                 img = random_resized_crop(img, rng, size)
@@ -213,6 +247,18 @@ def eval_transform(size: int = 224) -> Callable[[dict], dict]:
 
                 return {**example, "image": native.normalize_u8_batch(
                     img[None], IMAGENET_MEAN, IMAGENET_STD)[0]}
+            h, w = img.shape[:2]
+            if min(h, w) == resize_shorter:
+                # record path (already shorter-side == resize_shorter): crop
+                # in uint8 and normalize in one native pass — no resize, no
+                # float intermediate frame
+                from distributeddeeplearningspark_tpu.utils import native
+
+                y, x = (h - size) // 2, (w - size) // 2
+                return {**example, "image": native.crop_flip_normalize_batch(
+                    img[None], np.array([y], np.int32), np.array([x], np.int32),
+                    np.zeros(1, np.uint8), (size, size),
+                    IMAGENET_MEAN, IMAGENET_STD)[0]}
             img = normalize(center_crop(img.astype(np.float32) / 255.0, size,
                                         resize_shorter))
         elif needs_crop:

@@ -71,7 +71,8 @@ class SelfAttention(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, mask: jax.Array, *, train: bool) -> jax.Array:
+    def __call__(self, x: jax.Array, mask: jax.Array, *, train: bool,
+                 segment_ids: jax.Array | None = None) -> jax.Array:
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
@@ -80,7 +81,8 @@ class SelfAttention(nn.Module):
         q = dense("query")(x)
         k = dense("key")(x)
         v = dense("value")(x)
-        y = dot_product_attention(q, k, v, mask=mask, impl=cfg.attention_impl)
+        y = dot_product_attention(q, k, v, mask=mask, segment_ids=segment_ids,
+                                  impl=cfg.attention_impl)
         y = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out")(y)
         return nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
 
@@ -89,10 +91,12 @@ class EncoderLayer(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, mask: jax.Array, *, train: bool) -> jax.Array:
+    def __call__(self, x: jax.Array, mask: jax.Array, *, train: bool,
+                 segment_ids: jax.Array | None = None) -> jax.Array:
         cfg = self.cfg
         # post-LN (original BERT): sublayer → residual → LayerNorm(f32)
-        y = SelfAttention(cfg, name="attention")(x, mask, train=train)
+        y = SelfAttention(cfg, name="attention")(x, mask, train=train,
+                                                 segment_ids=segment_ids)
         x = nn.LayerNorm(dtype=jnp.float32, name="attention_ln")(x + y).astype(cfg.dtype)
         y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(x)
         y = nn.gelu(y)
@@ -136,8 +140,13 @@ class BertEncoder(nn.Module):
         x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
 
         mask = padding_mask(batch.get("attention_mask", jnp.ones_like(ids)))
+        # packed sequences (VERDICT r2 #4): per-position document ids block
+        # attention across packed-document boundaries; the flash kernel
+        # streams them natively, the XLA path expands into the mask
+        segment_ids = batch.get("segment_ids")
         for i in range(cfg.num_layers):
-            x = EncoderLayer(cfg, name=f"layer_{i}")(x, mask, train=train)
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, mask, train=train,
+                                                     segment_ids=segment_ids)
         return x
 
 

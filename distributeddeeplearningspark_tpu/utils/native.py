@@ -91,6 +91,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _f32p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, _f32p,
     ]
+    lib.dls_rrc_flip_normalize.argtypes = [
+        _u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, _f32p, _f32p, _f32p,
+    ]
     lib.dls_sum_into_f32.argtypes = [_f32p, _f32p, ctypes.c_int64]
     lib.dls_jpeg_info.restype = ctypes.c_int
     lib.dls_jpeg_info.argtypes = [
@@ -204,6 +209,40 @@ def normalize_u8_batch(images: np.ndarray, mean: np.ndarray, std: np.ndarray) ->
         lib.dls_normalize_u8_batch(images, n, h, w, c, mean, std, out)
         return out
     return (images.astype(np.float32) / 255.0 - mean) / std
+
+
+def rrc_flip_normalize(
+    image: np.ndarray,                # [H, W, C] uint8
+    region: tuple[int, int, int, int],  # (y0, x0, ch, cw) crop in source px
+    flip: bool,
+    size: tuple[int, int],
+    mean: np.ndarray,
+    std: np.ndarray,
+) -> np.ndarray | None:
+    """Fused crop→bilinear-resize→flip→(x/255-mean)/std, uint8 in, f32 out.
+
+    The whole per-epoch augmentation tail of the record input path in ONE
+    GIL-free pass with no float intermediate image (the numpy chain converts
+    the full frame to f32 before cropping — ~4× the bytes touched). Returns
+    None when the native library is unavailable; callers fall back to the
+    equivalent numpy chain (vision.train_transform does).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    h, w, c = image.shape
+    y0, x0, ch, cw = region
+    if not (0 <= y0 and 0 <= x0 and ch > 0 and cw > 0
+            and y0 + ch <= h and x0 + cw <= w):
+        raise ValueError(f"crop region {region} out of bounds for {(h, w)}")
+    image = np.ascontiguousarray(image, np.uint8)
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    oh, ow = size
+    out = np.empty((oh, ow, c), np.float32)
+    lib.dls_rrc_flip_normalize(image, h, w, c, y0, x0, ch, cw, int(flip),
+                               oh, ow, mean, std, out)
+    return out
 
 
 def resize_bilinear(image: np.ndarray, size: tuple[int, int]) -> np.ndarray:

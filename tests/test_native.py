@@ -116,3 +116,46 @@ def test_crop_origin_bounds_checked():
         native.crop_flip_normalize_batch(
             imgs, np.zeros(2, np.int32), np.zeros(2, np.int32), flips,
             (13, 10), mean, std)
+
+
+def test_rrc_flip_normalize_parity():
+    """Fused crop→resize→flip→normalize == the numpy chain (crop the /255
+    float frame, resize_bilinear, flip, standardize) to fp tolerance —
+    up- and down-scaling crops, both flip states."""
+    img = _rand_u8((37, 53, 3), seed=11)
+    mean, std = vision.IMAGENET_MEAN, vision.IMAGENET_STD
+    for region, flip, size in [
+        ((3, 5, 20, 30), False, (16, 16)),   # downscale
+        ((0, 0, 9, 7), True, (24, 24)),      # upscale
+        ((10, 10, 16, 16), True, (16, 16)),  # identity resize
+    ]:
+        got = native.rrc_flip_normalize(img, region, flip, size, mean, std)
+        assert got is not None and got.dtype == np.float32
+        y, x, ch, cw = region
+        ref = vision.resize_bilinear(
+            img[y:y + ch, x:x + cw].astype(np.float32) / 255.0, size)
+        if flip:
+            ref = ref[:, ::-1]
+        ref = (ref - mean) / std
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_rrc_region_bounds_checked():
+    img = _rand_u8((16, 16, 3), seed=12)
+    mean, std = vision.IMAGENET_MEAN, vision.IMAGENET_STD
+    for bad in [(-1, 0, 8, 8), (0, 0, 17, 8), (10, 10, 8, 8), (0, 0, 0, 8)]:
+        with pytest.raises(ValueError, match="out of bounds"):
+            native.rrc_flip_normalize(img, bad, False, (8, 8), mean, std)
+
+
+def test_train_transform_native_matches_numpy(monkeypatch):
+    """The fused-native and numpy train paths must pick the SAME crop (same
+    rng stream) and agree to fp tolerance — scheduling/native availability
+    cannot change the augmented output."""
+    ex = {"image": _rand_u8((40, 48, 3), seed=13), "label": np.int32(1)}
+    tf = vision.train_transform(size=16, seed=3)
+    with_native = tf(dict(ex))["image"]
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_TRIED", True)
+    without = tf(dict(ex))["image"]
+    np.testing.assert_allclose(with_native, without, atol=1e-4, rtol=1e-4)
