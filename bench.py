@@ -211,7 +211,8 @@ def bench_resnet(iters: int, batch_size: int = 256) -> dict:
     return rec
 
 
-def bench_bert(iters: int, batch_size: int = 32, seq: int = 512) -> dict:
+def bench_bert(iters: int, batch_size: int = 32, seq: int = 512,
+               segment_ids: bool = False) -> dict:
     """BERT-base MLM tokens/sec/chip + MFU (BASELINE.json metric #2).
 
     Full 512-token sequences with an all-ones attention mask (the padding-mask
@@ -235,12 +236,20 @@ def bench_bert(iters: int, batch_size: int = 32, seq: int = 512) -> dict:
     for _ in range(batch_size):
         ids = rng.integers(0, 30522, (seq,)).astype(np.int32)
         weights = (rng.random(seq) < 0.15).astype(np.float32)
-        examples.append(pack_mlm_predictions({
+        ex = {
             "input_ids": ids,
             "attention_mask": np.ones((seq,), np.int32),
             "mlm_labels": ids,
             "mlm_weights": weights,
-        }, max_pred))
+        }
+        if segment_ids:
+            # packed-document shape (VERDICT r2 #4 A/B): ~3 docs per window,
+            # Wikipedia-like boundary positions
+            segs = np.zeros((seq,), np.int32)
+            for b1 in sorted(rng.integers(1, seq, size=2)):
+                segs[b1:] += 1
+            ex["segment_ids"] = segs
+        examples.append(pack_mlm_predictions(ex, max_pred))
     batch = stack_examples(examples)
     mesh, state, step, gbatch, flops = _train_setup(
         model, batch, losses.masked_lm, tx=optax.adamw(1e-4))
@@ -268,6 +277,7 @@ def bench_bert(iters: int, batch_size: int = 32, seq: int = 512) -> dict:
         "mfu": round(mfu, 4),
         "batch_size": batch_size,
         "seq_len": seq,
+        "segment_ids": segment_ids,
         "chips": n_chips,
     }
     _sanity_check_mfu(rec)
@@ -552,6 +562,10 @@ def main(argv=None) -> int:
                     help="override per-model default batch size (debug)")
     ap.add_argument("--seq", type=int, default=0,
                     help="override BERT sequence length (debug)")
+    ap.add_argument("--segment-ids", action="store_true",
+                    help="bert only: bench the packed-document shape (~3 "
+                         "segment ids per window streamed into the flash "
+                         "kernel) — the VERDICT r2 #4 kernel-cost A/B")
     ap.add_argument("--fused-head-loss", action="store_true",
                     help="llama only: fuse the LM-head matmul into the loss "
                          "(A/B vs materialized [B,S,V] logits)")
@@ -635,6 +649,7 @@ def main(argv=None) -> int:
             args.iters, **({"batch_size": args.batch} if args.batch else {})),
         "bert_base_mlm": lambda: bench_bert(
             args.iters,
+            segment_ids=args.segment_ids,
             **({"batch_size": args.batch} if args.batch else {}),
             **({"seq": args.seq} if args.seq else {})),
         "llama_lora": lambda: bench_llama(

@@ -35,6 +35,18 @@ def main() -> None:
                    help="gathered MLM form: vocab projection on at most this "
                         "many masked positions per sequence (-1 = auto "
                         "int(0.15*seq)+4; 0 = full-length head)")
+    p.add_argument("--segment-ids", action="store_true",
+                   help="emit packed-document segment ids so attention is "
+                        "blocked across document boundaries (flash kernel "
+                        "streams them natively)")
+    p.add_argument("--no-pack", action="store_true",
+                   help="one padded document per window (the reference-era "
+                        "shape) — kept for the padding-waste A/B; default "
+                        "packs documents back-to-back")
+    p.add_argument("--token-stats", action="store_true",
+                   help="print pad_frac/effective_frac over 512 sampled "
+                        "windows before training (costs one extra tokenize "
+                        "pass over the sample)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -62,7 +74,17 @@ def main() -> None:
     max_pred = (int(args.seq_len * 0.15) + 4 if args.max_predictions < 0
                 else args.max_predictions or None)
     ds = text_lib.mlm_dataset(docs, tok, seq_len=args.seq_len,
-                              max_predictions=max_pred).repeat()
+                              max_predictions=max_pred,
+                              segment_ids=args.segment_ids,
+                              pack=not args.no_pack)
+    if args.token_stats:
+        # honesty metric (VERDICT r2 #4): how much of the measured tokens/sec
+        # is real (non-pad) signal — packed pipelines sit near 1.0, the
+        # --no-pack baseline far below on natural text. Costs one extra
+        # tokenize pass over the sampled windows, so it's opt-in.
+        stats = text_lib.token_stats(ds, max_examples=512)
+        print(f"input token stats: {stats}")
+    ds = ds.repeat()
 
     make = bert_base if args.variant == "base" else bert_tiny
     model = make(vocab_size=tok.vocab_size, max_position=max(args.seq_len, 128))
