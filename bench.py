@@ -179,14 +179,21 @@ def _sanity_check_mfu(rec: dict) -> None:
             "as invalid")
 
 
-def bench_resnet(iters: int, batch_size: int = 256) -> dict:
-    """ResNet-50 images/sec/chip + MFU (BASELINE.json metric #1)."""
+def bench_resnet(iters: int, batch_size: int = 256,
+                 fused_conv_bn: bool = False) -> dict:
+    """ResNet-50 images/sec/chip + MFU (BASELINE.json metric #1).
+
+    ``fused_conv_bn``: route the bottlenecks' stride-1 1×1 conv→BN pairs
+    through the Pallas matmul-with-BN-stats-epilogue kernel
+    (ops/conv_bn.py) — the VERDICT r2 next-#2 byte-diet A/B.
+    """
     from distributeddeeplearningspark_tpu.data.feed import stack_examples
     from distributeddeeplearningspark_tpu.metrics import device_peak_flops
     from distributeddeeplearningspark_tpu.models import ResNet50
     from distributeddeeplearningspark_tpu.train import losses
 
-    model = ResNet50(num_classes=1000, dtype="bfloat16")
+    model = ResNet50(num_classes=1000, dtype="bfloat16",
+                     fused_conv_bn=fused_conv_bn)
     rng = np.random.default_rng(0)
     batch = stack_examples([
         {"image": rng.normal(0, 1, (224, 224, 3)).astype(np.float32),
@@ -205,6 +212,7 @@ def bench_resnet(iters: int, batch_size: int = 256) -> dict:
         "batch_size": batch_size,
         "image_px": 224,
         "dtype": "bfloat16",
+        "fused_conv_bn": fused_conv_bn,
         "chips": n_chips,
     }
     _sanity_check_mfu(rec)
@@ -562,6 +570,9 @@ def main(argv=None) -> int:
                     help="override per-model default batch size (debug)")
     ap.add_argument("--seq", type=int, default=0,
                     help="override BERT sequence length (debug)")
+    ap.add_argument("--fused-conv-bn", action="store_true",
+                    help="resnet only: Pallas 1x1-conv+BN-stats epilogue "
+                         "kernel in the bottlenecks (byte-diet A/B)")
     ap.add_argument("--segment-ids", action="store_true",
                     help="bert only: bench the packed-document shape (~3 "
                          "segment ids per window streamed into the flash "
@@ -646,7 +657,8 @@ def main(argv=None) -> int:
             "input": ("input_pipeline",)}[args.model]
     runners = {
         "resnet50": lambda: bench_resnet(
-            args.iters, **({"batch_size": args.batch} if args.batch else {})),
+            args.iters, fused_conv_bn=args.fused_conv_bn,
+            **({"batch_size": args.batch} if args.batch else {})),
         "bert_base_mlm": lambda: bench_bert(
             args.iters,
             segment_ids=args.segment_ids,

@@ -47,12 +47,19 @@ def _norm_dtype(norm_dtype, dtype):
 
 
 class BottleneckBlock(nn.Module):
-    """1×1 → 3×3 → 1×1 bottleneck with projection shortcut when needed."""
+    """1×1 → 3×3 → 1×1 bottleneck with projection shortcut when needed.
+
+    ``fused_conv_bn=True`` routes the two stride-1 1×1 conv→BN pairs through
+    the Pallas matmul-with-stats-epilogue kernel (``ops/conv_bn.py`` —
+    VERDICT r2 next-#2's byte-diet lever: the separate whole-activation
+    BN-statistics read disappears for the block's fattest tensors).
+    """
 
     filters: int  # bottleneck width; output channels = 4 * filters
     strides: int = 1
     dtype: Any = jnp.bfloat16
     norm_dtype: Any = None  # None → follow self.dtype (see module docstring)
+    fused_conv_bn: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool) -> jax.Array:
@@ -61,18 +68,36 @@ class BottleneckBlock(nn.Module):
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
             epsilon=1e-5, dtype=_norm_dtype(self.norm_dtype, self.dtype),
         )
+
+        def conv1x1_bn(features, name, zero_gamma=False):
+            from distributeddeeplearningspark_tpu.ops.conv_bn import Conv1x1BN
+
+            return Conv1x1BN(
+                features, dtype=self.dtype, norm_dtype=self.norm_dtype,
+                scale_init=(nn.initializers.zeros if zero_gamma
+                            else nn.initializers.ones),
+                name=name)
+
         residual = x
-        y = conv(self.filters, (1, 1))(x)
-        y = nn.relu(norm()(y))
+        if self.fused_conv_bn:
+            y = conv1x1_bn(self.filters, "conv_bn_1")(x, train=train)
+            y = nn.relu(y)
+        else:
+            y = conv(self.filters, (1, 1))(x)
+            y = nn.relu(norm()(y))
         # explicit (1,1) padding = torch semantics; flax SAME pads (0,1) on
         # stride-2, which would break pretrained-weight parity (resnet_io)
         y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
                  padding=[(1, 1), (1, 1)])(y)
         y = nn.relu(norm()(y))
-        y = conv(4 * self.filters, (1, 1))(y)
         # zero-init gamma on the last BN: each block starts as identity,
         # the standard large-batch trick (Goyal et al.) — free accuracy.
-        y = norm(scale_init=nn.initializers.zeros)(y)
+        if self.fused_conv_bn:
+            y = conv1x1_bn(4 * self.filters, "conv_bn_3",
+                           zero_gamma=True)(y, train=train)
+        else:
+            y = conv(4 * self.filters, (1, 1))(y)
+            y = norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = conv(4 * self.filters, (1, 1), strides=(self.strides, self.strides),
                             name="shortcut_conv")(residual)
@@ -122,6 +147,7 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: Any = jnp.bfloat16
     norm_dtype: Any = None  # None → follow self.dtype (see module docstring)
+    fused_conv_bn: bool = False  # Pallas conv+BN-stats epilogue (bottlenecks)
 
     @nn.compact
     def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
@@ -133,6 +159,14 @@ class ResNet(nn.Module):
                          dtype=ndtype, name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        is_bottleneck = (isinstance(self.block_cls, type)
+                         and issubclass(self.block_cls, BottleneckBlock))
+        if self.fused_conv_bn and not is_bottleneck:
+            raise ValueError(
+                "fused_conv_bn=True requires a BottleneckBlock block_cls "
+                f"(got {self.block_cls!r}) — BasicBlock has no 1×1 convs "
+                "to fuse")
+        kw = {"fused_conv_bn": self.fused_conv_bn} if is_bottleneck else {}
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
                 x = self.block_cls(
@@ -140,6 +174,7 @@ class ResNet(nn.Module):
                     strides=2 if stage > 0 and block == 0 else 1,
                     dtype=self.dtype,
                     norm_dtype=self.norm_dtype,
+                    **kw,
                 )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
