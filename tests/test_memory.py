@@ -1,0 +1,136 @@
+"""Analytic HBM budget (VERDICT r2 next-#3): the formula must agree with
+the real model's parameter tree exactly, and the 7B report must carry the
+v4-32 fit evidence the config-5 contract names."""
+
+import jax
+import numpy as np
+
+from distributeddeeplearningspark_tpu.models import LlamaConfig, LlamaForCausalLM
+from distributeddeeplearningspark_tpu.utils.memory import (
+    GiB,
+    llama_memory_report,
+    llama_param_count,
+)
+
+
+def _real_param_count(cfg):
+    model = LlamaForCausalLM(cfg)
+    batch = {"input_ids": np.zeros((1, 16), np.int32)}
+    variables = model.init(jax.random.PRNGKey(0), batch, train=False)
+    total = lora = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(variables["params"]):
+        n = int(np.size(leaf))
+        total += n
+        if "lora" in jax.tree_util.keystr(path):
+            lora += n
+    return {"base": total - lora, "lora": lora}
+
+
+def test_param_count_matches_model_exactly():
+    for cfg in (LlamaConfig.tiny(), LlamaConfig.tiny(lora_rank=4),
+                LlamaConfig.tiny(num_kv_heads=1, lora_rank=2,
+                                 lora_targets=("wq", "wk", "wv", "wo"))):
+        want = _real_param_count(cfg)
+        got = llama_param_count(cfg)
+        assert got == want, (got, want, cfg)
+
+
+def test_7b_count_is_llama2_7b():
+    counts = llama_param_count(LlamaConfig.llama2_7b())
+    # Llama-2 7B: 6.74B params (±: exact value 6738415616 + tied head extra —
+    # our head is untied, so ~+131M)
+    assert 6.6e9 < counts["base"] < 7.0e9, counts
+
+
+def test_7b_v4_32_fsdp_layout_fits():
+    """The contract layout: 7B LoRA on v4-32 (16 chips, 32 GiB HBM each),
+    FSDP=8 x data=2, b=8 global (b=4/data shard... report uses global)."""
+    cfg = LlamaConfig.llama2_7b(lora_rank=16, fused_head_loss=True,
+                                remat_policy=None)
+    rep = llama_memory_report(
+        cfg, batch=8, seq=4096, mesh_shape={"data": 2, "fsdp": 8},
+        hbm_per_chip_gib=32)
+    d = rep.to_dict()
+    assert rep.fits(32 * GiB), d
+    # sanity: base params dominate and shard 8x
+    assert 1.5 < d["per_chip_gib"]["base_params_bf16"] < 2.0, d
+
+
+def test_7b_single_chip_borderline_documented():
+    """Single dev chip (v5e, 16 GiB): bf16 base alone is ~13.5 GiB — the
+    report must show b=1 s=1024 with remat None + fused CE as borderline,
+    NOT comfortably fitting (that's why the real attempt is evidence either
+    way)."""
+    cfg = LlamaConfig.llama2_7b(lora_rank=16, fused_head_loss=True,
+                                remat_policy=None)
+    rep = llama_memory_report(cfg, batch=1, seq=1024, mesh_shape={},
+                              hbm_per_chip_gib=16)
+    total = rep.total_bytes / GiB
+    assert 12.5 < total < 18.0, rep.to_dict()
+
+
+def test_report_scales_with_knobs():
+    cfg = LlamaConfig.llama2_7b(lora_rank=16)
+    base = llama_memory_report(cfg, batch=4, seq=2048, mesh_shape={})
+    fsdp = llama_memory_report(cfg, batch=4, seq=2048,
+                               mesh_shape={"fsdp": 8})
+    assert (fsdp.components["base_params_bf16"]
+            == base.components["base_params_bf16"] / 8)
+    dots = llama_memory_report(
+        LlamaConfig.llama2_7b(lora_rank=16, remat_policy="dots"),
+        batch=4, seq=2048, mesh_shape={})
+    assert dots.components["activations_bf16"] > base.components["activations_bf16"]
+    unfused = llama_memory_report(cfg, batch=4, seq=2048, mesh_shape={})
+    fused = llama_memory_report(
+        LlamaConfig.llama2_7b(lora_rank=16, fused_head_loss=True),
+        batch=4, seq=2048, mesh_shape={})
+    assert fused.components["loss_head"] < unfused.components["loss_head"] / 4
+
+
+def test_7b_fsdp_layout_lowers_abstractly(eight_devices):
+    """The REAL 7B geometry traces + SPMD-partitions on a data=1 x fsdp=8
+    mesh without materializing a single weight (jax.eval_shape init +
+    jit.lower on ShapeDtypeStructs) — the AOT half of VERDICT r2 next-#3's
+    evidence: the program exists at scale; the byte budget says it fits."""
+    import jax.numpy as jnp
+    import optax
+
+    from distributeddeeplearningspark_tpu.models import (
+        llama_rules, lora_trainable)
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+    from distributeddeeplearningspark_tpu.train import (
+        losses, optim, step as step_lib)
+
+    cfg = LlamaConfig.llama2_7b(lora_rank=16, dtype=jnp.bfloat16,
+                                max_position=1024, remat_policy=None,
+                                fused_head_loss=True)
+    model = LlamaForCausalLM(cfg)
+    mesh = MeshSpec(data=1, fsdp=8).build(eight_devices)
+    rules = llama_rules(cfg)
+    tx = optim.masked(optax.adamw(1e-4), lora_trainable)
+    batch = {"input_ids": jax.ShapeDtypeStruct((8, 1024), jnp.int32),
+             "loss_mask": jax.ShapeDtypeStruct((8, 1024), jnp.float32)}
+
+    def init_fn(rng):
+        model_rng, state_rng = jax.random.split(rng)
+        variables = dict(model.init(
+            {"params": model_rng, "dropout": model_rng},
+            {"input_ids": jnp.zeros((8, 1024), jnp.int32)}, train=False))
+        params = variables.pop("params")
+        return step_lib.TrainState.create(
+            params=params, opt_state=tx.init(params), mutable=variables,
+            rng=state_rng, embed_state={})
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    shardings = step_lib.state_shardings(abstract, mesh, rules)
+    # base kernels must actually shard over fsdp at this size
+    wq_sh = shardings.params["layers"]["attention"]["wq"]["base"]["kernel"]
+    assert "fsdp" in str(wq_sh.spec), wq_sh
+    jitted = step_lib.jit_train_step(
+        step_lib.make_train_step(model.apply, tx,
+                                 losses.causal_lm_fused,
+                                 trainable=lora_trainable),
+        mesh, shardings)
+    lowered = jitted.lower(abstract, batch)
+    text = lowered.as_text()
+    assert "stablehlo" in text.split("\n", 2)[0] or len(text) > 1000
