@@ -428,11 +428,16 @@ def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
     return rec
 
 
-def bench_dlrm(iters: int, batch_size: int = 8192) -> dict:
+def bench_dlrm(iters: int, batch_size: int = 8192,
+               scatter_ab: bool = False) -> dict:
     """DLRM examples/sec/chip (config 4 shape: 13 dense + 26 embeddings).
 
     Recommender steps are tiny-FLOP / gather-bound, so the headline here is
     examples/sec, not MFU. Reported in ``extra`` only.
+
+    ``scatter_ab``: also run the Pallas-vs-XLA row-scatter falsification
+    experiment at the bench shape (VERDICT r2 next-#9 — does a hand-rolled
+    per-row DMA scatter beat the 92 ns/row XLA floor?).
     """
     import optax
 
@@ -470,7 +475,7 @@ def bench_dlrm(iters: int, batch_size: int = 8192) -> dict:
     gbatch = put_global(batch, mesh)
     n_chips = mesh.devices.size
     step_time, times, _ = bench_steps(step, state, gbatch, iters=iters)
-    return {
+    rec = {
         "examples_per_sec_per_chip": round(batch_size / step_time / n_chips, 1),
         **_timing_fields(times, iters),
         "mfu": 0.0,  # gather-bound; MFU is not the meaningful axis here
@@ -478,6 +483,13 @@ def bench_dlrm(iters: int, batch_size: int = 8192) -> dict:
         "embedding_rows": sum(vocabs),
         "chips": n_chips,
     }
+    if scatter_ab:
+        from distributeddeeplearningspark_tpu.ops.scatter_rows import (
+            bench_scatter_ab)
+
+        rec["scatter_ab"] = bench_scatter_ab(
+            k=batch_size * 26, v=sum(vocabs), d=64, iters=max(5, iters // 2))
+    return rec
 
 
 def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
@@ -628,6 +640,9 @@ def main(argv=None) -> int:
                     help="override per-model default batch size (debug)")
     ap.add_argument("--seq", type=int, default=0,
                     help="override BERT sequence length (debug)")
+    ap.add_argument("--scatter-ab", action="store_true",
+                    help="dlrm only: Pallas-vs-XLA row-scatter experiment "
+                         "at the bench shape (VERDICT r2 next-#9)")
     ap.add_argument("--variant", default="0.9b", choices=["0.9b", "7b"],
                     help="llama only: 0.9b single-chip proxy (default) or "
                          "the real 7B geometry attempt + memory budget "
@@ -735,7 +750,8 @@ def main(argv=None) -> int:
         "input_pipeline": lambda: bench_input(
             args.iters, **({"batch_size": args.batch} if args.batch else {})),
         "dlrm": lambda: bench_dlrm(
-            args.iters, **({"batch_size": args.batch} if args.batch else {})),
+            args.iters, scatter_ab=args.scatter_ab,
+            **({"batch_size": args.batch} if args.batch else {})),
     }
     results: dict = {}
     for name in want:
