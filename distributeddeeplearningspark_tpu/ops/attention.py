@@ -57,12 +57,10 @@ def dot_product_attention(
     if impl == "ring":
         from distributeddeeplearningspark_tpu.ops.ring_attention import ring_attention
 
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "ring attention does not take segment_ids; pack per CP shard "
-                "or use impl='flash'/'xla'")
-        # GQA-native: grouped KV rides the ring at Hkv width, no repeat
-        return ring_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=scale)
+        # GQA-native: grouped KV rides the ring at Hkv width, no repeat;
+        # segment ids shard over seq and ride the ring like the mask
+        return ring_attention(q, k, v, bias=bias, mask=mask, causal=causal,
+                              scale=scale, segment_ids=segment_ids)
     k, v = _expand_gqa(q, k, v)
     if segment_ids is not None:
         seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]

@@ -190,15 +190,17 @@ def _fwd_kernel(*refs, scale: float, causal: bool, has_mask: bool,
 
 
 def _flash_fwd(q, k, v, kv_mask, *, scale, causal, group, block_q, block_k,
-               interpret, segs=None):
+               interpret, q_segs=None, kv_segs=None):
     bh, s, d = q.shape
     bhkv = k.shape[0]
     num_qb, num_kb = s // block_q, s // block_k
     grid = (bh, num_qb, num_kb)
     has_mask = kv_mask is not None
-    has_segs = segs is not None
+    has_segs = q_segs is not None
+    if has_segs != (kv_segs is not None):
+        raise ValueError("q_segs and kv_segs must be passed together")
     heads = (bh // kv_mask.shape[0] if has_mask
-             else bh // segs.shape[0] if has_segs else 0)
+             else bh // q_segs.shape[0] if has_segs else 0)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, has_mask=has_mask,
         has_segs=has_segs, num_kb=num_kb, block_q=block_q, block_k=block_k,
@@ -217,10 +219,10 @@ def _flash_fwd(q, k, v, kv_mask, *, scale, causal, group, block_q, block_k,
     if has_segs:
         in_specs.append(pl.BlockSpec((1, block_q, STAT_LANES),
                                      lambda b, i, j: (b // heads, i, 0)))
-        operands.append(_seg_stat(segs))
+        operands.append(_seg_stat(q_segs))
         in_specs.append(
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // heads, 0, j)))
-        operands.append(segs[:, None, :])
+        operands.append(kv_segs[:, None, :])
     vmem = _vmem()
     o, lse = pl.pallas_call(
         kernel,
@@ -360,15 +362,16 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, has_mask: bool,
 
 def _flash_bwd(res, g, *, scale, causal, group, block_q, block_k, interpret):
     q, k, v, kv_mask, o, lse = res[:6]
-    segs = res[6] if len(res) > 6 else None
+    q_segs = res[6] if len(res) > 6 else None
+    kv_segs = res[7] if len(res) > 7 else None
     do = g
     bh, s, d = q.shape
     bhkv = k.shape[0]
     num_qb, num_kb = s // block_q, s // block_k
     has_mask = kv_mask is not None
-    has_segs = segs is not None
+    has_segs = q_segs is not None
     heads = (bh // kv_mask.shape[0] if has_mask
-             else bh // segs.shape[0] if has_segs else 0)
+             else bh // q_segs.shape[0] if has_segs else 0)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     # row stats travel as [bh, s, STAT_LANES] (Mosaic block rule — see module
     # docstring); the replication is a cheap transient, the residual is 2-D
@@ -394,10 +397,10 @@ def _flash_bwd(res, g, *, scale, causal, group, block_q, block_k, interpret):
     if has_segs:
         in_specs_q.append(pl.BlockSpec((1, block_q, STAT_LANES),
                                        lambda b, i, j: (b // heads, i, 0)))
-        operands.append(_seg_stat(segs))
+        operands.append(_seg_stat(q_segs))
         in_specs_q.append(
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // heads, 0, j)))
-        operands.append(segs[:, None, :])
+        operands.append(kv_segs[:, None, :])
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           has_mask=has_mask, has_segs=has_segs, num_kb=num_kb,
@@ -430,15 +433,15 @@ def _flash_bwd(res, g, *, scale, causal, group, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // kvheads, 0, i)))
         operands_kv.append(mask3)
     if has_segs:
-        kvh = bhkv // segs.shape[0]
+        kvh = bhkv // kv_segs.shape[0]
         in_specs_kv.append(pl.BlockSpec(
             (1, block_q, STAT_LANES),
             lambda b, i, j: ((b * group + j // num_qb) // heads,
                              j % num_qb, 0)))
-        operands_kv.append(_seg_stat(segs))
+        operands_kv.append(_seg_stat(q_segs))
         in_specs_kv.append(
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // kvh, 0, i)))
-        operands_kv.append(segs[:, None, :])
+        operands_kv.append(kv_segs[:, None, :])
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           has_mask=has_mask, has_segs=has_segs, num_qb=num_qb,
@@ -467,28 +470,28 @@ def _flash_bwd(res, g, *, scale, causal, group, block_q, block_k, interpret):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, kv_mask, segs, scale, causal, group, block_q, block_k,
-           interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, kv_mask, q_segs, kv_segs, scale, causal, group, block_q,
+           block_k, interpret):
     o, _ = _flash_fwd(q, k, v, kv_mask, scale=scale, causal=causal,
                       group=group, block_q=block_q, block_k=block_k,
-                      interpret=interpret, segs=segs)
+                      interpret=interpret, q_segs=q_segs, kv_segs=kv_segs)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, kv_mask, segs, scale, causal, group, block_q,
-                   block_k, interpret):
+def _flash_vjp_fwd(q, k, v, kv_mask, q_segs, kv_segs, scale, causal, group,
+                   block_q, block_k, interpret):
     o, lse = _flash_fwd(q, k, v, kv_mask, scale=scale, causal=causal,
                         group=group, block_q=block_q, block_k=block_k,
-                        interpret=interpret, segs=segs)
-    return o, (q, k, v, kv_mask, o, lse, segs)
+                        interpret=interpret, q_segs=q_segs, kv_segs=kv_segs)
+    return o, (q, k, v, kv_mask, o, lse, q_segs, kv_segs)
 
 
 def _flash_vjp_bwd(scale, causal, group, block_q, block_k, interpret, res, g):
     dq, dk, dv = _flash_bwd(res, g, scale=scale, causal=causal, group=group,
                             block_q=block_q, block_k=block_k,
                             interpret=interpret)
-    return dq, dk, dv, None, None
+    return dq, dk, dv, None, None, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -592,6 +595,6 @@ def flash_attention(
         bb, ss, hh, dd = x.shape
         return x.transpose(0, 2, 1, 3).reshape(bb * hh, ss, dd)
 
-    o = _flash(flat(q), flat(k), flat(v), kv_mask, segs,
+    o = _flash(flat(q), flat(k), flat(v), kv_mask, segs, segs,
                scale, causal, group, block_q, block_k, interpret)
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

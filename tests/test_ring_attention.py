@@ -481,3 +481,112 @@ class TestKeyPaddingMask:
             n = mb[i].sum()
             np.testing.assert_allclose(g[i, :n], w[i, :n],
                                        atol=2e-5, rtol=2e-5)
+
+
+class TestSegmentIdsRing:
+    """Packed sequences under CP (VERDICT r2 #4 x #6): segment ids shard
+    over seq, q side reads locally, kv side rides the ring. Parity vs the
+    dense XLA path with the equivalent segment mask, fwd AND grads, both
+    hop implementations, 4+ seq shards."""
+
+    @staticmethod
+    def _segs(b, s, bounds):
+        out = np.zeros((b, s), np.int32)
+        for i, starts in enumerate(bounds):
+            for d_, st in enumerate(starts):
+                out[i, st:] = d_
+        return jnp.asarray(out)
+
+    @staticmethod
+    def _seg_mask(segs):
+        return segs[:, None, :, None] == segs[:, None, None, :]
+
+    @pytest.mark.parametrize("use_flash", [False, True])
+    def test_forward_matches_dense(self, use_flash, eight_devices):
+        mesh = MeshSpec(data=2, seq=4).build()
+        b, s = 4, 32
+        q, k, v = _qkv(b=b, s=s)
+        segs = self._segs(b, s, [[0, 10, 20], [0, 16], [0], [0, 5, 11, 27]])
+        want = _xla_attention(q, k, v, bias=None, mask=self._seg_mask(segs),
+                              causal=False, scale=None)
+        got = jax.jit(lambda a, b_, c: ring_attention(
+            a, b_, c, mesh=mesh, causal=False, segment_ids=segs,
+            use_flash=use_flash))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("use_flash", [False, True])
+    def test_gradients_match_dense_causal(self, use_flash, eight_devices):
+        """Causal x segments is the hard composition (flash _hop_active
+        gating + riding seg blocks) — grads on both impls."""
+        mesh = MeshSpec(data=2, seq=4).build()
+        b, s = 2, 16
+        q, k, v = _qkv(b=b, s=s, h=2, d=8, seed=41)
+        segs = self._segs(b, s, [[0, 7], [0, 3, 12]])
+
+        def loss_ring(a, b_, c):
+            o = ring_attention(a, b_, c, mesh=mesh, causal=True,
+                               segment_ids=segs, use_flash=use_flash)
+            return jnp.sum(o ** 2)
+
+        def loss_dense(a, b_, c):
+            o = _xla_attention(a, b_, c, bias=None,
+                               mask=self._seg_mask(segs), causal=True,
+                               scale=None)
+            return jnp.sum(o ** 2)
+
+        g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        g_dense = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+        for gr, gd in zip(g_ring, g_dense):
+            assert np.isfinite(np.asarray(gr)).all()
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_composes_with_padding_mask(self, eight_devices):
+        """Packed tail window: padding mask + segment ids (-1 on pads)
+        together on the ring."""
+        mesh = MeshSpec(data=2, seq=4).build()
+        b, s = 2, 32
+        q, k, v = _qkv(b=b, s=s, h=2, d=8, seed=43)
+        pad_mask = _padded_mask(b, s, [32, 24])
+        segs = np.array(self._segs(b, s, [[0, 13], [0, 9, 17]]))
+        segs[1, 24:] = -1
+        segs = jnp.asarray(segs)
+        want = _xla_attention(
+            q, k, v, bias=None,
+            mask=self._seg_mask(segs)
+            & (pad_mask != 0)[:, None, None, :],
+            causal=False, scale=None)
+        got = jax.jit(lambda a, b_, c: ring_attention(
+            a, b_, c, mesh=mesh, causal=False, mask=pad_mask,
+            segment_ids=segs))(q, k, v)
+        w, g, mb = np.asarray(want), np.asarray(got), np.asarray(pad_mask)
+        for i in range(b):
+            n = mb[i].sum()
+            np.testing.assert_allclose(g[i, :n], w[i, :n],
+                                       atol=2e-5, rtol=2e-5)
+            assert np.isfinite(g[i]).all()
+
+    def test_gqa_with_segments(self, eight_devices):
+        mesh = MeshSpec(data=1, seq=4, tensor=2).build()
+        rng = np.random.default_rng(47)
+        b, s, h, hkv, d = 2, 32, 8, 4, 16
+        q = jnp.asarray(rng.normal(0, 1, (b, s, h, d)).astype(np.float32))
+        k = jnp.asarray(rng.normal(0, 1, (b, s, hkv, d)).astype(np.float32))
+        v = jnp.asarray(rng.normal(0, 1, (b, s, hkv, d)).astype(np.float32))
+        segs = self._segs(b, s, [[0, 21], [0, 6]])
+        want = _xla_attention(q, jnp.repeat(k, 2, axis=2),
+                              jnp.repeat(v, 2, axis=2), bias=None,
+                              mask=self._seg_mask(segs), causal=True,
+                              scale=None)
+        got = jax.jit(lambda a, b_, c: ring_attention(
+            a, b_, c, mesh=mesh, causal=True, segment_ids=segs))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_bad_shape_rejected(self, eight_devices):
+        mesh = MeshSpec(data=2, seq=4).build()
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="segment_ids"):
+            ring_attention(q, k, v, mesh=mesh,
+                           segment_ids=jnp.zeros((4, 16), jnp.int32))
