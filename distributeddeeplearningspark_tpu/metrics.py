@@ -74,6 +74,104 @@ def compiled_flops_per_step(compiled) -> float | None:
         return None
 
 
+class StreamingAUC:
+    """Histogram-binned ROC AUC over a prediction stream (config 4's metric).
+
+    CTR accuracy is degenerate at Criteo's ~3% positive rate (predicting
+    "no click" scores 97%); ranking quality — AUC — is the metric the
+    reference's recommender workload is actually judged by. Exact AUC needs
+    a global sort, which neither streams nor shards; the standard
+    large-scale estimator bins scores into a fixed histogram per class and
+    trapezoid-integrates the binned ROC — error is O(1/bins), and 4096 bins
+    puts it far below run-to-run training noise.
+
+    Feed sigmoid probabilities (or any monotone score mapped to [0, 1])
+    batch by batch from ``Trainer.predict``; ``compute()`` at the end.
+    """
+
+    def __init__(self, num_bins: int = 4096):
+        import numpy as np
+
+        self.num_bins = num_bins
+        self._pos = np.zeros(num_bins, np.int64)
+        self._neg = np.zeros(num_bins, np.int64)
+
+    def update(self, scores, labels) -> None:
+        import numpy as np
+
+        s = np.clip(np.asarray(scores, np.float64).reshape(-1), 0.0, 1.0)
+        y = np.asarray(labels).reshape(-1)
+        if s.shape != y.shape:
+            raise ValueError(f"scores {s.shape} vs labels {y.shape}")
+        bins = np.minimum((s * self.num_bins).astype(np.int64),
+                          self.num_bins - 1)
+        self._pos += np.bincount(bins[y > 0], minlength=self.num_bins)
+        self._neg += np.bincount(bins[y <= 0], minlength=self.num_bins)
+
+    def compute(self) -> float:
+        """AUC = P(score⁺ > score⁻) + ½·P(tie), from the class histograms."""
+        import numpy as np
+
+        npos, nneg = self._pos.sum(), self._neg.sum()
+        if npos == 0 or nneg == 0:
+            return float("nan")  # undefined without both classes
+        # for each positive bin b: negatives strictly below + half of ties
+        neg_below = np.concatenate(([0], np.cumsum(self._neg)[:-1]))
+        wins = float((self._pos * neg_below).sum())
+        ties = 0.5 * float((self._pos * self._neg).sum())
+        return (wins + ties) / (float(npos) * float(nneg))
+
+
+def auc_from_predictions(
+    predictions,
+    *,
+    num_bins: int = 4096,
+    label_key: str = "label",
+    max_examples: int | None = None,
+    chunk: int = 8192,
+) -> float:
+    """AUC over a prediction stream, buffered into chunked updates.
+
+    Accepts the two stream shapes that occur in practice:
+
+    - ``Trainer.predict(..., with_inputs=True)`` pairs: ``(example_dict,
+      score)`` — the label is read from ``example_dict[label_key]``;
+    - plain ``(score, label)`` pairs.
+
+    Rows are buffered and fed to :meth:`StreamingAUC.update` in ``chunk``
+    batches (per-row updates would pay two ``num_bins``-length histogram
+    adds per example). ``max_examples`` stops consuming the stream early —
+    essential when the source is a full Criteo day file.
+    """
+    import itertools
+
+    import numpy as np
+
+    auc = StreamingAUC(num_bins)
+    scores: list = []
+    labels: list = []
+
+    def flush():
+        if scores:
+            auc.update(np.concatenate(scores), np.concatenate(labels))
+            scores.clear()
+            labels.clear()
+
+    stream = (predictions if max_examples is None
+              else itertools.islice(predictions, max_examples))
+    for a, b_ in stream:
+        if isinstance(a, dict):
+            score, label = b_, a[label_key]
+        else:
+            score, label = a, b_
+        scores.append(np.asarray(score, np.float64).reshape(-1))
+        labels.append(np.asarray(label).reshape(-1))
+        if len(scores) >= chunk:
+            flush()
+    flush()
+    return auc.compute()
+
+
 class Meter:
     """Per-step wall-clock + throughput + MFU accounting.
 

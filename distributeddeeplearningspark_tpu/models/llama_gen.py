@@ -33,14 +33,31 @@ from distributeddeeplearningspark_tpu.models.llama import LlamaConfig, LlamaForC
 
 
 def _sample(logits: jax.Array, key: jax.Array, *, temperature: float,
-            top_k: int) -> jax.Array:
-    """[B, V] f32 logits → [B] int32 token ids."""
+            top_k: int, top_p: float = 1.0) -> jax.Array:
+    """[B, V] f32 logits → [B] int32 token ids.
+
+    ``top_k`` and ``top_p`` (nucleus) compose: k-truncation first, then the
+    smallest prefix of the remaining sorted probabilities whose mass
+    reaches ``top_p`` (the first token always survives, so sampling is
+    never empty). Everything is sort/cumsum/where — static shapes, scans
+    cleanly under jit.
+    """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / jnp.float32(temperature)
     if top_k:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]          # desc
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep sorted position j while the mass BEFORE it is < top_p
+        # (position 0 always kept); threshold = smallest kept logit
+        keep = (cum - probs) < top_p
+        kept_min = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < kept_min, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
@@ -54,7 +71,7 @@ def decode_model(cfg: LlamaConfig, max_cache_len: int) -> LlamaForCausalLM:
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "temperature", "top_k",
-                     "eos_id", "pad_id", "max_cache_len"),
+                     "top_p", "eos_id", "pad_id", "max_cache_len"),
 )
 def generate(
     params: Any,
@@ -64,6 +81,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     seed: int = 0,
     eos_id: int | None = None,
     pad_id: int = 0,
@@ -94,7 +112,7 @@ def generate(
     key = jax.random.PRNGKey(seed)
     key, sub = jax.random.split(key)
     tok = _sample(logits[:, -1].astype(jnp.float32), sub,
-                  temperature=temperature, top_k=top_k)
+                  temperature=temperature, top_k=top_k, top_p=top_p)
     done = jnp.zeros((b,), bool)
     if eos_id is not None:
         done = tok == eos_id
@@ -106,7 +124,7 @@ def generate(
             {"input_ids": tok[:, None]}, train=False, mutable=["cache"])
         key, sub = jax.random.split(key)
         nxt = _sample(logits[:, -1].astype(jnp.float32), sub,
-                      temperature=temperature, top_k=top_k)
+                      temperature=temperature, top_k=top_k, top_p=top_p)
         if eos_id is not None:
             nxt = jnp.where(done, jnp.int32(pad_id), nxt)
             done = done | (nxt == eos_id)

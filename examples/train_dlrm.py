@@ -42,6 +42,13 @@ def main() -> None:
                    help="engineer features through the DataFrame plane "
                         "(spark.read.csv -> fillna/log1p/hash_bucket), the "
                         "reference's Spark-SQL route, instead of criteo_tsv")
+    p.add_argument("--eval-data", default=None,
+                   help="held-out Criteo TSV (file or dir): after training, "
+                        "stream predictions and report ROC AUC — the metric "
+                        "config 4 is judged by (accuracy is degenerate at "
+                        "CTR base rates)")
+    p.add_argument("--eval-examples", type=int, default=100_000,
+                   help="cap on eval rows (synthetic eval uses this size)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -54,38 +61,47 @@ def main() -> None:
     print(spark)
 
     vocabs = (args.vocab_size,) * args.num_sparse
-    if args.data_dir and args.sql_features:
-        import os
 
-        import numpy as np
+    def load_criteo(path):
+        """One loader for train AND eval — the categorical bucketing must be
+        identical between them (hash_bucket under --sql-features vs
+        criteo_tsv's hex-mod) or eval features index unrelated embedding
+        rows and the AUC silently degenerates to 0.5."""
+        if args.sql_features:
+            import os
 
-        from distributeddeeplearningspark_tpu.data.dataframe import col, hash_bucket
+            import numpy as np
 
-        dense = [f"I{i + 1}" for i in range(13)]
-        cats = [f"C{i + 1}" for i in range(args.num_sparse)]
-        path = (os.path.join(args.data_dir, "day_*")
-                if os.path.isdir(args.data_dir) else args.data_dir)
-        df = (spark.read.option("sep", "\t")
-              .schema(["label"] + dense + cats,
-                      {"label": np.int32, **{c: np.str_ for c in cats}})
-              .csv(path))
-        # dense: fill missing only — DLRM/WideAndDeep apply the Criteo
-        # log1p(max(x, 0)) transform inside the model (models/dlrm.py)
-        df = df.withColumns({c: col(c).fillna(0.0) for c in dense})
-        df = df.withColumns(
-            {c: hash_bucket(col(c), vocabs[i]) for i, c in enumerate(cats)})
-        ds = df.to_dataset(
-            vector_columns={"dense": dense, "sparse": cats}).repeat()
-    elif args.data_dir:
+            from distributeddeeplearningspark_tpu.data.dataframe import (
+                col, hash_bucket)
+
+            dense = [f"I{i + 1}" for i in range(13)]
+            cats = [f"C{i + 1}" for i in range(args.num_sparse)]
+            glob_path = (os.path.join(path, "day_*")
+                         if os.path.isdir(path) else path)
+            df = (spark.read.option("sep", "\t")
+                  .schema(["label"] + dense + cats,
+                          {"label": np.int32, **{c: np.str_ for c in cats}})
+                  .csv(glob_path))
+            # dense: fill missing only — DLRM/WideAndDeep apply the Criteo
+            # log1p(max(x, 0)) transform inside the model (models/dlrm.py)
+            df = df.withColumns({c: col(c).fillna(0.0) for c in dense})
+            df = df.withColumns(
+                {c: hash_bucket(col(c), vocabs[i]) for i, c in enumerate(cats)})
+            return df.to_dataset(vector_columns={"dense": dense, "sparse": cats})
         from distributeddeeplearningspark_tpu.data.sources import criteo_tsv
 
-        ds = criteo_tsv(
-            args.data_dir, vocab_sizes=vocabs,
-            num_partitions=max(spark.default_parallelism, 1),
-        ).repeat()
+        return criteo_tsv(path, vocab_sizes=vocabs,
+                          num_partitions=max(spark.default_parallelism, 1))
+
+    if args.data_dir:
+        ds = load_criteo(args.data_dir).repeat()
     else:
+        # pool ≫ steps×batch so the model must learn the id/dense signal
+        # rather than memorize a small repeated set — the eval AUC below
+        # exposed exactly that failure mode at ×64 (train acc 1.0, AUC 0.50)
         ds = synthetic_criteo(
-            args.batch_size * 64, vocab_sizes=vocabs,
+            args.batch_size * 1024, vocab_sizes=vocabs,
             num_partitions=max(spark.default_parallelism, 1),
         ).repeat()
 
@@ -107,6 +123,29 @@ def main() -> None:
         ds, batch_size=args.batch_size, steps=args.steps, log_every=25
     )
     print(f"train summary: {summary}")
+
+    if args.eval_data or not args.data_dir:
+        import jax
+        import jax.numpy as jnp
+
+        from distributeddeeplearningspark_tpu.metrics import auc_from_predictions
+
+        if args.eval_data:
+            eval_ds = load_criteo(args.eval_data)  # same bucketing as train
+        else:
+            # held-out synthetic draw (different seed → disjoint rows from
+            # the same click distribution)
+            eval_ds = synthetic_criteo(
+                args.eval_examples, vocab_sizes=vocabs,
+                num_partitions=max(spark.default_parallelism, 1), seed=777)
+        stream = trainer.predict(
+            eval_ds, batch_size=args.batch_size,
+            # model emits [B] logits; sigmoid → click probability
+            output_fn=lambda logits: jax.nn.sigmoid(
+                logits.astype(jnp.float32)),
+            with_inputs=True)
+        auc = auc_from_predictions(stream, max_examples=args.eval_examples)
+        print(f"eval AUC: {auc:.4f}")
     spark.stop()
 
 

@@ -1,0 +1,126 @@
+"""StreamingAUC (config 4's real metric) and nucleus sampling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu.metrics import StreamingAUC, auc_from_predictions
+
+
+class TestStreamingAUC:
+    def test_matches_exact_auc(self):
+        """Binned estimator vs the exact rank statistic on random scores."""
+        rng = np.random.default_rng(0)
+        n = 20_000
+        labels = (rng.random(n) < 0.25).astype(np.int32)
+        # informative but noisy scores
+        scores = np.clip(0.35 * labels + rng.normal(0.3, 0.2, n), 0, 1)
+
+        def exact_auc(s, y):
+            order = np.argsort(s, kind="stable")
+            ranks = np.empty(n, np.float64)
+            # average ranks for ties
+            s_sorted = s[order]
+            r = np.arange(1, n + 1, dtype=np.float64)
+            i = 0
+            while i < n:
+                j = i
+                while j + 1 < n and s_sorted[j + 1] == s_sorted[i]:
+                    j += 1
+                r[i:j + 1] = (i + 1 + j + 1) / 2
+                i = j + 1
+            ranks[order] = r
+            npos = y.sum()
+            return (ranks[y > 0].sum() - npos * (npos + 1) / 2) / (
+                npos * (n - npos))
+
+        auc = StreamingAUC()
+        # stream in chunks — order must not matter
+        for lo in range(0, n, 1111):
+            auc.update(scores[lo:lo + 1111], labels[lo:lo + 1111])
+        got, want = auc.compute(), exact_auc(scores, labels)
+        assert abs(got - want) < 2e-3, (got, want)
+
+    def test_perfect_and_random_and_inverted(self):
+        y = np.array([0, 0, 1, 1])
+        perfect = StreamingAUC(); perfect.update([0.1, 0.2, 0.8, 0.9], y)
+        assert perfect.compute() == 1.0
+        inverted = StreamingAUC(); inverted.update([0.9, 0.8, 0.2, 0.1], y)
+        assert inverted.compute() == 0.0
+        ties = StreamingAUC(); ties.update([0.5, 0.5, 0.5, 0.5], y)
+        assert ties.compute() == 0.5
+
+    def test_single_class_nan(self):
+        auc = StreamingAUC()
+        auc.update([0.5, 0.6], [1, 1])
+        assert np.isnan(auc.compute())
+
+    def test_from_predictions_stream(self):
+        preds = [([0.9], [1]), ([0.1], [0]), ([0.8], [1]), ([0.3], [0])]
+        assert auc_from_predictions(iter(preds)) == 1.0
+
+    def test_shape_mismatch_rejected(self):
+        auc = StreamingAUC()
+        with pytest.raises(ValueError, match="scores"):
+            auc.update([0.5, 0.6], [1])
+
+
+class TestTopPSampling:
+    def test_nucleus_truncates_tail(self):
+        from distributeddeeplearningspark_tpu.models.llama_gen import _sample
+
+        # one dominant token (p≈0.73), a mid token, and a long tail
+        logits = jnp.asarray(np.array(
+            [[5.0, 3.0, 0.0, -1.0, -1.0, -1.0]], np.float32))
+        keys = jax.random.split(jax.random.PRNGKey(0), 256)
+        toks = np.array([
+            int(_sample(logits, k, temperature=1.0, top_k=0, top_p=0.5)[0])
+            for k in keys])
+        # top_p=0.5: only the argmax survives (its mass alone ≥ 0.5 … the
+        # first sorted token is always kept and the second's prefix mass
+        # 0.73 ≥ 0.5 cuts it)
+        assert set(toks) == {0}
+
+    def test_top_p_one_is_plain_sampling(self):
+        from distributeddeeplearningspark_tpu.models.llama_gen import _sample
+
+        logits = jnp.asarray(np.zeros((1, 4), np.float32))
+        keys = jax.random.split(jax.random.PRNGKey(1), 128)
+        toks = {int(_sample(logits, k, temperature=1.0, top_k=0, top_p=1.0)[0])
+                for k in keys}
+        assert toks == {0, 1, 2, 3}  # uniform logits: everything reachable
+
+    def test_composes_with_top_k(self):
+        from distributeddeeplearningspark_tpu.models.llama_gen import _sample
+
+        logits = jnp.asarray(np.array([[4.0, 3.0, 2.0, 1.0]], np.float32))
+        keys = jax.random.split(jax.random.PRNGKey(2), 128)
+        toks = {int(_sample(logits, k, temperature=1.0, top_k=3, top_p=0.95)[0])
+                for k in keys}
+        assert 3 not in toks  # k-truncated
+        assert 0 in toks
+
+
+def test_from_predictions_with_inputs_shape():
+    """The Trainer.predict(with_inputs=True) pair shape: (example, score)."""
+    stream = iter([
+        ({"label": np.int32(1), "dense": np.zeros(3)}, np.float32(0.9)),
+        ({"label": np.int32(0), "dense": np.zeros(3)}, np.float32(0.2)),
+        ({"label": np.int32(1), "dense": np.zeros(3)}, np.float32(0.7)),
+        ({"label": np.int32(0), "dense": np.zeros(3)}, np.float32(0.4)),
+    ])
+    assert auc_from_predictions(stream) == 1.0
+
+
+def test_from_predictions_max_examples_stops_stream():
+    pulled = []
+
+    def gen():
+        for i in range(1000):
+            pulled.append(i)
+            yield (np.float64(i % 2), np.int64(i % 2))
+
+    auc = auc_from_predictions(gen(), max_examples=10)
+    assert len(pulled) == 10
+    assert auc == 1.0
