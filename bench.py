@@ -564,6 +564,24 @@ def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
             rec_seen += len(b["label"])
         rec_dt = time.perf_counter() - t0
         rec_rate = rec_seen / rec_dt
+
+        # batched-fused feed: ONE native varbatch augment call per batch,
+        # written straight into the batch buffer (no per-example calls, no
+        # np.stack pass — BASELINE.md r3 profile: those were 62% of the
+        # record path's host time)
+        from distributeddeeplearningspark_tpu.data.vision import (
+            imagenet_train_batched)
+
+        fused_feed = imagenet_train_batched(
+            array_records(rec_dir).shuffle(0).repeat(), batch_size, seed=0)
+        next(fused_feed)
+        t0 = time.perf_counter()
+        fused_seen = 0
+        for _ in range(max(2, iters // 4)):
+            b = next(fused_feed)
+            fused_seen += len(b["label"])
+        fused_dt = time.perf_counter() - t0
+        fused_rate = fused_seen / fused_dt
         rec_tmp.cleanup()
     return {
         # keep this key's historical meaning (JPEG-decode path) so the series
@@ -571,7 +589,9 @@ def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
         "host_images_per_sec": round(jpeg_rate, 1),
         "jpeg_path_images_per_sec": round(jpeg_rate, 1),
         "record_path_images_per_sec": round(rec_rate, 1),
+        "record_batched_images_per_sec": round(fused_rate, 1),
         "record_vs_jpeg_speedup": round(rec_rate / jpeg_rate, 2),
+        "batched_vs_jpeg_speedup": round(fused_rate / jpeg_rate, 2),
         "materialize_images_per_sec": round(n_images / mat_dt, 1),
         "native_kernels": native.available(),
         "image_px": size,

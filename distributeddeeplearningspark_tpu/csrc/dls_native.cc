@@ -221,6 +221,72 @@ void dls_rrc_flip_normalize(const uint8_t* in, int h, int w, int c,
   });
 }
 
+// Batched fused random-resized-crop over VARIABLE-SIZE images (the record
+// input path: shorter-side-resized uint8 frames of differing aspect).
+// One call augments a whole batch — per-image crop regions/flips sampled by
+// the caller (content-seeded rng stays in Python), pixels move here:
+// crop → bilinear resize → flip → normalize, PARALLEL OVER IMAGES (column
+// taps computed once per image; training batches ≥ core count keep every
+// core busy — sub-core-count batches underfill, an accepted trade for the
+// tap reuse). No GIL churn, no per-image ctypes overhead, and output is
+// written directly into the caller's [N, OH, OW, C] batch buffer — the
+// batch never passes through a separate np.stack copy.
+void dls_rrc_flip_normalize_varbatch(
+    const void* const* imgs, const int32_t* hs, const int32_t* ws, int c,
+    const int32_t* ys, const int32_t* xs, const int32_t* chs,
+    const int32_t* cws, const uint8_t* flips, int64_t n, int oh, int ow,
+    const float* mean, const float* std, float* out) {
+  const int64_t out_stride = static_cast<int64_t>(oh) * ow * c;
+  std::vector<float> inv_std(c), bias(c);
+  for (int k = 0; k < c; ++k) {
+    inv_std[k] = (1.0f / 255.0f) / std[k];
+    bias[k] = mean[k] * 255.0f;
+  }
+  // Parallel over IMAGES (a 256-image batch keeps ≤16 threads saturated);
+  // column taps are computed once per image, not per row.
+  parallel_for(n, [&](int64_t i) {
+    const uint8_t* in = static_cast<const uint8_t*>(imgs[i]);
+    const int w = ws[i], ch = chs[i], cw = cws[i];
+    const int y0 = ys[i], x0 = xs[i];
+    const int flip = flips[i];
+    float* obase = out + i * out_stride;
+    std::vector<int> tx0(ow), tx1(ow);
+    std::vector<float> wxs(ow);
+    for (int x = 0; x < ow; ++x) {
+      double srcx = (static_cast<double>(x) + 0.5) * cw / ow - 0.5;
+      int cx0 = std::clamp(static_cast<int>(std::floor(srcx)), 0, cw - 1);
+      tx0[x] = (x0 + cx0) * c;
+      tx1[x] = (x0 + std::min(cx0 + 1, cw - 1)) * c;
+      wxs[x] = static_cast<float>(
+          std::clamp(srcx - static_cast<double>(cx0), 0.0, 1.0));
+    }
+    for (int y = 0; y < oh; ++y) {
+      double srcy = (static_cast<double>(y) + 0.5) * ch / oh - 0.5;
+      int cy0 = std::clamp(static_cast<int>(std::floor(srcy)), 0, ch - 1);
+      int cy1 = std::min(cy0 + 1, ch - 1);
+      float wy = static_cast<float>(
+          std::clamp(srcy - static_cast<double>(cy0), 0.0, 1.0));
+      const uint8_t* top = in + (static_cast<int64_t>(y0 + cy0) * w) * c;
+      const uint8_t* bot = in + (static_cast<int64_t>(y0 + cy1) * w) * c;
+      float* orow = obase + static_cast<int64_t>(y) * ow * c;
+      for (int x = 0; x < ow; ++x) {
+        const float wx = wxs[x];
+        const uint8_t* tl = top + tx0[x];
+        const uint8_t* tr = top + tx1[x];
+        const uint8_t* bl = bot + tx0[x];
+        const uint8_t* br = bot + tx1[x];
+        const int xo = flip ? (ow - 1 - x) : x;
+        for (int k = 0; k < c; ++k) {
+          float t = tl[k] * (1.0f - wx) + tr[k] * wx;
+          float b = bl[k] * (1.0f - wx) + br[k] * wx;
+          orow[xo * c + k] =
+              (t * (1.0f - wy) + b * wy - bias[k]) * inv_std[k];
+        }
+      }
+    }
+  });
+}
+
 // dst += src elementwise — the host gradient-aggregation primitive behind the
 // PR1 treeAggregate parity path (SURVEY.md §3.1). Parallel over chunks.
 void dls_sum_into_f32(float* dst, const float* src, int64_t n) {

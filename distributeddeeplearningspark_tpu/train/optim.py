@@ -35,6 +35,22 @@ def lamb(learning_rate: float | optax.Schedule, *, b1: float = 0.9, b2: float = 
     return optax.lamb(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
 
 
+def adafactor(learning_rate: float | optax.Schedule, *,
+              weight_decay: float = 0.0,
+              min_dim_size_to_factor: int = 128) -> optax.GradientTransformation:
+    """Adafactor (Shazeer & Stern, arXiv:1804.04235) — the TPU-era
+    memory-frugal optimizer: second moments factor into row/column running
+    means for matrices ≥ ``min_dim_size_to_factor``, so optimizer state is
+    O(rows+cols) instead of O(rows·cols). At 7B full-parameter scale that
+    is the difference between AdamW's ~54 GB of f32 moments and ~a few
+    hundred MB — the standard choice when config 5 moves past LoRA to full
+    fine-tuning on pod slices."""
+    tx = optax.adafactor(
+        learning_rate, min_dim_size_to_factor=min_dim_size_to_factor,
+        weight_decay_rate=weight_decay or None)
+    return tx
+
+
 def warmup_linear(peak_lr: float, warmup_steps: int, total_steps: int,
                   end_lr: float = 0.0) -> optax.Schedule:
     """BERT-style linear warmup then linear decay."""

@@ -96,6 +96,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, _f32p, _f32p, _f32p,
     ]
+    lib.dls_rrc_flip_normalize_varbatch.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), _i32p, _i32p, ctypes.c_int,
+        _i32p, _i32p, _i32p, _i32p, _u8p, ctypes.c_int64,
+        ctypes.c_int, ctypes.c_int, _f32p, _f32p, _f32p,
+    ]
     lib.dls_sum_into_f32.argtypes = [_f32p, _f32p, ctypes.c_int64]
     lib.dls_jpeg_info.restype = ctypes.c_int
     lib.dls_jpeg_info.argtypes = [
@@ -242,6 +247,71 @@ def rrc_flip_normalize(
     out = np.empty((oh, ow, c), np.float32)
     lib.dls_rrc_flip_normalize(image, h, w, c, y0, x0, ch, cw, int(flip),
                                oh, ow, mean, std, out)
+    return out
+
+
+def rrc_flip_normalize_varbatch(
+    images: list[np.ndarray],          # N × [Hi, Wi, C] uint8 (varying size)
+    regions: np.ndarray,               # [N, 4] int32 (y0, x0, ch, cw)
+    flips: np.ndarray,                 # [N] uint8
+    size: tuple[int, int],
+    mean: np.ndarray,
+    std: np.ndarray,
+    out: np.ndarray | None = None,     # [N, OH, OW, C] f32 (written in place)
+) -> np.ndarray | None:
+    """Whole-batch fused augmentation over variable-size images in ONE
+    native call (parallel over images × row groups) writing directly into
+    the batch buffer — no per-image ctypes overhead, no np.stack pass.
+    Returns None when the native library is unavailable (callers fall back
+    to the per-example path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(images)
+    c = images[0].shape[2]
+    oh, ow = size
+    regions = np.ascontiguousarray(regions, np.int32)
+    if regions.shape != (n, 4):
+        raise ValueError(f"regions must be [{n}, 4], got {regions.shape}")
+    hs = np.empty(n, np.int32)
+    ws = np.empty(n, np.int32)
+    ptrs = (ctypes.c_void_p * n)()
+    contig = []  # keep alive for the duration of the call
+    for i, img in enumerate(images):
+        img = np.ascontiguousarray(img, np.uint8)
+        if img.ndim != 3 or img.shape[2] != c:
+            raise ValueError(f"image {i}: want [H, W, {c}] u8, got {img.shape}")
+        h, w = img.shape[:2]
+        y0, x0, ch, cw = regions[i]
+        if not (0 <= y0 and 0 <= x0 and ch > 0 and cw > 0
+                and y0 + ch <= h and x0 + cw <= w):
+            raise ValueError(
+                f"image {i}: crop region {tuple(regions[i])} out of bounds "
+                f"for {(h, w)}")
+        hs[i], ws[i] = h, w
+        contig.append(img)
+        ptrs[i] = img.ctypes.data_as(ctypes.c_void_p)
+    # fail loudly BEFORE dispatch — the C++ kernel reads raw offsets, so a
+    # short flips/mean/std array would be an out-of-bounds heap read there
+    flips = np.ascontiguousarray(flips, np.uint8)
+    if len(flips) != n:
+        raise ValueError(f"flips must have length {n}, got {len(flips)}")
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    if len(mean) != c or len(std) != c:
+        raise ValueError(
+            f"mean/std must have length {c}, got {len(mean)}/{len(std)}")
+    if out is None:
+        out = np.empty((n, oh, ow, c), np.float32)
+    elif out.shape != (n, oh, ow, c) or out.dtype != np.float32 \
+            or not out.flags.c_contiguous:
+        raise ValueError(f"out must be C-contiguous [{n}, {oh}, {ow}, {c}] f32")
+    lib.dls_rrc_flip_normalize_varbatch(
+        ptrs, hs, ws, c,
+        np.ascontiguousarray(regions[:, 0]), np.ascontiguousarray(regions[:, 1]),
+        np.ascontiguousarray(regions[:, 2]), np.ascontiguousarray(regions[:, 3]),
+        flips, n, oh, ow, mean, std, out)
+    del contig
     return out
 
 

@@ -136,3 +136,27 @@ def test_lamb_optimizer_steps():
         updates, state = tx.update(grads, state, params)
         params = __import__("optax").apply_updates(params, updates)
     assert float(jnp.abs(params["w"] - 1.0).max()) > 0
+
+
+def test_adafactor_factors_second_moments():
+    """Adafactor (the TPU memory-frugal optimizer): params move AND the
+    second-moment state for a factorable matrix is O(rows+cols), not
+    O(rows*cols) — the property it exists for."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributeddeeplearningspark_tpu.train import optim
+
+    tx = optim.adafactor(1e-2, min_dim_size_to_factor=8)
+    params = {"w": jnp.ones((128, 256)), "b": jnp.zeros((4,))}
+    state = tx.init(params)
+    # no state leaf may be as large as the factored matrix itself
+    big = [int(np.size(l)) for l in jax.tree_util.tree_leaves(state)
+           if int(np.size(l)) >= 128 * 256]
+    assert not big, f"unfactored second moments found: {big}"
+    grads = jax.tree.map(jnp.ones_like, params)
+    for _ in range(3):
+        updates, state = tx.update(grads, state, params)
+        params = __import__("optax").apply_updates(params, updates)
+    assert float(jnp.abs(params["w"] - 1.0).max()) > 0
