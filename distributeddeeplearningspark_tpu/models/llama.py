@@ -75,6 +75,15 @@ class LlamaConfig:
     # ``losses.causal_lm_fused``. Ignored in decode mode (generation needs
     # real logits).
     fused_head_loss: bool = False
+    # Mixture-of-Experts FFN (models/moe.py; 0 = dense SwiGLU). When >0
+    # every layer's MLP becomes a top-k-routed expert bank whose stacked
+    # kernels shard over the `expert` mesh axis; the model returns
+    # {"logits", "moe_aux"} in training so the load-balance loss reaches
+    # the optimizer (losses.causal_lm/_fused add it).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     # LoRA (rank 0 = disabled → plain full-parameter model)
     lora_rank: int = 0
     lora_alpha: float = 16.0
@@ -270,7 +279,8 @@ class LlamaMLP(nn.Module):
 
 
 class DecoderLayer(nn.Module):
-    """Pre-norm block; returns (x, None) — the (carry, out) pair nn.scan wants."""
+    """Pre-norm block; returns (x, aux) — the (carry, out) pair nn.scan
+    wants; ``aux`` is the layer's MoE load-balance loss (0 when dense)."""
 
     cfg: LlamaConfig
 
@@ -280,8 +290,17 @@ class DecoderLayer(nn.Module):
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="attention_norm")(x)
         x = x + LlamaAttention(cfg, name="attention")(h, mask)
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="mlp_norm")(x)
-        x = x + LlamaMLP(cfg, name="mlp")(h)
-        return x, None
+        if cfg.moe_experts:
+            from distributeddeeplearningspark_tpu.models.moe import MoEMLP
+
+            y, aux = MoEMLP(
+                cfg.hidden_size, cfg.intermediate_size, cfg.moe_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype, name="moe")(h)
+        else:
+            y, aux = LlamaMLP(cfg, name="mlp")(h), jnp.float32(0.0)
+        return x + y, aux
 
 
 class _LMHead(nn.Module):
@@ -309,7 +328,9 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, batch: dict[str, jax.Array], *, train: bool = False) -> jax.Array:
-        del train  # no dropout in Llama-2; kept for the uniform model API
+        # no dropout in Llama-2; `train` only gates whether the MoE aux
+        # loss is returned (predict/eval consumers expect a plain logits
+        # array — see the returns below)
         cfg = self.cfg
         ids = batch["input_ids"]
         if ids.shape[1] > cfg.max_position:
@@ -337,18 +358,32 @@ class LlamaForCausalLM(nn.Module):
                 in_axes=nn.broadcast,           # mask is shared, not scanned
                 length=cfg.num_layers,
             )(cfg, name="layers")
-            x, _ = stacked(x, mask)
+            x, aux = stacked(x, mask)
+            moe_aux = jnp.sum(aux) if cfg.moe_experts else None
         else:
+            auxes = []
             for i in range(cfg.num_layers):
-                x, _ = layer_cls(cfg, name=f"layers_{i}")(x, mask)
+                x, aux = layer_cls(cfg, name=f"layers_{i}")(x, mask)
+                auxes.append(aux)
+            moe_aux = (jnp.sum(jnp.stack(auxes))
+                       if cfg.moe_experts else None)
 
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)
         head = _LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")
         if cfg.fused_head_loss and not cfg.decode:
             # hand the pieces to losses.causal_lm_fused; the [B,S,V] f32
             # logits (and their cotangent) never exist
-            return {"hidden": x, "lm_head": head(x, return_kernel=True)}
-        return head(x).astype(jnp.float32)
+            out = {"hidden": x, "lm_head": head(x, return_kernel=True)}
+            if moe_aux is not None and train:
+                out["moe_aux"] = cfg.moe_aux_weight * moe_aux
+            return out
+        logits = head(x).astype(jnp.float32)
+        if moe_aux is not None and train and not cfg.decode:
+            # train only: predict/eval consumers (Trainer.predict row
+            # indexing, argmax output_fns) expect a bare logits array
+            return {"logits": logits,
+                    "moe_aux": cfg.moe_aux_weight * moe_aux}
+        return logits
 
 
 def llama2_7b(**kw) -> LlamaForCausalLM:
@@ -399,6 +434,12 @@ def llama_rules(cfg: LlamaConfig, *, fsdp: bool = True,
         (r"down/base/kernel", P(*lead, "tensor", None)),
         (r"token_embed/embedding", P("tensor", None)),
         (r"lm_head/kernel", P(None, "tensor")),
+        # MoE expert bank: stacked expert kernels shard over `expert`
+        # (+ FFN dims over `tensor`); the tiny router replicates
+        *(((r"moe/(w_gate|w_up)", P(*lead, "expert", None, "tensor")),
+           (r"moe/w_down", P(*lead, "expert", "tensor", None)),
+           (r"moe/router", P(*lead) if pipeline else P()),
+           ) if cfg.moe_experts else ()),
         # PP catch-all: any remaining stacked layer param (norm scales)
         # stores on its own stage's devices. (`(^|/)` anchor: TrainState
         # paths are prefixed, e.g. "params/layers/...".)

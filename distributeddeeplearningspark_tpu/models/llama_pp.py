@@ -51,6 +51,12 @@ def make_pp_apply(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int | None = N
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires scan_layers=True "
                          "(stacked [L, ...] params are what stages reshape)")
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "MoE is not wired through pipeline parallelism: the stage "
+            "forward discards each layer's load-balance aux loss, so the "
+            "router would silently collapse (no balancing gradient) — use "
+            "the data×expert(+fsdp/tensor) layout for MoE models")
     if cfg.fused_head_loss:
         raise ValueError(
             "fused_head_loss is not supported with pipeline parallelism: "

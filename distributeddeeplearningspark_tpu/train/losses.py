@@ -98,16 +98,32 @@ def causal_lm_fused(outputs: dict[str, jax.Array], batch: dict[str, Any]
     hidden = outputs["hidden"][:, :-1]
     labels = batch["input_ids"][:, 1:]
     per_tok = chunked_softmax_xent(hidden, outputs["lm_head"], labels)
-    return _reduce_next_token(per_tok, batch)
+    loss, metrics = _reduce_next_token(per_tok, batch)
+    return _add_moe_aux(loss, metrics, outputs)
+
+
+def _add_moe_aux(loss, metrics, outputs) -> tuple[jax.Array, dict]:
+    """Fold a model-reported (already-weighted) MoE load-balance loss in."""
+    if isinstance(outputs, dict) and "moe_aux" in outputs:
+        aux = outputs["moe_aux"]
+        loss = loss + aux
+        metrics = {**metrics, "loss": loss, "moe_aux": aux}
+    return loss, metrics
 
 
 def causal_lm(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, dict]:
-    """Next-token CE (Llama-2 LoRA fine-tune); respects ``loss_mask`` if given."""
+    """Next-token CE (Llama-2 LoRA fine-tune); respects ``loss_mask`` if
+    given. MoE models return ``{"logits", "moe_aux"}`` — the (already
+    config-weighted) load-balance term is added and reported."""
+    outputs = logits
     if isinstance(logits, dict):
-        raise TypeError(
-            "model returned the fused-head dict (fused_head_loss=True) — "
-            "pair it with losses.causal_lm_fused")
+        if "logits" not in logits:
+            raise TypeError(
+                "model returned the fused-head dict (fused_head_loss=True) — "
+                "pair it with losses.causal_lm_fused")
+        logits = outputs["logits"]
     labels = batch["input_ids"][:, 1:]
     logits = logits[:, :-1]
     per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-    return _reduce_next_token(per_tok, batch)
+    loss, metrics = _reduce_next_token(per_tok, batch)
+    return _add_moe_aux(loss, metrics, outputs)
