@@ -46,7 +46,12 @@ def _pack_record(example: dict) -> bytes:
     output is deterministic for identical content."""
     parts: list[bytes] = [struct.pack("<H", len(example))]
     for key in sorted(example):
-        arr = np.ascontiguousarray(example[key])
+        # NOT ascontiguousarray: it promotes 0-d scalars to shape (1,)
+        # (ndmin=1 quirk), which would make labels round-trip as [1] arrays
+        # and batch to [B, 1] instead of [B]. tobytes() below already
+        # serializes any layout as C-order bytes, so no contiguity copy is
+        # needed either.
+        arr = np.asarray(example[key])
         kb = key.encode("utf-8")
         ds = arr.dtype.str.encode("ascii")  # e.g. b'|u1', b'<f4', b'<i4'
         parts.append(struct.pack("<H", len(kb)))

@@ -30,6 +30,19 @@ def main() -> None:
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--data-dir", default=None,
                    help="ImageNet root (class-per-subdir of JPEGs); synthetic if unset")
+    p.add_argument("--records-dir", default=None,
+                   help="preprocessed array-record dir (data/records.py): "
+                        "stream pre-decoded frames instead of paying JPEG "
+                        "decode per epoch (11x+ per host — BASELINE.md r3). "
+                        "Create once with --materialize-records")
+    p.add_argument("--materialize-records", default=None, metavar="OUT_DIR",
+                   help="one-time: decode + shorter-side-resize --data-dir "
+                        "into OUT_DIR record shards, then exit (the "
+                        "rdd.cache() analog; point --records-dir here after)")
+    p.add_argument("--record-px", type=int, default=0,
+                   help="shorter-side size baked into materialized records "
+                        "(0 = auto: max(256, image-size/0.875) so training "
+                        "crops never upscale degraded frames)")
     p.add_argument("--eval-dir", default=None,
                    help="validation root (same layout); reports top-1/top-5 "
                         "after training via the exact tail-inclusive evaluator")
@@ -49,7 +62,40 @@ def main() -> None:
     spark = Session.builder.master(args.master or "auto").appName("resnet-imagenet").getOrCreate()
     print(spark)
 
-    if args.data_dir:
+    if args.materialize_records:
+        if not args.data_dir:
+            raise SystemExit("--materialize-records needs --data-dir")
+        from distributeddeeplearningspark_tpu.data.records import (
+            write_imagenet_records)
+
+        # record resolution tracks the training crop: baking 256-side frames
+        # and then training --image-size 384 would silently upscale degraded
+        # pixels
+        record_px = args.record_px or max(
+            256, int(round(args.image_size / 0.875)))
+        paths = write_imagenet_records(
+            args.data_dir, args.materialize_records, size=record_px,
+            num_shards=max(spark.default_parallelism, 8))
+        print(f"materialized {len(paths)} record shards in "
+              f"{args.materialize_records}")
+        spark.stop()
+        return
+
+    if args.records_dir:
+        from distributeddeeplearningspark_tpu.data.records import array_records
+
+        if args.eval_dir and not args.data_dir:
+            # record labels were baked from the TRAIN dir's class mapping;
+            # letting the eval dir derive its own set would silently
+            # renumber labels (the hazard the --eval-dir pin exists for)
+            raise SystemExit(
+                "--records-dir with --eval-dir needs --data-dir too (the "
+                "original class-per-subdir root) to pin the class mapping "
+                "the records were materialized with")
+        ds = array_records(
+            args.records_dir,
+            num_partitions=max(spark.default_parallelism, 1))
+    elif args.data_dir:
         from distributeddeeplearningspark_tpu.data.sources import imagenet_folder
 
         # decode=False: JPEG decode runs inside imagenet_train's (parallel)

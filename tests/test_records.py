@@ -61,10 +61,24 @@ class TestRoundTrip:
     def test_empty_and_scalar_records(self, tmp_path):
         p = str(tmp_path / "part-00000.dlsrec")
         with RecordShardWriter(p) as w:
-            w.write({"x": np.float64(3.5), "name_Ωé": np.arange(3)})
+            w.write({"x": np.float64(3.5), "l": np.int32(7),
+                     "name_Ωé": np.arange(3)})
         (rec,) = array_records(p).collect()
         assert rec["x"] == 3.5 and rec["x"].dtype == np.float64
+        # scalars must round-trip 0-d — ascontiguousarray's ndmin=1 quirk
+        # once turned labels into [1] arrays that batched to [B, 1]
+        assert np.ndim(rec["x"]) == 0 and np.ndim(rec["l"]) == 0
+        assert rec["l"] == 7 and rec["l"].dtype == np.int32
         np.testing.assert_array_equal(rec["name_Ωé"], np.arange(3))
+
+    def test_noncontiguous_input_roundtrips(self, tmp_path):
+        p = str(tmp_path / "part-00000.dlsrec")
+        base = np.arange(24, dtype=np.float32).reshape(4, 6)
+        with RecordShardWriter(p) as w:
+            w.write({"t": base.T, "s": base[:, ::2]})  # both non-contiguous
+        (rec,) = array_records(p).collect()
+        np.testing.assert_array_equal(rec["t"], base.T)
+        np.testing.assert_array_equal(rec["s"], base[:, ::2])
 
     def test_rejects_non_record_file(self, tmp_path):
         p = tmp_path / "junk.dlsrec"
