@@ -133,6 +133,32 @@ def segments_from_docs(
         yield ids
 
 
+def _pack_token_windows(
+    doc_tokens: Iterable[list[int]], window: int
+) -> Iterator[tuple[list[int], list[int], bool]]:
+    """Lockstep token/segment-id packer shared by the MLM and causal-LM
+    pipelines: concatenate per-document token lists, tag every position
+    with a running document counter, and cut ``window``-sized chunks →
+    ``(chunk, seg_ids, is_partial)``. The final partial chunk (corpus
+    tail) is yielded unpadded with ``is_partial=True`` — framing (CLS/SEP
+    vs EOS, pad conventions) belongs to the caller. ONE copy of the
+    buffer-slicing invariant lives here.
+    """
+    buf: list[int] = []
+    seg: list[int] = []
+    doc_id = 0
+    for toks in doc_tokens:
+        buf.extend(toks)
+        seg.extend([doc_id] * len(toks))
+        doc_id += 1
+        while len(buf) >= window:
+            chunk, buf = buf[:window], buf[window:]
+            cseg, seg = seg[:window], seg[window:]
+            yield chunk, cseg, False
+    if buf:
+        yield buf, seg, True
+
+
 def packed_segments_from_docs(
     docs: Iterable[str], tokenizer: WordPieceTokenizer, seq_len: int
 ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
@@ -146,26 +172,14 @@ def packed_segments_from_docs(
     the final [SEP] its last; padding (tail window only) gets id -1 so real
     tokens never attend to pad positions even without a padding mask.
     """
-    budget = seq_len - 2
-    buf: list[int] = []
-    seg: list[int] = []
-    doc_id = 0
-    for doc in docs:
-        toks = tokenizer.encode(doc)
-        buf.extend(toks)
-        seg.extend([doc_id] * len(toks))
-        doc_id += 1
-        while len(buf) >= budget:
-            chunk, buf = buf[:budget], buf[budget:]
-            cseg, seg = seg[:budget], seg[budget:]
-            yield (np.array([tokenizer.cls_id, *chunk, tokenizer.sep_id], np.int32),
-                   np.array([cseg[0], *cseg, cseg[-1]], np.int32))
-    if buf:
-        ids = [tokenizer.cls_id, *buf, tokenizer.sep_id]
-        sids = [seg[0], *seg, seg[-1]]
-        pad = seq_len - len(ids)
-        ids += [tokenizer.pad_id] * pad
-        sids += [-1] * pad
+    stream = (tokenizer.encode(doc) for doc in docs)
+    for chunk, cseg, partial in _pack_token_windows(stream, seq_len - 2):
+        ids = [tokenizer.cls_id, *chunk, tokenizer.sep_id]
+        sids = [cseg[0], *cseg, cseg[-1]]
+        if partial:
+            pad = seq_len - len(ids)
+            ids += [tokenizer.pad_id] * pad
+            sids += [-1] * pad
         yield np.array(ids, np.int32), np.array(sids, np.int32)
 
 
@@ -372,6 +386,7 @@ def lm_dataset(
     *,
     seq_len: int = 512,
     eos_between_docs: bool = True,
+    segment_ids: bool = False,
 ) -> PartitionedDataset:
     """Text RDD → packed causal-LM blocks (config 5's fine-tune feed).
 
@@ -380,26 +395,33 @@ def lm_dataset(
     into fixed [seq_len] windows: ``{"input_ids": [S] i32, "loss_mask": [S]
     f32}``. ``loss_mask`` zeroes padding in the final short block so
     :func:`~distributeddeeplearningspark_tpu.train.losses.causal_lm` ignores it.
+
+    ``segment_ids=True`` adds per-position document ids (running counter;
+    the SEP separator belongs to the document it ends; pads get -1) so
+    attention is blocked across packed-document boundaries — the model
+    consumes ``batch["segment_ids"]`` through the flash kernel / ring
+    (GPT-style packing without it is also standard; measure both).
     """
 
     def per_partition(pidx: int, lines: Iterable[str]) -> Iterator[dict]:
         del pidx
-        buf: list[int] = []
-        for doc in lines:
-            buf.extend(tokenizer.encode(doc))
-            if eos_between_docs:
-                buf.append(tokenizer.sep_id)
-            while len(buf) >= seq_len:
-                chunk, buf = buf[:seq_len], buf[seq_len:]
-                yield {
-                    "input_ids": np.array(chunk, np.int32),
-                    "loss_mask": np.ones(seq_len, np.float32),
-                }
-        if len(buf) > 1:
+        stream = (
+            tokenizer.encode(doc) + ([tokenizer.sep_id] if eos_between_docs
+                                     else [])
+            for doc in lines)
+        for chunk, cseg, partial in _pack_token_windows(stream, seq_len):
+            if partial and len(chunk) <= 1:
+                continue  # a lone token has no next-token target
             mask = np.zeros(seq_len, np.float32)
-            mask[: len(buf)] = 1.0
-            ids = buf + [tokenizer.pad_id] * (seq_len - len(buf))
-            yield {"input_ids": np.array(ids, np.int32), "loss_mask": mask}
+            mask[: len(chunk)] = 1.0
+            ids = chunk + [tokenizer.pad_id] * (seq_len - len(chunk))
+            ex = {"input_ids": np.array(ids, np.int32),
+                  "loss_mask": (np.ones(seq_len, np.float32)
+                                if not partial else mask)}
+            if segment_ids:
+                sids = cseg + [-1] * (seq_len - len(cseg))
+                ex["segment_ids"] = np.array(sids, np.int32)
+            yield ex
 
     return docs.map_partitions_with_index(per_partition)
 

@@ -199,7 +199,8 @@ class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, mask: jax.Array | None) -> jax.Array:
+    def __call__(self, x: jax.Array, mask: jax.Array | None,
+                 segment_ids: jax.Array | None = None) -> jax.Array:
         cfg = self.cfg
         hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
 
@@ -217,6 +218,10 @@ class LlamaAttention(nn.Module):
                     "decode mode has no padding-mask support: the KV cache "
                     "assumes equal-length prompts (drop attention_mask and "
                     "bucket/pad prompts to one length upstream)")
+            if segment_ids is not None:
+                raise ValueError(
+                    "decode mode does not take segment_ids (generation is "
+                    "one document per row)")
             y = self._decode_attend(q, k, v)
         else:
             positions = jnp.arange(x.shape[1])[None, :]
@@ -225,6 +230,7 @@ class LlamaAttention(nn.Module):
             # GQA K/V stay at nkv heads: flash indexes groups directly, ring
             # runs grouped einsums; only the xla fallback broadcasts.
             y = dot_product_attention(q, k, v, mask=mask, causal=True,
+                                      segment_ids=segment_ids,
                                       impl=cfg.attention_impl)
         rank = cfg.lora_rank if "wo" in cfg.lora_targets else 0
         return LoRADenseGeneral(cfg.hidden_size, axis=(-2, -1), rank=rank,
@@ -285,10 +291,12 @@ class DecoderLayer(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, mask: jax.Array | None):
+    def __call__(self, x: jax.Array, mask: jax.Array | None,
+                 segment_ids: jax.Array | None = None):
         cfg = self.cfg
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="attention_norm")(x)
-        x = x + LlamaAttention(cfg, name="attention")(h, mask)
+        x = x + LlamaAttention(cfg, name="attention")(h, mask,
+                                                      segment_ids)
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="mlp_norm")(x)
         if cfg.moe_experts:
             from distributeddeeplearningspark_tpu.models.moe import MoEMLP
@@ -342,6 +350,10 @@ class LlamaForCausalLM(nn.Module):
         pad = batch.get("attention_mask")
         # causal handled inside attention; only pass an explicit mask for padding
         mask = padding_mask(pad) if pad is not None else None
+        # packed-document batches (data/text.py lm_dataset(segment_ids=True)):
+        # per-position doc ids block cross-document attention — streamed
+        # natively by the flash kernel and the ring's riding blocks
+        segment_ids = batch.get("segment_ids")
 
         layer_cls = DecoderLayer
         if cfg.remat:
@@ -358,12 +370,13 @@ class LlamaForCausalLM(nn.Module):
                 in_axes=nn.broadcast,           # mask is shared, not scanned
                 length=cfg.num_layers,
             )(cfg, name="layers")
-            x, aux = stacked(x, mask)
+            x, aux = stacked(x, mask, segment_ids)
             moe_aux = jnp.sum(aux) if cfg.moe_experts else None
         else:
             auxes = []
             for i in range(cfg.num_layers):
-                x, aux = layer_cls(cfg, name=f"layers_{i}")(x, mask)
+                x, aux = layer_cls(cfg, name=f"layers_{i}")(x, mask,
+                                                            segment_ids)
                 auxes.append(aux)
             moe_aux = (jnp.sum(jnp.stack(auxes))
                        if cfg.moe_experts else None)

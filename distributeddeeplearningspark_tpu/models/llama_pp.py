@@ -82,7 +82,7 @@ def make_pp_apply(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int | None = N
     head_mod = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype)
 
     def stage_fn(stage_params: Any, act):
-        out, _ = stage_mod.apply({"params": stage_params}, act, None)
+        out, _ = stage_mod.apply({"params": stage_params}, act, None, None)
         return out
 
     def apply_fn(variables, batch, *, train: bool = False, rngs=None, mutable=None):
@@ -92,6 +92,12 @@ def make_pp_apply(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int | None = N
             raise NotImplementedError(
                 "pipeline-parallel Llama supports causal packing only; "
                 "handle padding via loss_mask (as config 5 does)")
+        if batch.get("segment_ids") is not None:
+            raise NotImplementedError(
+                "pipeline-parallel Llama does not thread segment_ids to the "
+                "stage forwards — packed batches would silently attend "
+                "across documents; drop segment_ids (GPT-style packing) or "
+                "use a non-PP layout")
         ids = batch["input_ids"]
         if ids.shape[1] > cfg.max_position:
             raise ValueError(

@@ -396,3 +396,98 @@ def test_predict_on_fused_model_returns_logits():
         {"params": params}, batch, train=False)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(plain),
                                atol=2e-5, rtol=2e-5)
+
+
+class TestLlamaPackedSegments:
+    """Packed causal training with cross-document isolation (lm_dataset
+    segment ids → LlamaAttention → flash/ring/xla)."""
+
+    def test_lm_dataset_emits_segment_ids(self):
+        from distributeddeeplearningspark_tpu.data import text as text_lib
+
+        docs = text_lib.synthetic_wikipedia(16, num_partitions=2)
+        tok = text_lib.WordPieceTokenizer.train(docs.collect(), vocab_size=512)
+        ds = text_lib.lm_dataset(docs, tok, seq_len=64, segment_ids=True)
+        exs = ds.take(3)
+        for ex in exs:
+            assert ex["segment_ids"].shape == (64,)
+            # ids nondecreasing within a window except pads (-1 tail)
+            sids = ex["segment_ids"]
+            body = sids[sids >= 0]
+            assert (np.diff(body) >= 0).all()
+        # pads (if any) carry -1 exactly where loss_mask is 0
+        for ex in exs:
+            np.testing.assert_array_equal(ex["segment_ids"] == -1,
+                                          ex["loss_mask"] == 0)
+
+    def test_packed_forward_isolates_documents(self):
+        """Causal attention with segment ids: doc 0's logits equal running
+        doc 0 alone (absolute RoPE positions match at offsets 0..n)."""
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(11)
+        ids = rng.integers(1, 500, (2, 32)).astype(np.int32)
+        segs = np.zeros((2, 32), np.int32)
+        segs[:, 20:] = 1
+        batch = {"input_ids": ids}
+        v = model.init(jax.random.PRNGKey(0), batch, train=False)
+        packed = model.apply(v, {**batch, "segment_ids": segs}, train=False)
+        alone = model.apply(v, {"input_ids": ids[:, :20]}, train=False)
+        np.testing.assert_allclose(np.asarray(packed)[:, :20],
+                                   np.asarray(alone), atol=2e-5, rtol=2e-5)
+        # and doc 1 differs from the unisolated run
+        plain = model.apply(v, batch, train=False)
+        assert not np.allclose(np.asarray(packed)[:, 20:],
+                               np.asarray(plain)[:, 20:])
+
+    def test_packed_train_step_under_cp(self, eight_devices):
+        """Segment ids ride the ring: packed batch trains on data=2 x seq=4
+        with finite loss."""
+        import dataclasses
+
+        import optax
+
+        from distributeddeeplearningspark_tpu.data.feed import (
+            put_global, stack_examples)
+        from distributeddeeplearningspark_tpu.ops import ring_attention as ring_mod
+        from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+        from distributeddeeplearningspark_tpu.parallel.sharding import ShardingRules
+        from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+
+        mesh = MeshSpec(data=2, seq=4).build(eight_devices)
+        ring_mod.set_default_mesh(mesh)
+        cfg = dataclasses.replace(LlamaConfig.tiny(), attention_impl="ring",
+                                  scan_layers=False, remat=False)
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(13)
+        segs = np.zeros((4, 32), np.int32)
+        segs[:, 16:] = 1
+        batch = stack_examples([
+            {"input_ids": rng.integers(1, 500, (32,)).astype(np.int32),
+             "loss_mask": np.ones((32,), np.float32),
+             "segment_ids": segs[i]}
+            for i in range(4)])
+        tx = optax.adamw(1e-3)
+        state, shardings = step_lib.init_state(model, tx, batch, mesh,
+                                               ShardingRules())
+        step = step_lib.jit_train_step(
+            step_lib.make_train_step(model.apply, tx, losses.causal_lm),
+            mesh, shardings, seq_sharded=True)
+        gbatch = put_global(batch, mesh, seq_sharded=True)
+        state, metrics = step(state, gbatch)
+        assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+def test_pp_rejects_segment_ids(eight_devices):
+    """PP stage forwards don't thread segment ids — must refuse loudly."""
+    from distributeddeeplearningspark_tpu.models.llama_pp import make_pp_apply
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+
+    mesh = MeshSpec(data=4, pipe=2).build()
+    cfg = LlamaConfig.tiny()
+    apply_fn = make_pp_apply(cfg, mesh, 2)
+    model = LlamaForCausalLM(cfg)
+    batch = {"input_ids": np.ones((4, 32), np.int32)}
+    v = model.init(jax.random.PRNGKey(0), batch, train=False)
+    with pytest.raises(NotImplementedError, match="segment_ids"):
+        apply_fn(v, {**batch, "segment_ids": np.zeros((4, 32), np.int32)})
