@@ -11,6 +11,7 @@ the optimizer mask, FSDP(+optional TP) via GSPMD sharding rules::
 """
 
 import argparse
+import dataclasses
 import logging
 
 from distributeddeeplearningspark_tpu import Session, Trainer
@@ -53,11 +54,43 @@ def main() -> None:
     p.add_argument("--fused-head-loss", action="store_true",
                    help="fuse the LM-head matmul into the loss: the [B,S,V] "
                         "f32 logits never materialize (train/fused_ce.py)")
+    p.add_argument("--segment-ids", action="store_true",
+                   help="packed-document isolation: lm_dataset emits doc "
+                        "ids and attention never crosses document "
+                        "boundaries (flash/ring stream them natively); "
+                        "default is GPT-style packing")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="swap each layer's FFN for a top-2-routed MoE "
+                        "expert bank sharded over the expert mesh axis "
+                        "(models/moe.py); 0 = dense")
+    p.add_argument("--expert", type=int, default=1,
+                   help="expert-parallel axis size (with --moe-experts)")
     p.add_argument("--corpus", default=None, help="text file (one doc per line); synthetic if unset")
     p.add_argument("--tokenizer", default=None,
                    help="HF tokenizer dir matching --weights (required with --weights: "
                         "token ids must index the pretrained embedding rows)")
     args = p.parse_args()
+    if args.segment_ids and args.pipeline > 1:
+        p.error("--segment-ids is not supported with --pipeline (the stage "
+                "forward does not thread them; packed batches would "
+                "silently attend across documents)")
+    if args.moe_experts:
+        if args.pipeline > 1:
+            p.error("--moe-experts is not supported with --pipeline "
+                    "(the stage forward drops the load-balance aux loss)")
+        if args.weights:
+            p.error("--moe-experts cannot load dense --weights: the "
+                    "checkpoint's mlp/{gate,up,down} kernels have no "
+                    "counterpart in the moe/w_* expert tree and "
+                    "load_pretrained would silently leave every expert "
+                    "randomly initialized")
+        if args.expert > 1 and args.moe_experts % args.expert:
+            p.error(f"--moe-experts {args.moe_experts} must divide by "
+                    f"--expert {args.expert} (expert-dim sharding)")
+    elif args.expert > 1:
+        p.error("--expert > 1 without --moe-experts just replicates the "
+                "dense model over extra chips; drop --expert or add "
+                "--moe-experts")
     if args.weights and not args.tokenizer:
         p.error("--weights requires --tokenizer (the checkpoint's own vocab); "
                 "a corpus-trained WordPiece vocab would index unrelated embedding rows")
@@ -70,6 +103,7 @@ def main() -> None:
         .config("mesh.data", 1).config("mesh.fsdp", args.fsdp)
         .config("mesh.tensor", args.tensor).config("mesh.seq", args.seq_parallel)
         .config("mesh.pipe", args.pipeline)
+        .config("mesh.expert", args.expert)
         .getOrCreate()
     )
     print(spark)
@@ -99,19 +133,18 @@ def main() -> None:
             lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
         )
     if args.seq_parallel > 1:
-        import dataclasses
-
         cfg = dataclasses.replace(cfg, attention_impl="ring")
     if args.fused_head_loss:
-        import dataclasses
-
         if args.pipeline > 1:
             p.error("--fused-head-loss is not supported with --pipeline "
                     "(the GPipe forward emits real logits)")
         cfg = dataclasses.replace(cfg, fused_head_loss=True)
+    if args.moe_experts:  # incompatibilities rejected at parse time above
+        cfg = dataclasses.replace(cfg, moe_experts=args.moe_experts)
     model = LlamaForCausalLM(cfg)
 
-    ds = text_lib.lm_dataset(docs, tok, seq_len=args.seq_len).repeat()
+    ds = text_lib.lm_dataset(docs, tok, seq_len=args.seq_len,
+                             segment_ids=args.segment_ids).repeat()
 
     # clip INSIDE the mask: the norm must be over adapter grads only, or the
     # frozen base weights' grads dominate it and shrink the LoRA updates
