@@ -186,3 +186,31 @@ def test_all_mode_degrades_to_host_input_when_tpu_down(monkeypatch, capsys):
     assert rec["metric"] == "input_pipeline_host_images_per_sec"
     assert rec["value"] == 42.0
     assert any("device workloads skipped" in e for e in rec["extra"]["errors"])
+
+
+def test_llama_7b_oom_returns_structured_evidence(monkeypatch):
+    """VERDICT r2 next-#3: a resource-exhaustion failure of the 7B attempt
+    must come back as the budget-bearing evidence record; any other error
+    must still raise (a code bug cannot masquerade as memory evidence)."""
+    import pytest
+
+    def oom(*a, **k):
+        raise RuntimeError("XLA:TPU RESOURCE_EXHAUSTED: Ran out of memory "
+                           "in hbm. Used 17.1G of 15.48G")
+
+    monkeypatch.setattr(bench, "_train_setup", oom)
+    rec = bench.bench_llama(2, variant="7b")
+    assert rec["error"].startswith("RuntimeError")
+    assert "memory_report" in rec and "memory_v4_32" in rec
+    # the v4-32 record must carry the CONTRACT shape, not the clamped
+    # single-chip attempt shape
+    assert rec["memory_v4_32"]["mesh"] == {"data": 2, "fsdp": 8}
+    assert "fits 32 GiB/chip: True" in " ".join(rec["memory_v4_32"]["notes"])
+    assert rec["batch_size"] == 1 and rec["seq_len"] == 1024
+
+    def bug(*a, **k):
+        raise TypeError("not a memory problem")
+
+    monkeypatch.setattr(bench, "_train_setup", bug)
+    with pytest.raises(TypeError):
+        bench.bench_llama(2, variant="7b")
