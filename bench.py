@@ -293,7 +293,8 @@ def bench_bert(iters: int, batch_size: int = 32, seq: int = 512,
 
 
 def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
-                fused_head: bool = False, variant: str = "0.9b") -> dict:
+                fused_head: bool = False, variant: str = "0.9b",
+                segment_ids: bool = False) -> dict:
     """Llama LoRA fine-tune tokens/sec/chip (BASELINE.json config 5 shape).
 
     ``variant="0.9b"`` (default): single-chip-sized geometry (~0.9B params,
@@ -356,10 +357,21 @@ def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
         mesh_shape={"data": 2, "fsdp": 8}, hbm_per_chip_gib=32).to_dict()
     model = LlamaForCausalLM(cfg)
     rng = np.random.default_rng(2)
-    batch = stack_examples([
-        {"input_ids": rng.integers(0, cfg.vocab_size, (seq,)).astype(np.int32),
-         "loss_mask": np.ones((seq,), np.float32)}
-        for _ in range(batch_size)])
+
+    def example():
+        ex = {"input_ids": rng.integers(
+                  0, cfg.vocab_size, (seq,)).astype(np.int32),
+              "loss_mask": np.ones((seq,), np.float32)}
+        if segment_ids:
+            # packed-document shape (~4 docs/window, Wikipedia-ish): the A/B
+            # prices cross-document isolation vs GPT-style packing
+            segs = np.zeros((seq,), np.int32)
+            for b1 in sorted(rng.integers(1, seq, size=3)):
+                segs[b1:] += 1
+            ex["segment_ids"] = segs
+        return ex
+
+    batch = stack_examples([example() for _ in range(batch_size)])
     try:
         mesh, state, step, gbatch, flops = _train_setup(
             model, batch,
@@ -420,6 +432,7 @@ def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
         "batch_size": batch_size,
         "seq_len": seq,
         "fused_head_loss": fused_head,
+        "segment_ids": segment_ids,
         "memory_report": mem_report,
         "memory_v4_32": mem_v4_32,
         "chips": n_chips,
@@ -671,9 +684,9 @@ def main(argv=None) -> int:
                     help="resnet only: Pallas 1x1-conv+BN-stats epilogue "
                          "kernel in the bottlenecks (byte-diet A/B)")
     ap.add_argument("--segment-ids", action="store_true",
-                    help="bert only: bench the packed-document shape (~3 "
-                         "segment ids per window streamed into the flash "
-                         "kernel) — the VERDICT r2 #4 kernel-cost A/B")
+                    help="bert/llama: bench the packed-document shape "
+                         "(segment ids streamed into the flash kernel) — "
+                         "prices cross-document isolation vs plain packing")
     ap.add_argument("--fused-head-loss", action="store_true",
                     help="llama only: fuse the LM-head matmul into the loss "
                          "(A/B vs materialized [B,S,V] logits)")
@@ -764,6 +777,7 @@ def main(argv=None) -> int:
         "llama_lora": lambda: bench_llama(
             max(5, args.iters // 2),
             fused_head=args.fused_head_loss,
+            segment_ids=args.segment_ids,
             variant=args.variant,
             **({"batch_size": args.batch} if args.batch else {}),
             **({"seq": args.seq} if args.seq else {})),
