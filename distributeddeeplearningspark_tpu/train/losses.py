@@ -20,18 +20,39 @@ import jax.numpy as jnp
 import optax
 
 
+def _row_mask(batch: dict[str, Any]) -> jax.Array | None:
+    """Per-row eval weights (1 real / 0 padding), present only on padded
+    remainder batches (data/feed.py ``_pad_to_shards``). Losses that see it
+    MUST exclude mask-0 rows from every mean and report the real count as
+    ``"weight"`` — that is what makes sharded eval exact (r3 missing-#5)."""
+    m = batch.get("eval_mask")
+    return None if m is None else m.astype(jnp.float32)
+
+
 def softmax_xent(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, dict]:
     """Classification (LeNet-5/MNIST, ResNet-50/ImageNet): mean CE + accuracy.
 
     Reports top-5 accuracy too when there are >5 classes — the second
     standard ImageNet number (top-k via one sort, no loop)."""
     labels = batch["label"]
-    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
-    acc = (jnp.argmax(logits, -1) == labels).mean()
-    metrics = {"loss": loss, "accuracy": acc}
+    per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    w = _row_mask(batch)
+    if w is None:
+        loss = per_ex.mean()
+        acc = (jnp.argmax(logits, -1) == labels).mean()
+        metrics = {"loss": loss, "accuracy": acc}
+        if logits.shape[-1] > 5:
+            top5 = jax.lax.top_k(logits, 5)[1]
+            metrics["top5_accuracy"] = (top5 == labels[:, None]).any(-1).mean()
+        return loss, metrics
+    denom = jnp.maximum(w.sum(), 1.0)
+    loss = (per_ex * w).sum() / denom
+    acc = ((jnp.argmax(logits, -1) == labels) * w).sum() / denom
+    metrics = {"loss": loss, "accuracy": acc, "weight": denom}
     if logits.shape[-1] > 5:
         top5 = jax.lax.top_k(logits, 5)[1]
-        metrics["top5_accuracy"] = (top5 == labels[:, None]).any(-1).mean()
+        metrics["top5_accuracy"] = (
+            (top5 == labels[:, None]).any(-1) * w).sum() / denom
     return loss, metrics
 
 
@@ -43,6 +64,9 @@ def masked_lm(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, dict
     """
     labels = batch["mlm_labels"]
     weights = batch["mlm_weights"].astype(jnp.float32)
+    em = _row_mask(batch)
+    if em is not None:  # padded eval rows contribute zero mask weight
+        weights = weights * em[:, None]
     per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
     denom = jnp.maximum(weights.sum(), 1.0)
     loss = (per_tok * weights).sum() / denom
@@ -54,9 +78,15 @@ def binary_xent(logits: jax.Array, batch: dict[str, Any]) -> tuple[jax.Array, di
     """CTR prediction (Wide&Deep/DLRM on Criteo): sigmoid BCE + accuracy."""
     labels = batch["label"].astype(jnp.float32)
     logits = logits.reshape(labels.shape)
-    loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
-    acc = ((logits > 0) == (labels > 0.5)).mean()
-    return loss, {"loss": loss, "accuracy": acc}
+    per_ex = optax.sigmoid_binary_cross_entropy(logits, labels)
+    hit = ((logits > 0) == (labels > 0.5))
+    w = _row_mask(batch)
+    if w is None:
+        return per_ex.mean(), {"loss": per_ex.mean(), "accuracy": hit.mean()}
+    denom = jnp.maximum(w.sum(), 1.0)
+    loss = (per_ex * w).sum() / denom
+    return loss, {"loss": loss, "accuracy": (hit * w).sum() / denom,
+                  "weight": denom}
 
 
 def _reduce_next_token(per_tok: jax.Array, batch: dict[str, Any]
@@ -65,8 +95,14 @@ def _reduce_next_token(per_tok: jax.Array, batch: dict[str, Any]
     (loss, perplexity, weight) metrics — one definition for both the
     materialized and the fused head path."""
     mask = batch.get("loss_mask")
+    em = _row_mask(batch)
     if mask is not None:
         mask = mask[:, 1:].astype(jnp.float32)
+    elif em is not None:
+        mask = jnp.ones_like(per_tok)
+    if em is not None:  # padded eval rows: zero token weight end-to-end
+        mask = mask * em[:, None]
+    if mask is not None:
         denom = jnp.maximum(mask.sum(), 1.0)
         loss = (per_tok * mask).sum() / denom
     else:
@@ -103,11 +139,15 @@ def causal_lm_fused(outputs: dict[str, jax.Array], batch: dict[str, Any]
 
 
 def _add_moe_aux(loss, metrics, outputs) -> tuple[jax.Array, dict]:
-    """Fold a model-reported (already-weighted) MoE load-balance loss in."""
+    """Fold a model-reported (already-weighted) MoE load-balance loss in;
+    also surfaces the dropped-token fraction (capacity honesty, r3 weak-#4)
+    as a pure metric — it never contributes to the loss."""
     if isinstance(outputs, dict) and "moe_aux" in outputs:
         aux = outputs["moe_aux"]
         loss = loss + aux
         metrics = {**metrics, "loss": loss, "moe_aux": aux}
+        if "moe_dropped_frac" in outputs:
+            metrics["moe_dropped_frac"] = outputs["moe_dropped_frac"]
     return loss, metrics
 
 

@@ -1,0 +1,89 @@
+"""Loss-function unit tests — the eval_mask row-weighting contract.
+
+VERDICT r3 missing-#5: `Trainer.evaluate` pads sub-shard tails with
+``eval_mask == 0`` rows (data/feed.py `_pad_to_shards`); every contract loss
+must (a) exclude those rows from every mean exactly and (b) report the real
+weight so the weighted-mean aggregation stays exact. These tests prove (a)/(b)
+directly against hand-computed references, independent of the Trainer plumbing
+(tests/test_train_mnist.py covers the end-to-end path).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributeddeeplearningspark_tpu.train import losses
+
+
+def test_softmax_xent_eval_mask_excludes_pad_rows():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(0, 1, (6, 10)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 10, (6,)).astype(np.int32))
+    mask = jnp.asarray([1, 1, 1, 1, 0, 0], jnp.float32)
+
+    full, m_full = losses.softmax_xent(
+        logits[:4], {"label": labels[:4]})
+    masked, m_masked = losses.softmax_xent(
+        logits, {"label": labels, "eval_mask": mask})
+    np.testing.assert_allclose(float(masked), float(full), rtol=1e-6)
+    np.testing.assert_allclose(float(m_masked["accuracy"]),
+                               float(m_full["accuracy"]), rtol=1e-6)
+    np.testing.assert_allclose(float(m_masked["top5_accuracy"]),
+                               float(m_full["top5_accuracy"]), rtol=1e-6)
+    assert float(m_masked["weight"]) == 4.0
+    assert "weight" not in m_full  # unpadded batches keep the legacy shape
+
+
+def test_binary_xent_eval_mask_excludes_pad_rows():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(0, 1, (5, 1)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 2, (5,)).astype(np.int32))
+    mask = jnp.asarray([1, 1, 1, 0, 0], jnp.float32)
+
+    full, m_full = losses.binary_xent(logits[:3], {"label": labels[:3]})
+    masked, m_masked = losses.binary_xent(
+        logits, {"label": labels, "eval_mask": mask})
+    np.testing.assert_allclose(float(masked), float(full), rtol=1e-6)
+    np.testing.assert_allclose(float(m_masked["accuracy"]),
+                               float(m_full["accuracy"]), rtol=1e-6)
+    assert float(m_masked["weight"]) == 3.0
+
+
+def test_masked_lm_eval_mask_zeroes_pad_row_tokens():
+    rng = np.random.default_rng(2)
+    b, s, v = 4, 8, 32
+    logits = jnp.asarray(rng.normal(0, 1, (b, s, v)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, v, (b, s)).astype(np.int32))
+    w = jnp.asarray(rng.random((b, s)) < 0.5, jnp.float32)
+    batch = {"mlm_labels": ids, "mlm_weights": w}
+
+    full, m_full = losses.masked_lm(
+        logits[:2], {k: val[:2] for k, val in batch.items()})
+    masked, m_masked = losses.masked_lm(
+        logits, {**batch, "eval_mask": jnp.asarray([1, 1, 0, 0], jnp.float32)})
+    np.testing.assert_allclose(float(masked), float(full), rtol=1e-5)
+    # weight = surviving mask count, NOT the padded batch's
+    assert float(m_masked["weight"]) == float(w[:2].sum())
+
+
+def test_causal_lm_eval_mask_with_and_without_loss_mask():
+    rng = np.random.default_rng(3)
+    b, s, v = 4, 8, 32
+    logits = jnp.asarray(rng.normal(0, 1, (b, s, v)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, v, (b, s)).astype(np.int32))
+    em = jnp.asarray([1, 1, 1, 0], jnp.float32)
+
+    # with an explicit loss_mask
+    lm = jnp.asarray(rng.random((b, s)) < 0.7, jnp.float32)
+    full, _ = losses.causal_lm(
+        logits[:3], {"input_ids": ids[:3], "loss_mask": lm[:3]})
+    masked, m = losses.causal_lm(
+        logits, {"input_ids": ids, "loss_mask": lm, "eval_mask": em})
+    np.testing.assert_allclose(float(masked), float(full), rtol=1e-5)
+    assert float(m["weight"]) == float(lm[:3, 1:].sum())
+
+    # without one (eval_mask alone synthesizes the token mask)
+    full2, _ = losses.causal_lm(logits[:3], {"input_ids": ids[:3]})
+    masked2, m2 = losses.causal_lm(
+        logits, {"input_ids": ids, "eval_mask": em})
+    np.testing.assert_allclose(float(masked2), float(full2), rtol=1e-5)
+    assert float(m2["weight"]) == 3 * (s - 1)
