@@ -57,6 +57,15 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # STORAGE dtype of the base weights (embed, attention/MLP kernels, LM
+    # head). Default f32 — full-parameter training wants f32 masters, and HF
+    # checkpoint interchange stays bit-faithful. Set "bfloat16" for frozen-
+    # base LoRA fine-tuning: the base never takes an optimizer step, so f32
+    # masters are pure waste — the r4 memval run measured f32 storage at
+    # 25.2 GiB of arguments for the 7B (vs 12.6 analytic bf16), which alone
+    # overflows a 16 GiB chip and doubles the v4-32 per-chip budget. LoRA
+    # A/B adapters and RMSNorm scales stay f32 regardless (they train).
+    param_dtype: Any = jnp.float32
     attention_impl: str = "auto"
     scan_layers: bool = True
     remat: bool = True
@@ -102,6 +111,13 @@ class LlamaConfig:
 
     @staticmethod
     def llama2_7b(**kw) -> "LlamaConfig":
+        # Frozen-base LoRA fine-tunes default to bf16 base-weight STORAGE
+        # (see param_dtype docstring: the r4 memval run measured f32 masters
+        # at 25.2 GiB for the 7B — unfittable on a 16 GiB chip and double
+        # the v4-32 budget, for weights that never take an optimizer step).
+        # Full-parameter 7B keeps f32 masters.
+        if kw.get("lora_rank") and "param_dtype" not in kw:
+            kw["param_dtype"] = jnp.bfloat16
         return LlamaConfig(**kw)
 
     @staticmethod
@@ -172,11 +188,13 @@ class LoRADenseGeneral(nn.Module):
     alpha: float = 16.0
     use_bias: bool = False
     dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32  # base-kernel STORAGE; A/B stay f32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         y = nn.DenseGeneral(self.features, axis=self.axis, use_bias=self.use_bias,
-                            dtype=self.dtype, name="base")(x)
+                            dtype=self.dtype, param_dtype=self.param_dtype,
+                            name="base")(x)
         if self.rank:
             axes = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
             axes = tuple(a % x.ndim for a in axes)
@@ -207,7 +225,8 @@ class LlamaAttention(nn.Module):
         def proj(name, heads):
             rank = cfg.lora_rank if name in cfg.lora_targets else 0
             return LoRADenseGeneral((heads, hd), rank=rank, alpha=cfg.lora_alpha,
-                                    dtype=cfg.dtype, name=name)
+                                    dtype=cfg.dtype,
+                                    param_dtype=cfg.param_dtype, name=name)
 
         q = proj("wq", nh)(x)                                   # [B,S,nh,hd]
         k = proj("wk", nkv)(x)
@@ -234,7 +253,8 @@ class LlamaAttention(nn.Module):
                                       impl=cfg.attention_impl)
         rank = cfg.lora_rank if "wo" in cfg.lora_targets else 0
         return LoRADenseGeneral(cfg.hidden_size, axis=(-2, -1), rank=rank,
-                                alpha=cfg.lora_alpha, dtype=cfg.dtype, name="wo")(y)
+                                alpha=cfg.lora_alpha, dtype=cfg.dtype,
+                                param_dtype=cfg.param_dtype, name="wo")(y)
 
     def _decode_attend(self, q, k, v):
         """KV-cached attention: append the T new tokens at the cache index,
@@ -277,7 +297,8 @@ class LlamaMLP(nn.Module):
         def proj(name, feats, axis=-1):
             rank = cfg.lora_rank if name in cfg.lora_targets else 0
             return LoRADenseGeneral(feats, axis=axis, rank=rank, alpha=cfg.lora_alpha,
-                                    dtype=cfg.dtype, name=name)
+                                    dtype=cfg.dtype,
+                                    param_dtype=cfg.param_dtype, name=name)
 
         gate = proj("gate", cfg.intermediate_size)(x)
         up = proj("up", cfg.intermediate_size)(x)
@@ -286,7 +307,8 @@ class LlamaMLP(nn.Module):
 
 class DecoderLayer(nn.Module):
     """Pre-norm block; returns (x, aux) — the (carry, out) pair nn.scan
-    wants; ``aux`` is the layer's MoE load-balance loss (0 when dense)."""
+    wants; ``aux`` is the layer's ``(moe_lb_loss, moe_dropped_frac)`` pair
+    (both 0 when dense)."""
 
     cfg: LlamaConfig
 
@@ -307,7 +329,8 @@ class DecoderLayer(nn.Module):
                 capacity_factor=cfg.moe_capacity_factor,
                 dtype=cfg.dtype, name="moe")(h)
         else:
-            y, aux = LlamaMLP(cfg, name="mlp")(h), jnp.float32(0.0)
+            y = LlamaMLP(cfg, name="mlp")(h)
+            aux = (jnp.float32(0.0), jnp.float32(0.0))
         return x + y, aux
 
 
@@ -319,11 +342,12 @@ class _LMHead(nn.Module):
 
     vocab: int
     dtype: Any
+    param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array, *, return_kernel: bool = False):
         kernel = self.param("kernel", nn.initializers.lecun_normal(),
-                            (x.shape[-1], self.vocab), jnp.float32)
+                            (x.shape[-1], self.vocab), self.param_dtype)
         if return_kernel:
             return kernel
         return jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
@@ -346,7 +370,7 @@ class LlamaForCausalLM(nn.Module):
                 f"sequence length {ids.shape[1]} exceeds max_position {cfg.max_position}"
             )
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
-                     name="token_embed")(ids)
+                     param_dtype=cfg.param_dtype, name="token_embed")(ids)
         pad = batch.get("attention_mask")
         # causal handled inside attention; only pass an explicit mask for padding
         mask = padding_mask(pad) if pad is not None else None
@@ -370,32 +394,39 @@ class LlamaForCausalLM(nn.Module):
                 in_axes=nn.broadcast,           # mask is shared, not scanned
                 length=cfg.num_layers,
             )(cfg, name="layers")
-            x, aux = stacked(x, mask, segment_ids)
+            x, (aux, dropped) = stacked(x, mask, segment_ids)
             moe_aux = jnp.sum(aux) if cfg.moe_experts else None
+            moe_dropped = jnp.mean(dropped) if cfg.moe_experts else None
         else:
-            auxes = []
+            auxes, droppeds = [], []
             for i in range(cfg.num_layers):
-                x, aux = layer_cls(cfg, name=f"layers_{i}")(x, mask,
-                                                            segment_ids)
+                x, (aux, drp) = layer_cls(cfg, name=f"layers_{i}")(
+                    x, mask, segment_ids)
                 auxes.append(aux)
+                droppeds.append(drp)
             moe_aux = (jnp.sum(jnp.stack(auxes))
                        if cfg.moe_experts else None)
+            moe_dropped = (jnp.mean(jnp.stack(droppeds))
+                           if cfg.moe_experts else None)
 
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)
-        head = _LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")
+        head = _LMHead(cfg.vocab_size, cfg.dtype, cfg.param_dtype,
+                       name="lm_head")
         if cfg.fused_head_loss and not cfg.decode:
             # hand the pieces to losses.causal_lm_fused; the [B,S,V] f32
             # logits (and their cotangent) never exist
             out = {"hidden": x, "lm_head": head(x, return_kernel=True)}
             if moe_aux is not None and train:
                 out["moe_aux"] = cfg.moe_aux_weight * moe_aux
+                out["moe_dropped_frac"] = moe_dropped
             return out
         logits = head(x).astype(jnp.float32)
         if moe_aux is not None and train and not cfg.decode:
             # train only: predict/eval consumers (Trainer.predict row
             # indexing, argmax output_fns) expect a bare logits array
             return {"logits": logits,
-                    "moe_aux": cfg.moe_aux_weight * moe_aux}
+                    "moe_aux": cfg.moe_aux_weight * moe_aux,
+                    "moe_dropped_frac": moe_dropped}
         return logits
 
 

@@ -114,7 +114,14 @@ def llama_memory_report(
     counts = llama_param_count(cfg)
     notes: list[str] = []
     comp: dict[str, float] = {}
-    comp["base_params_bf16"] = counts["base"] * 2 / param_shard
+    # STORAGE dtype of the base weights (LlamaConfig.param_dtype): the r4
+    # memval run caught this model assuming bf16 while the weights were
+    # stored f32 (compiled argument size 25.2 vs analytic 12.6 GiB on the
+    # 7B) — the byte count must come from the config, not an assumption
+    pdt = str(getattr(cfg, "param_dtype", "float32"))
+    pbytes = 2 if ("bfloat16" in pdt or "float16" in pdt) else 4
+    comp[f"base_params_{'bf16' if pbytes == 2 else 'f32'}"] = (
+        counts["base"] * pbytes / param_shard)
 
     n_lora = counts["lora"]
     if trainable == "lora" and cfg.lora_rank:
