@@ -17,10 +17,11 @@ row, writes back. Indices MUST be unique (duplicate rows would race across
 grid steps — same contract the XLA path's ``unique_indices=True`` asserts)
 and STRICTLY in-range: unlike the XLA path there is no ``mode='drop'`` —
 an OOB id would address a block row past V (OOB DMA in compiled mode).
-NOTE the real embed caller (train/embed.py rowwise_adagrad_update) pads
-with OOB sentinels and relies on drop semantics — if this kernel wins the
-A/B and replaces that scatter, a sentinel filter (e.g. clamp count to the
-true unique count, or slice ids < V) must be added at the call site first.
+The real embed caller (train/embed.py rowwise_adagrad_update) pads with
+OOB sentinels and relies on drop semantics — that caller must go through
+:func:`scatter_add_rows_dropping`, the guarded boundary that redirects
+sentinels to a discarded scratch row (and is what ``scatter_impl="pallas"``
+wires); the raw kernel cannot be called with sentinel inputs safely.
 
 If this measures at ≈92 ns/row, the DMA-bound floor stands confirmed and
 BASELINE.md records it; if it beats XLA, it becomes the embed path's
@@ -82,6 +83,42 @@ def scatter_add_rows(
         interpret=interpret,
     )(idx.astype(jnp.int32), updates[:, None, :], table[:, None, :])
     return out[:, 0, :]
+
+
+def scatter_add_rows_dropping(
+    table: jax.Array,     # [V, D]
+    idx: jax.Array,       # [K] int32 — UNIQUE among in-range ids; ids >= V
+                          # are drop sentinels (train/embed.py's padding)
+    updates: jax.Array,   # [K, D]
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Drop-semantics boundary for :func:`scatter_add_rows` (VERDICT r3
+    weak-#7 / next-#6): its intended caller pads with out-of-range sentinel
+    ids and relies on XLA's ``mode='drop'``, which the raw kernel does NOT
+    have — an OOB id would issue an OOB DMA in compiled mode. This wrapper
+    makes sentinel inputs safe to wire:
+
+    - sentinel ids (``>= V``) are redirected to a scratch row appended at
+      index V, and their update rows zeroed;
+    - the scratch row is sliced off afterward, so repeated sentinel hits
+      can only corrupt a row nobody reads (grid-step write pipelining makes
+      repeated-row read-modify-write unordered — confining the repeats to
+      the scratch row is what makes them harmless);
+    - duplicate IN-RANGE ids remain the caller's contract, exactly as with
+      ``unique_indices=True`` on the XLA path.
+
+    Costs one [V+1, D] concat (a table copy) vs the raw kernel's in-place
+    alias — acceptable for wiring safety; the falsification A/B
+    (``bench.py --model dlrm --scatter-ab``) measures the raw kernel.
+    """
+    v, d = table.shape
+    pad = idx >= v
+    safe_idx = jnp.where(pad, v, idx).astype(jnp.int32)
+    safe_upd = jnp.where(pad[:, None], jnp.zeros_like(updates), updates)
+    ext = jnp.concatenate([table, jnp.zeros((1, d), table.dtype)], axis=0)
+    out = scatter_add_rows(ext, safe_idx, safe_upd, interpret=interpret)
+    return out[:v]
 
 
 def bench_scatter_ab(k: int = 212_992, v: int = 2_600_000, d: int = 64,

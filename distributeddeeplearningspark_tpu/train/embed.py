@@ -102,6 +102,7 @@ def rowwise_adagrad_update(
     *,
     lr: float,
     eps: float = 1e-8,
+    scatter_impl: str = "xla",
 ) -> tuple[jax.Array, jax.Array]:
     """Apply row-wise AdaGrad to the rows named by ``ids`` only.
 
@@ -110,6 +111,13 @@ def rowwise_adagrad_update(
     AdaGrad). Duplicate ids are first combined by ``segment_sum``, so the
     result is deterministic and equals the dense update that a full gradient
     with those row sums would produce.
+
+    ``scatter_impl="pallas"`` routes the table scatter through the guarded
+    drop-semantics boundary ``ops.scatter_rows.scatter_add_rows_dropping``
+    (VERDICT r3 next-#6: the raw kernel must never see this function's OOB
+    sentinel padding). The tiny [V] accum scatter stays on XLA either way —
+    it is not the traffic the A/B is about. Flip the default only if the
+    ``--scatter-ab`` falsification experiment beats XLA's emitter on-chip.
     """
     v, d = table.shape
     flat = ids.reshape(-1)
@@ -133,8 +141,17 @@ def rowwise_adagrad_update(
     # unique() guarantees sorted, collision-free indices — assert both to XLA
     # so the TPU scatter emitter parallelizes instead of serializing updates
     # under collision-safety assumptions.
-    new_table = table.at[uniq].add(
-        upd, mode="drop", unique_indices=True, indices_are_sorted=True)
+    if scatter_impl == "pallas":
+        from distributeddeeplearningspark_tpu.ops.scatter_rows import (
+            scatter_add_rows_dropping)
+
+        new_table = scatter_add_rows_dropping(table, uniq, upd)
+    elif scatter_impl == "xla":
+        new_table = table.at[uniq].add(
+            upd, mode="drop", unique_indices=True, indices_are_sorted=True)
+    else:
+        raise ValueError(f"scatter_impl must be 'xla' or 'pallas', "
+                         f"got {scatter_impl!r}")
     new_accum = accum.at[uniq].set(
         new_acc_rows, mode="drop", unique_indices=True, indices_are_sorted=True)
     return new_table, new_accum

@@ -33,3 +33,58 @@ def test_bad_update_shape_rejected():
     table, idx, upd = _case(16, 8, 4)
     with pytest.raises(ValueError, match="updates"):
         scatter_add_rows(table, idx, upd[:, :4])
+
+
+def test_dropping_wrapper_discards_sentinels():
+    """VERDICT r3 weak-#7 / next-#6: the guarded boundary must accept the
+    embed caller's OOB-sentinel padding (ids >= V, unique, trailing) and
+    drop those rows exactly, like XLA mode='drop'."""
+    from distributeddeeplearningspark_tpu.ops.scatter_rows import (
+        scatter_add_rows_dropping)
+
+    v, d, k = 32, 8, 12
+    table, _, upd = _case(v, d, k, seed=3)
+    rng = np.random.default_rng(4)
+    real = np.sort(rng.choice(v, 7, replace=False))
+    # embed-style padding: sentinels v+0, v+1, ... (unique, sorted, OOB)
+    idx = jnp.asarray(np.concatenate(
+        [real, v + np.arange(k - 7)]).astype(np.int32))
+    got = scatter_add_rows_dropping(table, idx, upd)
+    want = table.at[idx].add(upd, mode="drop", unique_indices=True,
+                             indices_are_sorted=True)
+    assert got.shape == table.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_rowwise_adagrad_pallas_impl_matches_xla():
+    """The embed call-site switch: scatter_impl='pallas' (through the
+    guarded wrapper) must equal the XLA path bit-for-bit-ish, including the
+    duplicate-id case whose unique() padding produces the sentinels."""
+    from distributeddeeplearningspark_tpu.train.embed import (
+        rowwise_adagrad_update)
+
+    rng = np.random.default_rng(5)
+    v, d = 24, 8
+    table = jnp.asarray(rng.normal(0, 1, (v, d)).astype(np.float32))
+    accum = jnp.asarray(rng.uniform(0, 0.5, (v,)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, v, (6, 2)).astype(np.int32))  # dups
+    d_vecs = jnp.asarray(rng.normal(0, 1, (6, 2, d)).astype(np.float32))
+    xla_t, xla_a = rowwise_adagrad_update(
+        table, accum, ids, d_vecs, lr=0.1, eps=1e-8)
+    pls_t, pls_a = rowwise_adagrad_update(
+        table, accum, ids, d_vecs, lr=0.1, eps=1e-8, scatter_impl="pallas")
+    np.testing.assert_allclose(np.asarray(pls_t), np.asarray(xla_t),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(pls_a), np.asarray(xla_a))
+
+
+def test_rowwise_adagrad_rejects_unknown_impl():
+    from distributeddeeplearningspark_tpu.train.embed import (
+        rowwise_adagrad_update)
+
+    table = jnp.zeros((4, 8), jnp.float32)
+    with pytest.raises(ValueError, match="scatter_impl"):
+        rowwise_adagrad_update(table, jnp.zeros((4,), jnp.float32),
+                               jnp.zeros((2,), jnp.int32),
+                               jnp.zeros((2, 8), jnp.float32),
+                               lr=0.1, scatter_impl="cuda")
