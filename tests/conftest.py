@@ -43,6 +43,7 @@ _SLOW_PATTERNS = (
     "test_profiling.py::test_op_breakdown",
     "test_llama_gen.py",           # KV-cache decode rollouts (big compiles)
     "test_bench.py::test_bench_failure",
+    "test_bench.py::test_bench_kernels_interpret_smoke",  # interpret Pallas
     "test_bench.py::test_timing_suspect",
     "test_checkpoint.py::test_trainer_resume",
     "test_checkpoint.py::test_roundtrip",

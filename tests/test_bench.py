@@ -214,3 +214,90 @@ def test_llama_7b_oom_returns_structured_evidence(monkeypatch):
     monkeypatch.setattr(bench, "_train_setup", bug)
     with pytest.raises(TypeError):
         bench.bench_llama(2, variant="7b")
+
+
+def test_chip_queue_items_are_unique_and_parse():
+    """VERDICT r3 next-#1: the one-command chip queue. A typo'd argv or a
+    duplicate item name would burn a real chip window — validate every
+    entry against bench's own CLI parser, off-chip."""
+    import bench
+
+    names = [n for n, _, _ in bench.CHIP_QUEUE]
+    assert len(names) == len(set(names))
+    ap = bench.build_parser()
+    for name, argv, timeout_s in bench.CHIP_QUEUE:
+        args = ap.parse_args(argv)  # SystemExit on an invalid flag
+        assert timeout_s >= 300, f"{name}: timeout too tight for axon compiles"
+    # priority order pins the all-model run first and the kernel Mosaic
+    # compiles second (BASELINE.md chip-queue row)
+    assert names[0] == "all_model" and names[1] == "kernels_mosaic"
+
+
+def test_chip_queue_aborts_when_backend_never_up(monkeypatch, tmp_path):
+    """A dead tunnel must not burn the per-item timeouts: the queue probes
+    first, records the failure, and exits 0 with a parseable line."""
+    import bench
+
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda **kw: (False, ["probe 1/1: hung (killed)"]))
+    out = tmp_path / "q.jsonl"
+    rc = bench.run_chip_queue(str(out))
+    assert rc == 0
+    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    assert recs[0]["item"] == "probe" and recs[0]["ok"] is False
+
+
+def test_chip_queue_appends_as_items_complete(monkeypatch, tmp_path):
+    """Each item's record must land in the file AS IT COMPLETES (a killed
+    window keeps everything already measured), and an item failure triggers
+    a re-probe that can stop the queue."""
+    import subprocess as sp
+
+    import bench
+
+    probes = iter([(True, []), (False, ["gone"])])
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda **kw: next(probes))
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        class R:
+            returncode = 0
+            stderr = ""
+            stdout = ('{"metric": "m", "value": 1.0}\n' if len(calls) == 1
+                      else "boom not json\n")
+        return R()
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    out = tmp_path / "q.jsonl"
+    bench.run_chip_queue(str(out), items=["all_model", "kernels_mosaic",
+                                          "memval"])
+    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    items = [r["item"] for r in recs]
+    # probe ok, first item ok, second item non-JSON -> re-probe fails ->
+    # queue stops; memval never runs
+    assert items[0] == "probe" and "all_model" in items
+    assert "kernels_mosaic" in items and "memval" not in items
+    assert recs[-1]["item"] == "probe_recheck" and recs[-1]["skipped_rest"]
+
+
+def test_bench_kernels_interpret_smoke():
+    """--model kernels off-chip: both Pallas kernels parity-check against
+    their XLA reference chains in interpret mode (timing skipped — only the
+    compiled path's numbers mean anything)."""
+    rec = bench.bench_kernels()
+    assert rec["mode"] == "interpret"
+    assert rec["conv_bn"]["compile"] == "ok", rec["conv_bn"]
+    assert rec["conv_bn"]["grad_max_rel_err"] < 0.02
+    assert rec["conv_bn"]["fused_ms"] is None
+    assert rec["scatter_rows"]["compile"] == "ok", rec["scatter_rows"]
+    assert rec["scatter_rows"]["max_abs_err"] == 0.0
+
+
+def test_chip_queue_rejects_unknown_item_names(tmp_path):
+    import pytest
+
+    with pytest.raises(SystemExit, match="unknown --queue-items"):
+        bench.run_chip_queue(str(tmp_path / "q.jsonl"), items=["memvall"])
