@@ -249,12 +249,23 @@ def write_array_records(
             w.close()
         return [w.path for w in writers]
     paths = []
-    for i, part in enumerate(parts):
-        p = os.path.join(out_dir, f"part-{i:05d}.dlsrec")
-        with RecordShardWriter(p) as w:
-            for ex in part:
-                w.write(ex)
-        paths.append(p)
+    try:
+        for i, part in enumerate(parts):
+            p = os.path.join(out_dir, f"part-{i:05d}.dlsrec")
+            with RecordShardWriter(p) as w:
+                for ex in part:
+                    w.write(ex)
+            paths.append(p)
+    except BaseException:
+        # abort-ALL (ADVICE r3): completed earlier shards would otherwise
+        # look valid, and a retry into the same out_dir could silently mix
+        # shards from two runs (mirrors the resharding branch's abort)
+        for p in paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        raise
     return paths
 
 

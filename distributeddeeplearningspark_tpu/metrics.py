@@ -150,12 +150,16 @@ def auc_from_predictions(
     auc = StreamingAUC(num_bins)
     scores: list = []
     labels: list = []
+    buffered_rows = 0  # ADVICE r3: count ROWS, not arrays — a stream of
+    # batched arrays would otherwise hold chunk×batch rows before flushing
 
     def flush():
+        nonlocal buffered_rows
         if scores:
             auc.update(np.concatenate(scores), np.concatenate(labels))
             scores.clear()
             labels.clear()
+            buffered_rows = 0
 
     stream = (predictions if max_examples is None
               else itertools.islice(predictions, max_examples))
@@ -164,9 +168,11 @@ def auc_from_predictions(
             score, label = b_, a[label_key]
         else:
             score, label = a, b_
-        scores.append(np.asarray(score, np.float64).reshape(-1))
+        s = np.asarray(score, np.float64).reshape(-1)
+        scores.append(s)
         labels.append(np.asarray(label).reshape(-1))
-        if len(scores) >= chunk:
+        buffered_rows += s.size
+        if buffered_rows >= chunk:
             flush()
     flush()
     return auc.compute()
