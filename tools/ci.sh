@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Per-round suite proof-of-run (VERDICT r3 weak-#5 / next-#4).
+#
+# The fast tier is what every driver run executes; the slow tier (whole-model
+# jits, multi-process gangs, SIGKILL drills) only runs when someone remembers
+# — so this script runs BOTH and appends an auditable line per tier to
+# SUITE_LOG.md. Run it at least once per round:
+#
+#   bash tools/ci.sh            # both tiers
+#   bash tools/ci.sh fast       # fast tier only
+#   bash tools/ci.sh slow       # slow tier only
+set -u -o pipefail  # pipefail: the tier's rc must be pytest's, not tail's
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="/root/.axon_site:${PYTHONPATH:-}"
+
+log() {  # tier, summary-tail, exit-code, seconds
+  printf '| %s | %s | %s | rc=%s | %ss |\n' \
+    "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$1" "$2" "$3" "$4" >> SUITE_LOG.md
+}
+
+run_tier() {  # name, marker-expr
+  local t0 rc out secs
+  t0=$(date +%s)
+  out=$(python -m pytest tests/ -q -m "$2" --tb=no 2>&1 | tail -1)
+  rc=$?
+  secs=$(( $(date +%s) - t0 ))
+  log "$1" "${out}" "${rc}" "${secs}"
+  echo "[$1] ${out} (rc=${rc}, ${secs}s)"
+  return $rc
+}
+
+[ -f SUITE_LOG.md ] || {
+  echo '# Suite run log (appended by tools/ci.sh — VERDICT r3 next-#4)' > SUITE_LOG.md
+  echo '' >> SUITE_LOG.md
+  echo '| when (UTC) | tier | summary | exit | wall |' >> SUITE_LOG.md
+  echo '|---|---|---|---|---|' >> SUITE_LOG.md
+}
+
+overall=0
+case "${1:-both}" in
+  fast) run_tier fast "not slow" || overall=$? ;;
+  slow) run_tier slow "slow" || overall=$? ;;
+  both) run_tier fast "not slow" || overall=$?
+        run_tier slow "slow" || overall=$? ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both]"; exit 2 ;;
+esac
+exit $overall
