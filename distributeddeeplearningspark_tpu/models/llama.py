@@ -104,6 +104,20 @@ class LlamaConfig:
     # cached prefix. Equal-length prompts per batch (prefill writes [0, T)).
     decode: bool = False
     max_cache_len: int | None = None
+    # Keep weight-relayout copies INSIDE the layer scan. XLA's layout
+    # assignment gives the scan-stacked projection kernels one entry layout,
+    # but the forward dot (contract hidden) and the backward dx dot
+    # (contract heads·head_dim) each prefer a different one; XLA then
+    # commutes copy(dynamic_slice(W_stacked)) → dynamic_slice(copy(W_stacked))
+    # and hoists WHOLE-STACK relayout copies out of the loop. Measured on the
+    # r4 chip window (7B, b=1, s=1024): three 1.0 GiB copies of the stacked
+    # wq/wk/wv — 3.0 of the 3.79 GiB program HBM — overflowing a 16 GiB chip
+    # by 0.7 GiB that the weights themselves fit. An optimization_barrier on
+    # each SLICED param blocks the commutation, so the (same total bytes of)
+    # relayout runs per-layer inside the loop: peak temp drops by ~2× the
+    # stack size at the cost of re-running slice-relayouts in the remat
+    # replay. Default on; set False to let XLA hoist when HBM is plentiful.
+    scan_param_barrier: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -380,6 +394,19 @@ class LlamaForCausalLM(nn.Module):
         segment_ids = batch.get("segment_ids")
 
         layer_cls = DecoderLayer
+        if cfg.scan_layers and cfg.scan_param_barrier:
+            # barrier each SLICED layer's params (see the config field's
+            # rationale). MUST wrap inside the remat region (i.e. before
+            # nn.remat): outside it, the barrier's outputs become per-layer
+            # saved residuals and the forward scan stashes a full stacked
+            # copy of every weight (+12.5 GiB at 7B, measured) — inside,
+            # the backward replay re-slices the loop-invariant params and
+            # re-applies the free barrier instead.
+            layer_cls = nn.map_variables(
+                layer_cls, "params",
+                trans_in_fn=lambda tree: jax.tree.map(
+                    jax.lax.optimization_barrier, tree),
+                init=self.is_initializing())
         if cfg.remat:
             layer_cls = nn.remat(layer_cls, prevent_cse=False,
                                  policy=_remat_policy(cfg.remat_policy))
