@@ -317,11 +317,16 @@ def _llama_09b_cfg(*, seq: int = 2048, fused_head: bool = False,
         moe_top_k=min(2, moe_experts) if moe_experts else 2,
         # keep matmul outputs across the remat boundary: measured 429→391
         # ms (19.1k→21.0k tok/s) on this shape at b=4; b≥6 OOMs 16G HBM
-        # with it, so the policy pays exactly while the batch still fits
-        remat_policy="dots",
+        # with it, so the policy pays exactly while the batch still fits.
+        # Long-context (s≥16384) flips to full remat + fused CE: the kept
+        # dots alone exceed 16 GiB there, while the flipped pair measures
+        # s=16384 b=1 at 9677 tok/s/chip on the r4 window (r2's boundary
+        # was "s=16384 exceeds single-chip HBM" — bf16 base storage plus
+        # these two knobs moved it)
+        remat_policy=None if seq >= 16384 else "dots",
         # A/B knob (queued in BASELINE.md's r2 outage note): fuse the
         # LM-head matmul into the loss so [B,S,V] never materializes
-        fused_head_loss=fused_head)
+        fused_head_loss=fused_head or seq >= 16384)
 
 
 def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
@@ -363,7 +368,11 @@ def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
         raise ValueError("--moe-experts is a 0.9b-proxy experiment; the 7b "
                          "geometry is the dense contract shape")
     if variant == "7b":
-        batch_size, seq = min(batch_size, 1), min(seq, 1024)
+        # b=1 always; seq capped at 2048 (s=1024 measured 14.68 GiB compiled
+        # live with the scan relayout barrier — the queue item pins s=1024,
+        # the known-good shape, so an s=2048 OOM can't cost the round its
+        # executed-7B evidence)
+        batch_size, seq = min(batch_size, 1), min(seq, 2048)
         fused_head = True  # [B,S,V] f32 logits alone would be 0.25 GiB; the
         # cotangent doubles it — fused CE is mandatory at this margin
         cfg = LlamaConfig.llama2_7b(
@@ -381,6 +390,9 @@ def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
     else:
         cfg = _llama_09b_cfg(seq=seq, fused_head=fused_head,
                              moe_experts=moe_experts)
+    # the config builders may force fused CE on (7b always; 0.9b at s≥16384)
+    # — the loss choice below must follow the config, not the CLI flag
+    fused_head = cfg.fused_head_loss
     mem_report = llama_memory_report(
         cfg, batch=batch_size, seq=seq, mesh_shape={},
         hbm_per_chip_gib=16).to_dict()
@@ -943,7 +955,7 @@ CHIP_QUEUE: list[tuple[str, list[str], int]] = [
     ("fused_conv_bn_ab", ["--model", "resnet", "--fused-conv-bn",
                           "--skip-smoke"], 900),
     ("llama_7b_attempt", ["--model", "llama", "--variant", "7b",
-                          "--skip-smoke"], 1500),
+                          "--seq", "1024", "--skip-smoke"], 1500),
     ("bert_segment_ids_ab", ["--model", "bert", "--segment-ids",
                              "--skip-smoke"], 900),
     ("llama_segment_ids_ab", ["--model", "llama", "--segment-ids",
