@@ -301,3 +301,17 @@ def test_chip_queue_rejects_unknown_item_names(tmp_path):
 
     with pytest.raises(SystemExit, match="unknown --queue-items"):
         bench.run_chip_queue(str(tmp_path / "q.jsonl"), items=["memvall"])
+
+
+def test_llama_09b_cfg_long_context_flip():
+    """s>=16384 must flip the 0.9b bench config to full remat + fused CE —
+    the pair that made s=16384 fit a single 16 GiB chip on the r4 window
+    (9677 tok/s/chip); below that the measured-fastest 'dots' policy stays."""
+    import bench
+
+    short = bench._llama_09b_cfg(seq=2048)
+    assert short.remat_policy == "dots" and not short.fused_head_loss
+    long = bench._llama_09b_cfg(seq=16384)
+    assert long.remat_policy is None and long.fused_head_loss
+    # explicit --fused-head-loss still wins at short seq
+    assert bench._llama_09b_cfg(seq=2048, fused_head=True).fused_head_loss

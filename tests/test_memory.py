@@ -57,16 +57,22 @@ def test_7b_v4_32_fsdp_layout_fits():
 
 
 def test_7b_single_chip_borderline_documented():
-    """Single dev chip (v5e, 16 GiB): bf16 base alone is ~13.5 GiB — the
+    """Single dev chip (v5e, 16 GiB): bf16 base alone is ~12.6 GiB — the
     report must show b=1 s=1024 with remat None + fused CE as borderline,
     NOT comfortably fitting (that's why the real attempt is evidence either
-    way)."""
+    way). Window tightened from the r3 ±22% to the r4 chip-window
+    measurement (VERDICT r3 next-#7): the compiler's memory_analysis()
+    reported 14.68 GiB live for this exact shape and the analytic total
+    landed −5.7% under it (13.84; the 0.9b shape validated at +2.1%), so
+    the model must stay within ±10% of that measured anchor."""
     cfg = LlamaConfig.llama2_7b(lora_rank=16, fused_head_loss=True,
                                 remat_policy=None)
     rep = llama_memory_report(cfg, batch=1, seq=1024, mesh_shape={},
                               hbm_per_chip_gib=16)
     total = rep.total_bytes / GiB
-    assert 12.5 < total < 18.0, rep.to_dict()
+    measured_compiled_live = 14.678   # CHIP_QUEUE_r04.jsonl memval, 07-31
+    assert abs(total - measured_compiled_live) / measured_compiled_live < 0.10, \
+        rep.to_dict()
 
 
 def test_report_scales_with_knobs():
