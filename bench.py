@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import subprocess
 import sys
 import time
@@ -288,8 +289,42 @@ def bench_bert(iters: int, batch_size: int = 32, seq: int = 512,
         "segment_ids": segment_ids,
         "chips": n_chips,
     }
+    rec["packing_economics"] = _bert_packing_economics(
+        rec["tokens_per_sec_per_chip"])
     _sanity_check_mfu(rec)
     return rec
+
+
+def _bert_packing_economics(raw_tok_per_sec: float) -> dict:
+    """Price the packed-vs-per-document pipeline in EFFECTIVE (non-pad)
+    tokens/sec — the half of VERDICT r2 #4 the device alone can't answer.
+    The r4 chip window measured the packed path's segment-id masks FREE
+    (117,618 vs 117,659 tok/s, −0.03%), so the whole win is pad_frac, which
+    is a property of the input pipeline: measure it through the REAL
+    mlm_dataset path (synthetic Wikipedia-like corpus, 60–120-word docs)
+    and derive effective tok/s for both modes from the single measured
+    device rate. Honest caveat: pad_frac is corpus-dependent; the synthetic
+    corpus stands in for Wikipedia's short-document regime.
+    """
+    from distributeddeeplearningspark_tpu.data import text as text_lib
+
+    docs = text_lib.synthetic_wikipedia(48, num_partitions=2)
+    tok = text_lib.WordPieceTokenizer.train(docs.collect(), vocab_size=512)
+    packed = text_lib.token_stats(
+        text_lib.mlm_dataset(docs, tok, seq_len=512))
+    naive = text_lib.token_stats(
+        text_lib.mlm_dataset(docs, tok, seq_len=512, pack=False))
+    return {
+        "packed_pad_frac": packed["pad_frac"],
+        "per_document_pad_frac": naive["pad_frac"],
+        "effective_tokens_per_sec_packed": round(
+            raw_tok_per_sec * packed["effective_frac"], 1),
+        "effective_tokens_per_sec_per_document": round(
+            raw_tok_per_sec * naive["effective_frac"], 1),
+        "packing_speedup_effective": round(
+            packed["effective_frac"] / max(naive["effective_frac"], 1e-9), 2),
+        "segment_mask_cost_measured": "-0.03% (CHIP_QUEUE_r04 bert A/B)",
+    }
 
 
 def _llama_09b_cfg(*, seq: int = 2048, fused_head: bool = False,
@@ -452,9 +487,18 @@ def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
             "OOM", "tpu_compile_helper subprocess exit code"))
         if variant != "7b" or not (oom_explicit or oom_suspected):
             raise
+        # the memory verdict lines can sit thousands of chars into the
+        # tunnel's stderr relay (the r4 window's explicit OOM line started
+        # at ~1600) — extract them verbatim so the record stays auditable
+        # even after the head truncation (ADVICE r3 #1)
+        mem_lines = [ln.strip() for ln in msg.splitlines()
+                     if re.search(r"Ran out of memory|Used [0-9.]+[MG] of"
+                                  r"|Exceeded .* capacity|RESOURCE_EXHAUSTED",
+                                  ln)]
         return {
             "variant": variant,
             "error": f"{type(e).__name__}: {msg[:1500]}",
+            "error_memory_lines": mem_lines[:8],
             "oom_suspected": oom_suspected,
             "oom_is_evidence": (
                 "single-chip 7B attempt failed with an explicit memory "

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import Mesh
@@ -68,6 +69,16 @@ def make_pp_apply(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int | None = N
     stage_len = cfg.num_layers // p
 
     layer_cls = DecoderLayer
+    if cfg.scan_param_barrier:
+        # same whole-stack relayout hazard as the non-PP scan (see
+        # LlamaConfig.scan_param_barrier): each stage's [L/P, ...] stacked
+        # weights would otherwise grow hoisted fwd+bwd layout copies.
+        # Ordering as in llama.py: inside the remat region, or the barrier
+        # outputs become per-layer saved residuals.
+        layer_cls = nn.map_variables(
+            layer_cls, "params",
+            trans_in_fn=lambda tree: jax.tree.map(
+                jax.lax.optimization_barrier, tree))
     if cfg.remat:
         layer_cls = nn.remat(layer_cls, prevent_cse=False)
     stage_mod = nn.scan(
