@@ -1009,6 +1009,19 @@ CHIP_QUEUE: list[tuple[str, list[str], int]] = [
     ("dlrm_scatter_ab", ["--model", "dlrm", "--scatter-ab",
                          "--skip-smoke"], 900),
     ("memval", ["--model", "memval"], 1200),
+    # --- added after the 2026-07-31 window executed items 1-9 (results in
+    # CHIP_QUEUE_r04.jsonl + BASELINE.md): the remaining opportunistic
+    # set. Re-running earlier items is harmless (fresh same-day numbers
+    # under the current series conditions).
+    ("llama_moe_e4", ["--model", "llama", "--moe-experts", "4",
+                      "--skip-smoke"], 900),
+    ("llama_moe_e8", ["--model", "llama", "--moe-experts", "8",
+                      "--skip-smoke"], 900),
+    ("resnet_b512", ["--model", "resnet", "--batch", "512",
+                     "--skip-smoke"], 900),
+    ("llama_longctx_16k", ["--model", "llama", "--batch", "1",
+                           "--seq", "16384", "--iters", "5",
+                           "--skip-smoke"], 1200),
 ]
 
 
