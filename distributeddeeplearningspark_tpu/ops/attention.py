@@ -10,6 +10,10 @@ Models call :func:`dot_product_attention`; the implementation is chosen by
   masks and grouped (GQA) K/V natively.
 - ``"ring"`` — context-parallel exact attention over the mesh ``seq`` axis
   (see :mod:`.ring_attention`); use when sequences are sharded across chips.
+- ``"ulysses"`` — context-parallel exact attention via all-to-all head
+  scatter (see :mod:`.ulysses`): 2 collectives per call and full-sequence
+  local flash, but heads must divide by the ``seq`` degree; the ring has
+  no head constraint and O(S/n) memory.
 - ``"auto"`` — flash on TPU when the shape qualifies (seq multiple of the
   block size, head_dim lane-friendly, mask expressible key-only), else xla.
 
@@ -61,6 +65,13 @@ def dot_product_attention(
         # segment ids shard over seq and ride the ring like the mask
         return ring_attention(q, k, v, bias=bias, mask=mask, causal=causal,
                               scale=scale, segment_ids=segment_ids)
+    if impl == "ulysses":
+        from distributeddeeplearningspark_tpu.ops.ulysses import ulysses_attention
+
+        # all-to-all CP: head-scatter/seq-gather, full-sequence local flash
+        # (2 collectives vs the ring's n−1 hops; heads must divide by seq)
+        return ulysses_attention(q, k, v, bias=bias, mask=mask, causal=causal,
+                                 scale=scale, segment_ids=segment_ids)
     k, v = _expand_gqa(q, k, v)
     if segment_ids is not None:
         seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]

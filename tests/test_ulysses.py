@@ -1,0 +1,166 @@
+"""Ulysses (all-to-all) context parallelism vs dense attention, on a real
+seq mesh — the second CP strategy next to the ring (ops/ulysses.py).
+
+Runs on 8 fake CPU devices with nontrivial (data × seq × tensor) meshes so
+the all_to_all head-scatter/seq-gather pair and the batch/head shardings
+are genuinely exercised. Coverage mirrors tests/test_ring_attention.py:
+causal/non-causal parity, gradients, GQA, key-padding masks, packed
+segment ids, the head-divisibility guard, and a full Llama CP train step
+whose loss equals the pure-DP loss.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearningspark_tpu.models import LlamaConfig, LlamaForCausalLM
+from distributeddeeplearningspark_tpu.ops.attention import _xla_attention
+from distributeddeeplearningspark_tpu.ops import ring_attention as ring_mod
+from distributeddeeplearningspark_tpu.ops.ulysses import ulysses_attention
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+from distributeddeeplearningspark_tpu.parallel.sharding import ShardingRules
+from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+
+
+def _qkv(b=4, s=32, h=8, d=16, seed=0, hkv=None):
+    rng = np.random.default_rng(seed)
+    mk = lambda hh: jnp.asarray(
+        rng.normal(0, 1, (b, s, hh, d)).astype(np.float32))
+    return mk(h), mk(hkv or h), mk(hkv or h)
+
+
+@pytest.mark.parametrize("spec", [
+    MeshSpec(data=2, seq=4),
+    MeshSpec(data=1, seq=8),
+    MeshSpec(data=2, seq=2, tensor=2),
+])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(spec, causal, eight_devices):
+    mesh = spec.build()
+    q, k, v = _qkv()
+    want = _xla_attention(q, k, v, bias=None, mask=None, causal=causal,
+                          scale=None)
+    got = jax.jit(lambda a, b_, c: ulysses_attention(
+        a, b_, c, mesh=mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_gradients_match_dense(eight_devices):
+    mesh = MeshSpec(data=2, seq=4).build()
+    q, k, v = _qkv(b=2, s=16, h=4, d=8, seed=7)
+
+    def loss_u(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, mesh=mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, bias=None, mask=None,
+                                      causal=True, scale=None) ** 2)
+
+    g_u = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(q, k, v)
+    g_d = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(g_u, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=3e-5, rtol=3e-5)
+
+
+def test_ulysses_gqa_matches_xla_repeat(eight_devices):
+    """Grouped KV (hkv < h) scatters at its own width; parity vs the dense
+    path's broadcast."""
+    mesh = MeshSpec(data=4, seq=2).build()
+    q, k, v = _qkv(h=8, hkv=4, seed=11)
+    want = _xla_attention(q, jnp.repeat(k, 2, 2), jnp.repeat(v, 2, 2),
+                          bias=None, mask=None, causal=True, scale=None)
+    got = jax.jit(lambda a, b_, c: ulysses_attention(
+        a, b_, c, mesh=mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_key_padding_mask_and_segments(eight_devices):
+    """Key-only padding masks and packed segment ids gather to full length
+    and match the dense path (incl. zeroed fully-masked rows)."""
+    mesh = MeshSpec(data=2, seq=4).build()
+    b, s = 4, 32
+    q, k, v = _qkv(b=b, s=s, seed=13)
+    rng = np.random.default_rng(5)
+    kv_mask = jnp.asarray(np.arange(s)[None, :] < rng.integers(8, s, (b, 1)))
+    segs = jnp.asarray(np.sort(rng.integers(0, 3, (b, s))).astype(np.int32))
+
+    seg_mask = segs[:, None, :, None] == segs[:, None, None, :]
+    dense_mask = jnp.logical_and(kv_mask[:, None, None, :], seg_mask)
+    want = _xla_attention(q, k, v, bias=None, mask=dense_mask, causal=True,
+                          scale=None)
+    # dense path leaves fully-masked rows as uniform-softmax junk; CP paths
+    # zero them — compare only rows with at least one allowed key
+    got = jax.jit(lambda a, b_, c, m, sg: ulysses_attention(
+        a, b_, c, mesh=mesh, causal=True, mask=m, segment_ids=sg))(
+            q, k, v, kv_mask, segs)
+    rows_ok = np.asarray(jnp.any(
+        dense_mask & (jnp.arange(s)[None, None, :, None]
+                      >= jnp.arange(s)[None, None, None, :]), axis=-1))[:, 0]
+    np.testing.assert_allclose(np.asarray(got)[rows_ok],
+                               np.asarray(want)[rows_ok],
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_rejects_undividable_heads_and_bias(eight_devices):
+    mesh = MeshSpec(data=1, seq=8).build()
+    q, k, v = _qkv(h=4)  # 4 heads over seq=8 → no
+    with pytest.raises(ValueError, match="impl='ring'"):
+        ulysses_attention(q, k, v, mesh=mesh)
+    mesh2 = MeshSpec(data=2, seq=4).build()
+    q2, k2, v2 = _qkv()
+    with pytest.raises(NotImplementedError, match="bias"):
+        ulysses_attention(q2, k2, v2, mesh=mesh2,
+                          bias=jnp.zeros((1, 1, 32, 32)))
+
+
+def test_llama_ulysses_context_parallel_train_step(eight_devices):
+    """Full CP train step via impl='ulysses' over data=2 × seq=4; loss ≡
+    the pure-DP loss on the same batch/params (mirrors the ring's test)."""
+    mesh = MeshSpec(data=2, seq=4).build()
+    # tiny() has 4q/2kv heads — too few for seq=4 head scatter; widen to
+    # 8q/4kv (the guard under test elsewhere rejects the default)
+    cfg = LlamaConfig.tiny(num_heads=8, num_kv_heads=4,
+                           attention_impl="ulysses",
+                           scan_layers=False, remat=False)
+    ring_mod.set_default_mesh(mesh)
+    try:
+        model = LlamaForCausalLM(cfg)
+        batch = {
+            "input_ids": np.tile(np.arange(32, dtype=np.int32)[None],
+                                 (8, 1)) % cfg.vocab_size,
+            "loss_mask": np.ones((8, 32), np.float32),
+        }
+        tx = optax.adamw(1e-3)
+        state, shardings = step_lib.init_state(model, tx, batch, mesh,
+                                               ShardingRules())
+        train = step_lib.make_train_step(model.apply, tx, losses.causal_lm)
+        jitted = step_lib.jit_train_step(train, mesh, shardings,
+                                         seq_sharded=True)
+        from distributeddeeplearningspark_tpu.data.feed import put_global
+
+        gbatch = put_global(batch, mesh, seq_sharded=True)
+        _, metrics = jitted(state, gbatch)
+
+        mesh_dp = MeshSpec(data=8).build()
+        cfg_dp = dataclasses.replace(cfg, attention_impl="xla")
+        model_dp = LlamaForCausalLM(cfg_dp)
+        state_dp, sh_dp = step_lib.init_state(model_dp, tx, batch, mesh_dp,
+                                              ShardingRules())
+        train_dp = step_lib.make_train_step(model_dp.apply, tx,
+                                            losses.causal_lm)
+        jitted_dp = step_lib.jit_train_step(train_dp, mesh_dp, sh_dp)
+        _, metrics_dp = jitted_dp(state_dp, put_global(batch, mesh_dp))
+        np.testing.assert_allclose(
+            float(jax.device_get(metrics["loss"])),
+            float(jax.device_get(metrics_dp["loss"])),
+            rtol=1e-4,
+        )
+    finally:
+        ring_mod.set_default_mesh(None)
