@@ -44,7 +44,14 @@ def main() -> None:
     p.add_argument("--fsdp", type=int, default=-1, help="FSDP axis size (-1: all devices)")
     p.add_argument("--tensor", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--seq-parallel", type=int, default=1,
-                   help="context-parallel axis size (ring attention shards the sequence)")
+                   help="context-parallel axis size (shards the sequence "
+                        "over the mesh seq axis)")
+    p.add_argument("--cp-impl", choices=["ring", "ulysses"], default="ring",
+                   help="context-parallel strategy when --seq-parallel > 1: "
+                        "ring (blockwise K/V rotation, O(S/n) memory, no "
+                        "head constraint) or ulysses (all-to-all head "
+                        "scatter, 2 collectives, heads must divide by the "
+                        "CP degree)")
     p.add_argument("--pipeline", type=int, default=1,
                    help="pipeline-parallel axis size (GPipe stages over scanned layers)")
     p.add_argument("--microbatches", type=int, default=0,
@@ -133,7 +140,7 @@ def main() -> None:
             lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
         )
     if args.seq_parallel > 1:
-        cfg = dataclasses.replace(cfg, attention_impl="ring")
+        cfg = dataclasses.replace(cfg, attention_impl=args.cp_impl)
     if args.fused_head_loss:
         if args.pipeline > 1:
             p.error("--fused-head-loss is not supported with --pipeline "
