@@ -328,7 +328,7 @@ def _bert_packing_economics(raw_tok_per_sec: float) -> dict:
 
 
 def _llama_09b_cfg(*, seq: int = 2048, fused_head: bool = False,
-                   moe_experts: int = 0):
+                   moe_experts: int = 0, moe_group: int = 0):
     """THE 0.9b bench config — one definition shared by bench_llama and
     bench_memval, so the memory validation can never drift from the shape
     the series actually runs (a review caught exactly that: memval carrying
@@ -350,6 +350,7 @@ def _llama_09b_cfg(*, seq: int = 2048, fused_head: bool = False,
         # moe_dropped_frac metric rides the step output
         moe_experts=moe_experts,
         moe_top_k=min(2, moe_experts) if moe_experts else 2,
+        moe_group_size=moe_group,
         # keep matmul outputs across the remat boundary: measured 429→391
         # ms (19.1k→21.0k tok/s) on this shape at b=4; b≥6 OOMs 16G HBM
         # with it, so the policy pays exactly while the batch still fits.
@@ -364,9 +365,10 @@ def _llama_09b_cfg(*, seq: int = 2048, fused_head: bool = False,
         fused_head_loss=fused_head or seq >= 16384)
 
 
-def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
+def bench_llama(iters: int, batch_size: int | None = None, seq: int = 2048,
                 fused_head: bool = False, variant: str = "0.9b",
-                segment_ids: bool = False, moe_experts: int = 0) -> dict:
+                segment_ids: bool = False, moe_experts: int = 0,
+                moe_group: int = 0) -> dict:
     """Llama LoRA fine-tune tokens/sec/chip (BASELINE.json config 5 shape).
 
     ``variant="0.9b"`` (default): single-chip-sized geometry (~0.9B params,
@@ -403,28 +405,32 @@ def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
         raise ValueError("--moe-experts is a 0.9b-proxy experiment; the 7b "
                          "geometry is the dense contract shape")
     if variant == "7b":
-        # b=1 always; seq capped at 2048 (s=1024 measured 14.68 GiB compiled
-        # live with the scan relayout barrier — the queue item pins s=1024,
-        # the known-good shape, so an s=2048 OOM can't cost the round its
-        # executed-7B evidence)
-        batch_size, seq = min(batch_size, 1), min(seq, 2048)
+        # b defaults to 1 (the known-good shape: s=1024 compiled 14.68 GiB
+        # live with the scan relayout barrier) so a bare --variant 7b can't
+        # cost the round its executed-7B evidence; an EXPLICIT --batch may
+        # push to 2 — the b=2 fit question IS the llama_7b_b2 queue item's
+        # evidence — but never past 2 on a 16 GiB chip.
+        batch_size = 1 if batch_size is None else min(batch_size, 2)
+        seq = min(seq, 2048)
         fused_head = True  # [B,S,V] f32 logits alone would be 0.25 GiB; the
         # cotangent doubles it — fused CE is mandatory at this margin
         cfg = LlamaConfig.llama2_7b(
             lora_rank=16, dtype="bfloat16", max_position=seq,
             remat_policy=None, fused_head_loss=True)
     elif variant == "tiny":
-        batch_size, seq = min(batch_size, 2), min(seq, 256)
+        batch_size, seq = min(batch_size or 2, 2), min(seq, 256)
         cfg = LlamaConfig(
             vocab_size=2048, hidden_size=256, num_layers=4, num_heads=8,
             num_kv_heads=4, intermediate_size=512, max_position=seq,
             lora_rank=8, dtype="float32", remat=False,
             moe_experts=moe_experts,
             moe_top_k=min(2, moe_experts) if moe_experts else 2,
+            moe_group_size=moe_group,
             fused_head_loss=fused_head)
     else:
+        batch_size = 4 if batch_size is None else batch_size
         cfg = _llama_09b_cfg(seq=seq, fused_head=fused_head,
-                             moe_experts=moe_experts)
+                             moe_experts=moe_experts, moe_group=moe_group)
     # the config builders may force fused CE on (7b always; 0.9b at s≥16384)
     # — the loss choice below must follow the config, not the CLI flag
     fused_head = cfg.fused_head_loss
@@ -524,6 +530,7 @@ def bench_llama(iters: int, batch_size: int = 4, seq: int = 2048,
         moe_fields = {
             "moe_experts": moe_experts,
             "moe_top_k": cfg.moe_top_k,
+            "moe_group_size": cfg.moe_group_size,
             "moe_capacity_factor": cfg.moe_capacity_factor,
             "moe_aux": round(float(m["moe_aux"]), 5),
             "moe_dropped_frac": round(float(m["moe_dropped_frac"]), 5),
@@ -1017,11 +1024,23 @@ CHIP_QUEUE: list[tuple[str, list[str], int]] = [
                       "--skip-smoke"], 900),
     ("llama_moe_e8", ["--model", "llama", "--moe-experts", "8",
                       "--skip-smoke"], 900),
+    # GShard grouping lever (r4 session-2): g=256 at s=2048 cuts the
+    # dispatch einsums' per-token cost 8×; CPU-relative at the tiny shape
+    # measured 854→707 ms (E=4 top-2). Device A/B vs llama_moe_e4 prices
+    # it at the real shape where the MXU does the dispatch matmuls.
+    ("llama_moe_e4_g256", ["--model", "llama", "--moe-experts", "4",
+                           "--moe-group", "256", "--skip-smoke"], 900),
     ("resnet_b512", ["--model", "resnet", "--batch", "512",
                      "--skip-smoke"], 900),
     ("llama_longctx_16k", ["--model", "llama", "--batch", "1",
                            "--seq", "16384", "--iters", "5",
                            "--skip-smoke"], 1200),
+    # 7B b=2 at s=1024: the r4 window's b=1 compile peaked 14.68 of
+    # 15.75 GiB, so b=2 is *likely* OOM — but either outcome is evidence
+    # (a measured tok/s or a structured OOM record with the allocation
+    # dump tail; BASELINE.md "r4 (next chip window)" item 5).
+    ("llama_7b_b2", ["--model", "llama", "--variant", "7b", "--batch", "2",
+                     "--seq", "1024", "--iters", "5", "--skip-smoke"], 1500),
 ]
 
 
@@ -1149,6 +1168,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="llama only: swap the FFN for a GShard top-2 MoE "
                          "with E experts (0 = dense) — relative step-time "
                          "prices the dense-dispatch cost (r3 weak-#4)")
+    ap.add_argument("--moe-group", type=int, default=0,
+                    help="llama+--moe-experts: routing-group size (0 = per-"
+                         "sequence). Dispatch cost per token is linear in "
+                         "the group, so g<S prices the GShard grouping "
+                         "lever; must divide B*S. Rejected without "
+                         "--moe-experts (would silently bench dense)")
     ap.add_argument("--fused-head-loss", action="store_true",
                     help="llama only: fuse the LM-head matmul into the loss "
                          "(A/B vs materialized [B,S,V] logits)")
@@ -1160,7 +1185,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.moe_group and not args.moe_experts:
+        # mirror the config-5 driver's guard: with moe_experts=0 no MoE
+        # layer is built, so the flag would silently bench plain dense
+        parser.error("--moe-group only applies to the MoE router; add "
+                     "--moe-experts or drop it")
 
     if args.chip_queue:
         items = [s for s in args.queue_items.split(",") if s] or None
@@ -1268,6 +1299,7 @@ def main(argv=None) -> int:
             fused_head=args.fused_head_loss,
             segment_ids=args.segment_ids,
             moe_experts=args.moe_experts,
+            moe_group=args.moe_group,
             variant=args.variant,
             **({"batch_size": args.batch} if args.batch else {}),
             **({"seq": args.seq} if args.seq else {})),
@@ -1360,17 +1392,37 @@ def main(argv=None) -> int:
                     "series-comparable JPEG path",
         }
         import os
+        import time as _t
 
-        queue_artifacts = sorted(
-            f for f in os.listdir(".") if f.startswith("CHIP_QUEUE")
-            and f.endswith(".jsonl"))
-        if queue_artifacts:
-            # an outage at round-end must not erase a mid-round chip window:
-            # point at the committed device artifacts (NOT re-emitted as
-            # fresh values — the judge reads them from the named files)
+        # an outage at round-end must not erase a mid-round chip window:
+        # point at the device artifacts (NOT re-emitted as fresh values —
+        # the judge reads them from the named files). Guard against the
+        # converse lie (r4 review): committed PRIOR-round CHIP_QUEUE files
+        # sit in the repo root forever, so "this round" means the file's
+        # own last record `ts` (run_chip_queue stamps every line; mtime
+        # would lie after a fresh checkout) is within the last ~18 h, and
+        # the claim carries each file's age so it stays auditable.
+        here = os.path.dirname(os.path.abspath(__file__))
+        fresh = []
+        for f in sorted(os.listdir(here)):
+            if not (f.startswith("CHIP_QUEUE") and f.endswith(".jsonl")):
+                continue
+            try:
+                with open(os.path.join(here, f)) as fh:
+                    last = [ln for ln in fh if ln.strip()][-1]
+                import calendar
+
+                ts = json.loads(last)["ts"]
+                age_h = (_t.time() - calendar.timegm(_t.strptime(
+                    ts, "%Y-%m-%dT%H:%M:%SZ"))) / 3600
+            except (OSError, IndexError, KeyError, ValueError, TypeError):
+                continue  # unreadable/unstamped artifact proves nothing
+            if 0 <= age_h < 18:
+                fresh.append(f"{f} (last record {age_h:.1f}h ago)")
+        if fresh:
             headline["device_numbers_this_round"] = (
                 f"TPU was reachable earlier this round; device-backed "
-                f"records live in {', '.join(queue_artifacts)} and the "
+                f"records live in {', '.join(fresh)} and the "
                 f"BASELINE.md measurement log")
     else:
         headline = {"metric": metric, "value": value, "unit": unit}

@@ -93,6 +93,11 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Routing-group size (0 = per-sequence groups). Dispatch/combine cost
+    # per token is linear in the group size, so shrinking it below S cuts
+    # the GShard dense-dispatch overhead (the r4 1.33×-dense floor) at the
+    # price of per-group capacity enforcement; must divide B·S.
+    moe_group_size: int = 0
     # LoRA (rank 0 = disabled → plain full-parameter model)
     lora_rank: int = 0
     lora_alpha: float = 16.0
@@ -341,6 +346,7 @@ class DecoderLayer(nn.Module):
                 cfg.hidden_size, cfg.intermediate_size, cfg.moe_experts,
                 top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
+                group_size=cfg.moe_group_size,
                 dtype=cfg.dtype, name="moe")(h)
         else:
             y = LlamaMLP(cfg, name="mlp")(h)

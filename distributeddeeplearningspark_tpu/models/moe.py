@@ -12,8 +12,16 @@ tables were its only user). TPU-first choices:
   shard over ``expert`` (dim 0 of every [E, ...] kernel) and the dispatch
   einsum's contraction lowers to the all-to-all the reference would have
   hand-written.
-- **Per-sequence routing groups** (G = batch): capacity is bounded per
-  group, so the dispatch tensor is O(S · E · C) per sequence, not O(T²).
+- **Per-sequence routing groups** (G = batch) by default: capacity is
+  bounded per group, so the dispatch tensor is O(S · E · C) per sequence,
+  not O(T²). With C = capacity_factor·g·k/E the dispatch/combine einsums
+  still cost ~capacity_factor·k·g·H FLOPs *per token* — linear in the
+  group size g, which defaults to the whole sequence. ``group_size``
+  shrinks g below S (the GShard/GLaM grouping knob): r4 CPU table showed
+  even E=1 top-1 paying 1.33× dense step time at g=S=256, which is
+  exactly this term; smaller groups trade a little routing freedom
+  (capacity is enforced per group, so load imbalance *within* a group
+  drops tokens a global router would have kept) for dispatch cost.
 - **Top-k routing with capacity dropping** (Switch/GShard): tokens beyond
   an expert's capacity fall through (the residual connection carries
   them); an auxiliary load-balance loss (Switch Transformer eq. 4 —
@@ -40,6 +48,7 @@ class MoEMLP(nn.Module):
     num_experts: int
     top_k: int = 2
     capacity_factor: float = 1.25
+    group_size: int = 0  # 0 = one group per sequence (g = S)
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -47,6 +56,18 @@ class MoEMLP(nn.Module):
         h, i, e = self.hidden_size, self.intermediate_size, self.num_experts
         if not 1 <= self.top_k <= e:
             raise ValueError(f"top_k {self.top_k} must be in [1, {e}]")
+        bb, ss, _ = x.shape
+        if self.group_size:
+            # Regroup [B, S] tokens into [B·S/g, g]: dim 0 stays B-major so
+            # a data/fsdp-sharded batch dim regroups without resharding (as
+            # long as g divides the per-shard token count — a group that
+            # spans shard boundaries forces an all-gather).
+            if (bb * ss) % self.group_size:
+                raise ValueError(
+                    f"group_size {self.group_size} must divide B*S "
+                    f"({bb}*{ss}); pick a divisor of the per-step token "
+                    "count or 0 for per-sequence groups")
+            x = x.reshape(bb * ss // self.group_size, self.group_size, h)
         b, s, _ = x.shape
         # per-group (= per-sequence) expert capacity, ≥1 so tiny test
         # shapes still route
@@ -121,6 +142,8 @@ class MoEMLP(nn.Module):
         # next to moe_aux so a tight capacity_factor can't silently starve
         # tokens of their experts
         dropped_frac = dropped / jnp.float32(b * s * self.top_k)
+        if self.group_size:
+            y = y.reshape(bb, ss, h)
         return y.astype(x.dtype), (aux, dropped_frac)
 
 

@@ -70,6 +70,10 @@ def main() -> None:
                    help="swap each layer's FFN for a top-2-routed MoE "
                         "expert bank sharded over the expert mesh axis "
                         "(models/moe.py); 0 = dense")
+    p.add_argument("--moe-group", type=int, default=0,
+                   help="routing-group size for --moe-experts (0 = per-"
+                        "sequence): dispatch cost per token is linear in "
+                        "the group size; must divide batch*seq_len")
     p.add_argument("--expert", type=int, default=1,
                    help="expert-parallel axis size (with --moe-experts)")
     p.add_argument("--corpus", default=None, help="text file (one doc per line); synthetic if unset")
@@ -98,6 +102,9 @@ def main() -> None:
         p.error("--expert > 1 without --moe-experts just replicates the "
                 "dense model over extra chips; drop --expert or add "
                 "--moe-experts")
+    elif args.moe_group:
+        p.error("--moe-group only applies to the MoE router; add "
+                "--moe-experts or drop it")
     if args.weights and not args.tokenizer:
         p.error("--weights requires --tokenizer (the checkpoint's own vocab); "
                 "a corpus-trained WordPiece vocab would index unrelated embedding rows")
@@ -147,7 +154,8 @@ def main() -> None:
                     "(the GPipe forward emits real logits)")
         cfg = dataclasses.replace(cfg, fused_head_loss=True)
     if args.moe_experts:  # incompatibilities rejected at parse time above
-        cfg = dataclasses.replace(cfg, moe_experts=args.moe_experts)
+        cfg = dataclasses.replace(cfg, moe_experts=args.moe_experts,
+                                  moe_group_size=args.moe_group)
     model = LlamaForCausalLM(cfg)
 
     ds = text_lib.lm_dataset(docs, tok, seq_len=args.seq_len,

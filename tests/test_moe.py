@@ -75,6 +75,56 @@ class TestMoEMLP:
             MoEMLP(16, 32, num_experts=2, top_k=3).init(
                 jax.random.PRNGKey(0), _x())
 
+    def test_group_size_equal_to_seq_is_identity(self):
+        """group_size = S regroups [B, S] into B groups of S — exactly the
+        default per-sequence grouping, so outputs must match bit-for-bit
+        (same einsums, same capacity, same drops)."""
+        x = _x(b=2, s=8, seed=3)
+        base = MoEMLP(16, 32, num_experts=4, top_k=2, dtype=jnp.float32)
+        grouped = MoEMLP(16, 32, num_experts=4, top_k=2, group_size=8,
+                         dtype=jnp.float32)
+        v = base.init(jax.random.PRNGKey(3), x)
+        y0, (a0, d0) = base.apply(v, x)
+        y1, (a1, d1) = grouped.apply(v, x)
+        np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))
+        assert float(a0) == float(a1) and float(d0) == float(d1)
+
+    def test_group_size_invariant_when_capacity_ample(self):
+        """E=1 top-1 with ample capacity: every token goes to the only
+        expert with gate 1 and nothing drops, so the output equals the
+        dense SwiGLU no matter how tokens are grouped — the correctness
+        contract that lets group_size be a pure cost knob."""
+        x = _x(b=2, s=8, seed=4)
+        v = None
+        outs = []
+        for g in (0, 2, 4, 16):  # 16 = B·S: one global group
+            m = MoEMLP(16, 32, num_experts=1, top_k=1, capacity_factor=2.0,
+                       group_size=g, dtype=jnp.float32)
+            v = v or m.init(jax.random.PRNGKey(4), x)
+            y, (_, dropped) = m.apply(v, x)
+            assert float(dropped) == 0.0
+            outs.append(np.asarray(y))
+        for y in outs[1:]:
+            np.testing.assert_allclose(y, outs[0], atol=1e-5, rtol=1e-5)
+
+    def test_group_size_must_divide_tokens(self):
+        with pytest.raises(ValueError, match="group_size"):
+            MoEMLP(16, 32, num_experts=2, group_size=5).init(
+                jax.random.PRNGKey(0), _x(b=2, s=8))
+
+    def test_small_groups_can_only_drop_more(self):
+        """Capacity enforced per group is a strictly tighter constraint
+        than per sequence: at tight capacity the grouped router's drop
+        fraction must be ≥ the per-sequence one (the documented trade)."""
+        x = _x(b=1, s=16, seed=5)
+        kw = dict(num_experts=2, top_k=1, capacity_factor=0.5,
+                  dtype=jnp.float32)
+        base = MoEMLP(16, 32, **kw)
+        v = base.init(jax.random.PRNGKey(5), x)
+        _, (_, d_seq) = base.apply(v, x)
+        _, (_, d_grp) = MoEMLP(16, 32, group_size=4, **kw).apply(v, x)
+        assert float(d_grp) >= float(d_seq) - 1e-9
+
 
 class TestMoELlama:
     def _cfg(self, **kw):
