@@ -140,6 +140,19 @@ class LlamaConfig:
         return LlamaConfig(**kw)
 
     @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        """Llama-2 13B geometry (MHA — 13B predates GQA): the pod-scale
+        step-up of config 5. The analytic budget (utils/memory.py) places
+        the LoRA fine-tune comfortably inside a v4-32 fsdp=8 layout
+        (tests/test_memory.py::test_13b_count_and_v4_32_fsdp_layout_fits);
+        delegates to llama2_7b so the LoRA-implies-bf16-storage policy
+        lives in exactly one place."""
+        base = dict(hidden_size=5120, num_layers=40, num_heads=40,
+                    num_kv_heads=40, intermediate_size=13824)
+        base.update(kw)
+        return LlamaConfig.llama2_7b(**base)
+
+    @staticmethod
     def tiny(**kw) -> "LlamaConfig":
         """4-layer/128-wide config for CPU tests."""
         base = dict(vocab_size=512, hidden_size=128, num_layers=4, num_heads=4,

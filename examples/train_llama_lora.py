@@ -30,7 +30,7 @@ from distributeddeeplearningspark_tpu.train import losses, optim
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--master", default=None)
-    p.add_argument("--variant", default="tiny", choices=["7b", "tiny"])
+    p.add_argument("--variant", default="tiny", choices=["7b", "13b", "tiny"])
     p.add_argument("--weights", default=None, help="HF safetensors file/dir for the base model")
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--batch-size", type=int, default=8)
@@ -133,8 +133,10 @@ def main() -> None:
     else:
         tok = text_lib.WordPieceTokenizer.train(docs.collect(), vocab_size=2048)
 
-    if args.variant == "7b":
-        cfg = LlamaConfig.llama2_7b(lora_rank=args.lora_rank, lora_alpha=args.lora_alpha)
+    if args.variant in ("7b", "13b"):
+        factory = (LlamaConfig.llama2_7b if args.variant == "7b"
+                   else LlamaConfig.llama2_13b)
+        cfg = factory(lora_rank=args.lora_rank, lora_alpha=args.lora_alpha)
         if tok.vocab_size > cfg.vocab_size:
             # nn.Embed's take() silently clamps out-of-range ids under jit —
             # fail loudly instead of training on a wrong embedding row

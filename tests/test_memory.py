@@ -93,6 +93,28 @@ def test_report_scales_with_knobs():
     assert fused.components["loss_head"] < unfused.components["loss_head"] / 4
 
 
+def test_13b_count_and_v4_32_fsdp_layout_fits():
+    """Llama-2 13B (the config-5 pod-scale step-up, MHA geometry): exact
+    param count matches the published 13.0B (+131M untied head), and the
+    LoRA fine-tune budget sits comfortably inside a v4-32 fsdp=8 layout —
+    measured 10.4 GiB/chip of 32 (same analytic model the r4 chip window
+    validated within +2.1%/-5.7% of compiled.memory_analysis())."""
+    counts = llama_param_count(LlamaConfig.llama2_13b())
+    assert 12.9e9 < counts["base"] < 13.2e9, counts
+    cfg = LlamaConfig.llama2_13b(lora_rank=16, fused_head_loss=True,
+                                 remat_policy=None)
+    # bf16 base storage must kick in exactly as in llama2_7b
+    import jax.numpy as jnp
+    assert cfg.param_dtype == jnp.bfloat16
+    rep = llama_memory_report(
+        cfg, batch=8, seq=4096, mesh_shape={"data": 2, "fsdp": 8},
+        hbm_per_chip_gib=32)
+    d = rep.to_dict()
+    assert rep.fits(32 * GiB), d
+    # base params shard 8x: 13.0B * 2B / 8 = ~3.0 GiB/chip
+    assert 2.8 < d["per_chip_gib"]["base_params_bf16"] < 3.3, d
+
+
 def test_7b_fsdp_layout_lowers_abstractly(eight_devices):
     """The REAL 7B geometry traces + SPMD-partitions on a data=1 x fsdp=8
     mesh without materializing a single weight (jax.eval_shape init +
