@@ -209,6 +209,81 @@ class PartitionedDataset:
         return PartitionedDataset([make(g) for g in groups],
                                   infinite=self._infinite)
 
+    def union(self, other: "PartitionedDataset") -> "PartitionedDataset":
+        """Spark ``union``: concatenate partition lists (no dedup, no
+        shuffle — exactly Spark's semantics; partition count is the sum)."""
+        if self._infinite or other._infinite:
+            raise ValueError("union() with an infinite (.repeat()) dataset "
+                             "would never yield the other side's rows")
+        return PartitionedDataset(self._parts + other._parts)
+
+    def sample(self, fraction: float, seed: int = 0) -> "PartitionedDataset":
+        """Spark ``sample(withReplacement=False)``: keep each element with
+        probability ``fraction``, independently per element (deterministic
+        per seed+partition; narrow, no materialization)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+
+        def samp(i: int, it: Iterable[Any]) -> Iterator[Any]:
+            rng = random.Random((seed << 16) ^ i)
+            return (x for x in it if rng.random() < fraction)
+
+        return self.map_partitions_with_index(samp)
+
+    def distinct(self) -> "PartitionedDataset":
+        """Spark ``distinct`` (hashable elements). Honest narrow-engine
+        semantics: per-partition dedup plus a driver-side cross-partition
+        pass on first iteration — there is deliberately no shuffle service
+        (SURVEY §7 'what NOT to build'), so the cross-partition set lives on
+        the driver; output keeps first-occurrence order and collapses to
+        partition 0, like a Spark ``distinct().coalesce(1)``."""
+        self._require_finite("distinct")
+        parts = self._parts
+
+        def gen() -> Iterator[Any]:
+            seen: set = set()
+            for p in parts:
+                for x in p():
+                    if x not in seen:
+                        seen.add(x)
+                        yield x
+
+        return PartitionedDataset([gen])
+
+    def cache(self) -> "PartitionedDataset":
+        """Spark ``cache()``: materialize each partition on first iteration
+        and serve subsequent iterations from memory — for small/medium
+        driver-side data (vocab builds, eval sets iterated per epoch). The
+        ARRAY-scale analog is the record path (`data/records.py`
+        write-once materialization); use that for image/token corpora."""
+        self._require_finite("cache")
+
+        def cached(part: PartitionFn) -> PartitionFn:
+            store: list = []
+            done = [False]
+
+            def gen() -> Iterator[Any]:
+                if done[0]:
+                    return iter(store)
+
+                def fill() -> Iterator[Any]:
+                    # build into a LOCAL list and commit atomically on
+                    # completion: consumers may stop mid-way (take(n)) or
+                    # interleave two live iterators — a shared store would
+                    # be corrupted by the second filler (r4 review repro)
+                    tmp: list = []
+                    for x in part():
+                        tmp.append(x)
+                        yield x
+                    store[:] = tmp
+                    done[0] = True
+
+                return fill()
+
+            return gen
+
+        return PartitionedDataset([cached(p) for p in self._parts])
+
     def zip_with_index(self) -> "PartitionedDataset":
         """(elem, global_index) pairs; forces a driver count of prior partitions."""
         self._require_finite("zip_with_index")

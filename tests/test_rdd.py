@@ -76,6 +76,61 @@ def test_numpy_parallelize():
     np.testing.assert_array_equal(got.reshape(6, 2), arr)
 
 
+def test_union_concatenates_partitions():
+    a = PartitionedDataset.parallelize([1, 2], 2)
+    b = PartitionedDataset.parallelize([3, 4, 5], 1)
+    u = a.union(b)
+    assert u.num_partitions == 3
+    assert u.collect() == [1, 2, 3, 4, 5]
+    import pytest
+
+    with pytest.raises(ValueError, match="union"):
+        a.union(b.repeat())
+
+
+def test_sample_deterministic_and_bounded():
+    ds = PartitionedDataset.parallelize(range(1000), 4)
+    s1 = ds.sample(0.3, seed=7).collect()
+    s2 = ds.sample(0.3, seed=7).collect()
+    assert s1 == s2  # deterministic per seed
+    assert 200 < len(s1) < 400  # ~300 expected
+    assert set(s1) <= set(range(1000))
+    assert ds.sample(0.0).count() == 0
+    assert ds.sample(1.0).count() == 1000
+    import pytest
+
+    with pytest.raises(ValueError, match="fraction"):
+        ds.sample(1.5)
+
+
+def test_distinct_keeps_first_occurrence_order():
+    ds = PartitionedDataset.parallelize([3, 1, 3, 2, 1, 2, 5], 3)
+    assert ds.distinct().collect() == [3, 1, 2, 5]
+
+
+def test_cache_materializes_once_and_survives_partial_reads():
+    calls = [0]
+
+    def gen():
+        calls[0] += 1
+        yield from range(5)
+
+    ds = PartitionedDataset.from_generators([gen]).cache()
+    assert ds.take(2) == [0, 1]   # partial read: cache must NOT freeze this
+    assert ds.collect() == [0, 1, 2, 3, 4]
+    assert ds.collect() == [0, 1, 2, 3, 4]
+    # one partial + one full pass over the source; the last collect was served
+    # from memory
+    assert calls[0] == 2
+    # interleaved live iterators must not corrupt the committed store
+    # (r4 review repro: a shared fill buffer yielded [0..4, 1..4] forever)
+    it = ds.iter_partition(0)
+    next(it)
+    assert ds.collect() == [0, 1, 2, 3, 4]
+    list(it)  # drain the stale iterator
+    assert ds.collect() == [0, 1, 2, 3, 4]
+
+
 def test_pyspark_aliases():
     ds = PartitionedDataset.parallelize(range(4), 2)
     assert ds.mapPartitions(lambda it: (x + 1 for x in it)).collect() == [1, 2, 3, 4]
