@@ -11,6 +11,7 @@ from distributeddeeplearningspark_tpu.models.llama import (
     LlamaConfig,
     LlamaForCausalLM,
     llama2_7b,
+    llama2_13b,
     llama_rules,
     llama_tiny,
     lora_trainable,
@@ -45,6 +46,7 @@ __all__ = [
     "LlamaConfig",
     "LlamaForCausalLM",
     "llama2_7b",
+    "llama2_13b",
     "llama_rules",
     "llama_tiny",
     "lora_trainable",

@@ -480,6 +480,10 @@ def llama2_7b(**kw) -> LlamaForCausalLM:
     return LlamaForCausalLM(LlamaConfig.llama2_7b(**kw))
 
 
+def llama2_13b(**kw) -> LlamaForCausalLM:
+    return LlamaForCausalLM(LlamaConfig.llama2_13b(**kw))
+
+
 def llama_tiny(**kw) -> LlamaForCausalLM:
     return LlamaForCausalLM(LlamaConfig.tiny(**kw))
 
