@@ -21,6 +21,7 @@ from distributeddeeplearningspark_tpu.models.bert import (
     BertEncoder,
     BertForMLM,
     bert_base,
+    bert_large,
     bert_tiny,
 )
 from distributeddeeplearningspark_tpu.models.resnet import (
@@ -37,6 +38,7 @@ __all__ = [
     "BertEncoder",
     "BertForMLM",
     "bert_base",
+    "bert_large",
     "bert_tiny",
     "DLRM",
     "FusedEmbedding",

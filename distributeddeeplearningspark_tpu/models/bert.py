@@ -59,6 +59,17 @@ class BertConfig:
         self.attention_impl = attention_impl
 
     @staticmethod
+    def large(**kw) -> "BertConfig":
+        """BERT-large geometry (Devlin et al. Table 1: 24 layers, 1024
+        hidden, 16 heads, ~340M params) — the scale-up companion to the
+        config-3 contract model; pair with ``optim.lamb`` at pod-scale
+        batch."""
+        base = dict(hidden_size=1024, num_layers=24, num_heads=16,
+                    intermediate_size=4096)
+        base.update(kw)
+        return BertConfig(**base)
+
+    @staticmethod
     def tiny(**kw) -> "BertConfig":
         """4-layer/128-wide config for CPU tests."""
         base = dict(vocab_size=1024, hidden_size=128, num_layers=4, num_heads=4,
@@ -193,6 +204,10 @@ class BertForMLM(nn.Module):
 
 def bert_base(**kw) -> BertForMLM:
     return BertForMLM(BertConfig(**kw))
+
+
+def bert_large(**kw) -> BertForMLM:
+    return BertForMLM(BertConfig.large(**kw))
 
 
 def bert_tiny(**kw) -> BertForMLM:

@@ -13,14 +13,15 @@ import logging
 from distributeddeeplearningspark_tpu import Session, Trainer
 from distributeddeeplearningspark_tpu.data import text as text_lib
 from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
-from distributeddeeplearningspark_tpu.models import bert_base, bert_tiny
+from distributeddeeplearningspark_tpu.models import bert_base, bert_large, bert_tiny
 from distributeddeeplearningspark_tpu.train import losses, optim
 
 
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--master", default=None)
-    p.add_argument("--variant", default="base", choices=["base", "tiny"])
+    p.add_argument("--variant", default="base",
+                   choices=["base", "large", "tiny"])
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--seq-len", type=int, default=128)
@@ -86,7 +87,8 @@ def main() -> None:
         print(f"input token stats: {stats}")
     ds = ds.repeat()
 
-    make = bert_base if args.variant == "base" else bert_tiny
+    make = {"base": bert_base, "large": bert_large,
+            "tiny": bert_tiny}[args.variant]
     model = make(vocab_size=tok.vocab_size, max_position=max(args.seq_len, 128))
     tx = optim.with_grad_clip(
         optim.adamw(optim.warmup_linear(args.lr, args.warmup, args.steps)), 1.0

@@ -7,7 +7,7 @@ import optax
 
 from distributeddeeplearningspark_tpu.data import text as text_lib
 from distributeddeeplearningspark_tpu.data.feed import host_batches, put_global
-from distributeddeeplearningspark_tpu.models import bert_tiny
+from distributeddeeplearningspark_tpu.models import bert_large, bert_tiny
 from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
 from distributeddeeplearningspark_tpu.parallel.sharding import REPLICATED
 from distributeddeeplearningspark_tpu.train import losses, optim, step as step_lib
@@ -73,6 +73,19 @@ def test_bert_forward_shapes():
     logits = model.apply(variables, batch, train=False)
     assert logits.shape == (2, 32, model.cfg.vocab_size)
     assert logits.dtype == jnp.float32
+
+
+def test_bert_large_geometry_param_count():
+    """BertConfig.large must be the published BERT-large: ~340M params
+    (Devlin et al. Table 1), counted abstractly via eval_shape — no 340M
+    f32 init on the test host."""
+    model = bert_large()
+    batch = {"input_ids": jax.ShapeDtypeStruct((1, 16), np.int32)}
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), batch, train=False))
+    n = sum(int(np.prod(leaf.shape))
+            for leaf in jax.tree_util.tree_leaves(abstract))
+    assert 3.2e8 < n < 3.6e8, n
 
 
 def test_tied_decoder_shares_embedding():
