@@ -16,14 +16,27 @@ import numpy as np
 from distributeddeeplearningspark_tpu import Session, Trainer
 from distributeddeeplearningspark_tpu.data import vision
 from distributeddeeplearningspark_tpu.data.sources import synthetic_images
-from distributeddeeplearningspark_tpu.models import ResNet18, ResNet50
+from distributeddeeplearningspark_tpu.models import (
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
 from distributeddeeplearningspark_tpu.train import losses, optim
+
+RESNETS = {
+    "resnet18": ResNet18, "resnet34": ResNet34, "resnet50": ResNet50,
+    "resnet101": ResNet101, "resnet152": ResNet152,
+}
 
 
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--master", default=None)
-    p.add_argument("--variant", default="resnet50", choices=["resnet18", "resnet50"])
+    p.add_argument("--variant", default="resnet50",
+                   choices=["resnet18", "resnet34", "resnet50", "resnet101",
+                            "resnet152"])
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument("--image-size", type=int, default=224)
@@ -113,7 +126,7 @@ def main() -> None:
         )
     ds = vision.imagenet_train(ds, size=args.image_size, repeat=True)
 
-    model = (ResNet50 if args.variant == "resnet50" else ResNet18)(num_classes=args.num_classes)
+    model = RESNETS[args.variant](num_classes=args.num_classes)
     schedule = optim.warmup_cosine(args.lr, warmup_steps=min(args.steps // 10, 500),
                                    total_steps=args.steps)
     trainer = Trainer(
@@ -126,10 +139,15 @@ def main() -> None:
         from distributeddeeplearningspark_tpu.models.resnet_io import (
             import_torchvision_resnet)
 
+        from distributeddeeplearningspark_tpu.models.resnet import (
+            BottleneckBlock)
+
         sd = torch.load(args.weights, map_location="cpu", weights_only=True)
-        stage_sizes = (3, 4, 6, 3) if args.variant == "resnet50" else (2, 2, 2, 2)
+        # derive the import layout from the model itself so the table can't
+        # drift from models/resnet.py
         params, stats = import_torchvision_resnet(
-            sd, stage_sizes=stage_sizes, bottleneck=args.variant == "resnet50")
+            sd, stage_sizes=tuple(model.stage_sizes),
+            bottleneck=issubclass(model.block_cls, BottleneckBlock))
         if args.num_classes != np.shape(params["head"]["bias"])[0]:
             # fine-tuning to a new label space: keep the fresh-init head
             params.pop("head")
