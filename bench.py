@@ -328,7 +328,8 @@ def _bert_packing_economics(raw_tok_per_sec: float) -> dict:
 
 
 def _llama_09b_cfg(*, seq: int = 2048, fused_head: bool = False,
-                   moe_experts: int = 0, moe_group: int = 0):
+                   moe_experts: int = 0, moe_group: int = 0,
+                   base_quant: str | None = None):
     """THE 0.9b bench config — one definition shared by bench_llama and
     bench_memval, so the memory validation can never drift from the shape
     the series actually runs (a review caught exactly that: memval carrying
@@ -351,6 +352,7 @@ def _llama_09b_cfg(*, seq: int = 2048, fused_head: bool = False,
         moe_experts=moe_experts,
         moe_top_k=min(2, moe_experts) if moe_experts else 2,
         moe_group_size=moe_group,
+        base_quant=base_quant,
         # keep matmul outputs across the remat boundary: measured 429→391
         # ms (19.1k→21.0k tok/s) on this shape at b=4; b≥6 OOMs 16G HBM
         # with it, so the policy pays exactly while the batch still fits.
@@ -368,7 +370,7 @@ def _llama_09b_cfg(*, seq: int = 2048, fused_head: bool = False,
 def bench_llama(iters: int, batch_size: int | None = None, seq: int = 2048,
                 fused_head: bool = False, variant: str = "0.9b",
                 segment_ids: bool = False, moe_experts: int = 0,
-                moe_group: int = 0) -> dict:
+                moe_group: int = 0, base_quant: str | None = None) -> dict:
     """Llama LoRA fine-tune tokens/sec/chip (BASELINE.json config 5 shape).
 
     ``variant="0.9b"`` (default): single-chip-sized geometry (~0.9B params,
@@ -416,7 +418,8 @@ def bench_llama(iters: int, batch_size: int | None = None, seq: int = 2048,
         # cotangent doubles it — fused CE is mandatory at this margin
         cfg = LlamaConfig.llama2_7b(
             lora_rank=16, dtype="bfloat16", max_position=seq,
-            remat_policy=None, fused_head_loss=True)
+            remat_policy=None, fused_head_loss=True,
+            base_quant=base_quant)
     elif variant == "tiny":
         batch_size, seq = min(batch_size or 2, 2), min(seq, 256)
         cfg = LlamaConfig(
@@ -426,11 +429,13 @@ def bench_llama(iters: int, batch_size: int | None = None, seq: int = 2048,
             moe_experts=moe_experts,
             moe_top_k=min(2, moe_experts) if moe_experts else 2,
             moe_group_size=moe_group,
+            base_quant=base_quant,
             fused_head_loss=fused_head)
     else:
         batch_size = 4 if batch_size is None else batch_size
         cfg = _llama_09b_cfg(seq=seq, fused_head=fused_head,
-                             moe_experts=moe_experts, moe_group=moe_group)
+                             moe_experts=moe_experts, moe_group=moe_group,
+                             base_quant=base_quant)
     # the config builders may force fused CE on (7b always; 0.9b at s≥16384)
     # — the loss choice below must follow the config, not the CLI flag
     fused_head = cfg.fused_head_loss
@@ -574,6 +579,7 @@ def bench_llama(iters: int, batch_size: int | None = None, seq: int = 2048,
         "fused_head_loss": fused_head,
         "segment_ids": segment_ids,
         "param_dtype": str(cfg.param_dtype),
+        "base_quant": cfg.base_quant,
         **moe_fields,
         "memory_report": mem_report,
         "memory_v4_32": mem_v4_32,
@@ -1054,6 +1060,14 @@ CHIP_QUEUE: list[tuple[str, list[str], int]] = [
     # dump tail; BASELINE.md "r4 (next chip window)" item 5).
     ("llama_7b_b2", ["--model", "llama", "--variant", "7b", "--batch", "2",
                      "--seq", "1024", "--iters", "5", "--skip-smoke"], 1500),
+    # int8 frozen base (QLoRA-style, r4 session-2): base 12.6 → ~6.3 GiB
+    # per the validated analytic budget, so b=2 s=2048 should FIT where
+    # bf16 b=2 is borderline — and the bf16-vs-int8 tok/s delta prices
+    # the dequant-in-matmul cost on the MXU. Both outcomes are evidence.
+    ("llama_7b_int8_b2", ["--model", "llama", "--variant", "7b",
+                          "--base-quant", "int8", "--batch", "2",
+                          "--seq", "2048", "--iters", "5",
+                          "--skip-smoke"], 1500),
 ]
 
 
@@ -1187,6 +1201,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "the group, so g<S prices the GShard grouping "
                          "lever; must divide B*S. Rejected without "
                          "--moe-experts (would silently bench dense)")
+    ap.add_argument("--base-quant", default=None, choices=["int8"],
+                    help="llama only: QLoRA-style int8 frozen-base storage "
+                         "(per-out-channel absmax scales; base HBM bytes "
+                         "halve again vs bf16 — at 7B the base drops to "
+                         "~6.3 GiB, the b=2 single-chip lever)")
     ap.add_argument("--fused-head-loss", action="store_true",
                     help="llama only: fuse the LM-head matmul into the loss "
                          "(A/B vs materialized [B,S,V] logits)")
@@ -1200,6 +1219,10 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.base_quant and args.model not in ("llama", "all"):
+        # mirror --moe-group: a silently ignored flag would let a bf16 run
+        # masquerade as the int8 number
+        parser.error("--base-quant only applies to the llama bench")
     if args.moe_group and not args.moe_experts:
         # mirror the config-5 driver's guard: with moe_experts=0 no MoE
         # layer is built, so the flag would silently bench plain dense
@@ -1313,6 +1336,7 @@ def main(argv=None) -> int:
             segment_ids=args.segment_ids,
             moe_experts=args.moe_experts,
             moe_group=args.moe_group,
+            base_quant=args.base_quant,
             variant=args.variant,
             **({"batch_size": args.batch} if args.batch else {}),
             **({"seq": args.seq} if args.seq else {})),

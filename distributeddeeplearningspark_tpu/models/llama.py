@@ -98,6 +98,18 @@ class LlamaConfig:
     # the GShard dense-dispatch overhead (the r4 1.33×-dense floor) at the
     # price of per-group capacity enforcement; must divide B·S.
     moe_group_size: int = 0
+    # QLoRA-style int8 base storage ("int8" | None). One step below bf16:
+    # every frozen projection/FFN base kernel is stored int8 with a per-
+    # output-channel f32 scale (absmax), dequantized INTO the matmul (the
+    # int8→bf16 convert+multiply fuses as a dot-operand read, so HBM sees
+    # ~1 byte/weight). Frozen-base LoRA only — the base never takes an
+    # optimizer step, so storage precision is a pure memory/bandwidth
+    # knob: 7B base drops 12.6 → ~6.3 GiB (b=2 headroom on a 16 GiB
+    # chip; decode's per-token weight reads halve). Embeddings, LM head
+    # and norm scales stay at param_dtype/f32 (QLoRA convention —
+    # quantizing the embedding hurts quality for no meaningful bytes).
+    # Requires lora_rank > 0; rejected with MoE (experts train).
+    base_quant: str | None = None
     # LoRA (rank 0 = disabled → plain full-parameter model)
     lora_rank: int = 0
     lora_alpha: float = 16.0
@@ -221,25 +233,54 @@ class LoRADenseGeneral(nn.Module):
     use_bias: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32  # base-kernel STORAGE; A/B stay f32
+    base_quant: str | None = None   # "int8": kernel int8 + per-out-channel scale
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        y = nn.DenseGeneral(self.features, axis=self.axis, use_bias=self.use_bias,
-                            dtype=self.dtype, param_dtype=self.param_dtype,
-                            name="base")(x)
+        axes = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
+        axes = tuple(a % x.ndim for a in axes)
+        feats = (self.features,) if isinstance(self.features, int) else tuple(self.features)
+        in_dim = math.prod(x.shape[a] for a in axes)
+        batch_shape = tuple(s for i, s in enumerate(x.shape) if i not in axes)
+
+        def fold(t: jax.Array) -> jax.Array:  # x → [batch..., in_dim]
+            t = jnp.moveaxis(t, axes, range(t.ndim - len(axes), t.ndim))
+            return t.reshape(batch_shape + (in_dim,)).astype(self.dtype)
+
+        if self.base_quant == "int8":
+            if self.use_bias:
+                raise NotImplementedError("int8 base_quant has no bias path")
+            # Deterministic shared init scale (≈clip at 4σ of lecun-normal)
+            # keeps kernel/scale self-consistent under random init; real
+            # use quantizes pretrained weights via
+            # llama_io.quantize_base_int8 (per-channel absmax).
+            q0 = 4.0 / math.sqrt(in_dim) / 127.0
+
+            def qinit(key, shape, _dtype=jnp.int8):
+                w = nn.initializers.lecun_normal()(
+                    key, (shape[0], math.prod(shape[1:])), jnp.float32)
+                return jnp.clip(jnp.round(w / q0), -127, 127).astype(
+                    jnp.int8).reshape(shape)
+
+            kernel_q = self.param("base_q8", qinit, (in_dim,) + feats)
+            scale = self.param("base_scale",
+                               lambda _k, shape: jnp.full(shape, q0, jnp.float32),
+                               feats)
+            # dequant rides the dot's operand read (convert+mul fuse into
+            # the matmul on TPU): HBM traffic stays ~1 byte/weight
+            w = kernel_q.astype(self.dtype) * scale.astype(self.dtype)
+            y = fold(x) @ w.reshape(in_dim, math.prod(feats))
+            y = y.reshape(batch_shape + feats)
+        else:
+            y = nn.DenseGeneral(self.features, axis=self.axis,
+                                use_bias=self.use_bias, dtype=self.dtype,
+                                param_dtype=self.param_dtype, name="base")(x)
         if self.rank:
-            axes = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
-            axes = tuple(a % x.ndim for a in axes)
-            feats = (self.features,) if isinstance(self.features, int) else tuple(self.features)
-            in_dim = math.prod(x.shape[a] for a in axes)
-            batch_shape = tuple(s for i, s in enumerate(x.shape) if i not in axes)
             a_mat = self.param("lora_a", nn.initializers.he_uniform(), (in_dim, self.rank),
                                jnp.float32)
             b_mat = self.param("lora_b", nn.initializers.zeros,
                                (self.rank, math.prod(feats)), jnp.float32)
-            x2 = jnp.moveaxis(x, axes, range(x.ndim - len(axes), x.ndim))
-            x2 = x2.reshape(batch_shape + (in_dim,)).astype(self.dtype)
-            delta = (x2 @ a_mat.astype(self.dtype)) @ b_mat.astype(self.dtype)
+            delta = (fold(x) @ a_mat.astype(self.dtype)) @ b_mat.astype(self.dtype)
             delta = delta.reshape(batch_shape + feats) * (self.alpha / self.rank)
             y = y + delta.astype(y.dtype)
         return y
@@ -258,7 +299,8 @@ class LlamaAttention(nn.Module):
             rank = cfg.lora_rank if name in cfg.lora_targets else 0
             return LoRADenseGeneral((heads, hd), rank=rank, alpha=cfg.lora_alpha,
                                     dtype=cfg.dtype,
-                                    param_dtype=cfg.param_dtype, name=name)
+                                    param_dtype=cfg.param_dtype,
+                                    base_quant=cfg.base_quant, name=name)
 
         q = proj("wq", nh)(x)                                   # [B,S,nh,hd]
         k = proj("wk", nkv)(x)
@@ -286,7 +328,8 @@ class LlamaAttention(nn.Module):
         rank = cfg.lora_rank if "wo" in cfg.lora_targets else 0
         return LoRADenseGeneral(cfg.hidden_size, axis=(-2, -1), rank=rank,
                                 alpha=cfg.lora_alpha, dtype=cfg.dtype,
-                                param_dtype=cfg.param_dtype, name="wo")(y)
+                                param_dtype=cfg.param_dtype,
+                                base_quant=cfg.base_quant, name="wo")(y)
 
     def _decode_attend(self, q, k, v):
         """KV-cached attention: append the T new tokens at the cache index,
@@ -330,7 +373,8 @@ class LlamaMLP(nn.Module):
             rank = cfg.lora_rank if name in cfg.lora_targets else 0
             return LoRADenseGeneral(feats, axis=axis, rank=rank, alpha=cfg.lora_alpha,
                                     dtype=cfg.dtype,
-                                    param_dtype=cfg.param_dtype, name=name)
+                                    param_dtype=cfg.param_dtype,
+                                    base_quant=cfg.base_quant, name=name)
 
         gate = proj("gate", cfg.intermediate_size)(x)
         up = proj("up", cfg.intermediate_size)(x)
@@ -402,6 +446,22 @@ class LlamaForCausalLM(nn.Module):
             raise ValueError(
                 f"sequence length {ids.shape[1]} exceeds max_position {cfg.max_position}"
             )
+        if cfg.base_quant is not None:
+            if cfg.base_quant != "int8":
+                raise ValueError(f"unknown base_quant {cfg.base_quant!r}; "
+                                 "supported: 'int8'")
+            if not cfg.lora_rank:
+                # int8 leaves carry float0 tangents — full-parameter
+                # training would feed them to the optimizer; the quantized
+                # base only makes sense frozen under adapters
+                raise ValueError("base_quant='int8' requires lora_rank > 0 "
+                                 "(frozen-base LoRA; train with "
+                                 "trainable=lora_trainable)")
+            if cfg.moe_experts:
+                raise NotImplementedError(
+                    "base_quant with moe_experts: the expert bank TRAINS "
+                    "from scratch (f32) — quantizing it would silently "
+                    "freeze garbage; drop one of the two")
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="token_embed")(ids)
         pad = batch.get("attention_mask")
@@ -526,6 +586,20 @@ def llama_rules(cfg: LlamaConfig, *, fsdp: bool = True,
         (r"wo/base/kernel", P(*lead, "tensor", None, None)),
         (r"(gate|up)/base/kernel", P(*lead, None, "tensor")),
         (r"down/base/kernel", P(*lead, "tensor", None)),
+        # int8 base (base_quant): kernels mirror their bf16 siblings'
+        # layouts; per-out-channel scales follow the kernel's OUTPUT dims
+        # (wo/down outputs are the psum'd hidden dim → replicated)
+        # int8 kernels fold input axes: wq/wk/wv stay (in, heads, hd)
+        # like their dense siblings, but wo folds (heads, hd) → one 2-D
+        # (heads*hd, hidden) contracting-sharded kernel
+        *(((r"(wq|wk|wv)/base_q8", P(*lead, None, "tensor", None)),
+           (r"(wq|wk|wv)/base_scale", P(*lead, "tensor", None)),
+           (r"wo/base_q8", P(*lead, "tensor", None)),
+           (r"(gate|up)/base_q8", P(*lead, None, "tensor")),
+           (r"(gate|up)/base_scale", P(*lead, "tensor")),
+           (r"(wo|down)/base_scale", P(*lead, None)),
+           (r"down/base_q8", P(*lead, "tensor", None)),
+           ) if cfg.base_quant else ()),
         (r"token_embed/embedding", P("tensor", None)),
         (r"lm_head/kernel", P(None, "tensor")),
         # MoE expert bank: stacked expert kernels shard over `expert`

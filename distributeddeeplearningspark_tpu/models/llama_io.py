@@ -156,6 +156,12 @@ def export_llama_safetensors(params: dict, cfg: LlamaConfig, path: str) -> None:
     from safetensors.numpy import save_file
 
     flat = _flatten(params)
+    if any(k.endswith("base_q8") for k in flat):
+        raise NotImplementedError(
+            "export of an int8-quantized tree: HF interchange has no "
+            "per-channel-scale layout for it — export the DENSE tree you "
+            "quantized from (quantization is lossy; there is no faithful "
+            "int8 → HF bf16 inverse)")
     h = cfg.hidden_size
     out: dict[str, np.ndarray] = {}
     out["model.embed_tokens.weight"] = np.asarray(flat["token_embed/embedding"])
@@ -206,6 +212,13 @@ def merge_lora(params: dict, cfg: LlamaConfig) -> dict:
     def merge_node(node):
         if not isinstance(node, dict):
             return node
+        if "base_q8" in node:
+            raise NotImplementedError(
+                "merge_lora on an int8-quantized tree would bake absmax "
+                "re-quantization error into the merged weights; merge on "
+                "the dense tree FIRST, then quantize_base_int8 the result "
+                "(or keep adapters separate — int8 decode serves them "
+                "unmerged)")
         if "lora_a" in node and "base" in node:
             a, b = np.asarray(node["lora_a"]), np.asarray(node["lora_b"])
             kernel = np.asarray(node["base"]["kernel"])
@@ -219,6 +232,63 @@ def merge_lora(params: dict, cfg: LlamaConfig) -> dict:
         return {k: merge_node(v) for k, v in node.items()}
 
     return merge_node(params)
+
+
+def quantize_base_int8(params: dict) -> dict:
+    """Quantize every frozen base kernel to int8 + per-output-channel f32
+    absmax scales — the tree transform that turns a dense (f32/bf16) Llama
+    param tree into the shapes a ``base_quant='int8'`` model expects.
+
+    Each ``.../<proj>/base/kernel`` node becomes ``<proj>/base_q8`` (int8,
+    input axes folded to one leading dim, matching LoRADenseGeneral's int8
+    layout) + ``<proj>/base_scale`` (f32, the kernel's output dims).
+    Scanned stacks keep their leading [L] on both. Per-channel absmax:
+    q = round(W/s), s = max|W_channel|/127 — max quantization error is
+    s/2 per weight (≤0.4% of the channel's absmax). Embeddings, LM head,
+    norms, and LoRA adapters pass through untouched (QLoRA convention).
+
+    Use after :func:`load_llama_safetensors` (or on any trained tree) and
+    feed the result to ``Trainer.load_pretrained`` on an int8-config model.
+    """
+
+    def walk(tree, scanned=False):
+        out = {}
+        for k, v in tree.items():
+            if k == "layers":
+                out[k] = walk(v, scanned=True)
+                continue
+            if isinstance(v, dict) and "base" in v and \
+                    isinstance(v["base"], dict) and "kernel" in v["base"]:
+                w = np.asarray(v["base"]["kernel"], np.float32)
+                lead = 1 if scanned else 0
+                # output dims: (heads, hd) for wq/wk/wv; 1 dim otherwise.
+                # wo's kernel is [.., nh, hd, h]: TWO input dims to fold.
+                if k in ("wq", "wk", "wv"):
+                    n_in, out_dims = 1, 2
+                elif k == "wo":
+                    n_in, out_dims = 2, 1
+                else:  # gate/up/down
+                    n_in, out_dims = 1, 1
+                assert w.ndim == lead + n_in + out_dims, (k, w.shape)
+                l_shape = w.shape[:lead]
+                in_dim = int(np.prod(w.shape[lead:lead + n_in]))
+                feats = w.shape[lead + n_in:]
+                w2 = w.reshape(l_shape + (in_dim,) + feats)
+                # per-(L, out-channel) absmax over the folded input axis
+                s = np.max(np.abs(w2), axis=lead) / 127.0        # [L?, *feats]
+                s = np.maximum(s, 1e-12)
+                q = np.clip(np.round(w2 / np.expand_dims(s, lead)),
+                            -127, 127).astype(np.int8)
+                rest = {kk: vv for kk, vv in v.items() if kk != "base"}
+                out[k] = {"base_q8": q, "base_scale": s.astype(np.float32),
+                          **walk(rest, scanned)}
+            elif isinstance(v, dict):
+                out[k] = walk(v, scanned)
+            else:
+                out[k] = v
+        return out
+
+    return walk(params)
 
 
 def _flatten(tree: dict, prefix: str = "") -> dict[str, Any]:

@@ -117,12 +117,23 @@ def make_train_step(
             loss, metrics = loss_fn(outputs, mb)
             return loss, (metrics, updated)
 
-        grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+        # allow_int: int8 frozen-base leaves (LlamaConfig.base_quant)
+        # are valid params that can never receive a real gradient — jax
+        # hands back float0 for them, normalized to typed zeros below so
+        # optax transforms and the accumulation scan stay dtype-stable
+        grad_fn = jax.value_and_grad(loss_of, has_aux=True, allow_int=True)
+
+        def detyped(grads):
+            return jax.tree.map(
+                lambda g, p: jnp.zeros_like(p)
+                if g.dtype == jax.dtypes.float0 else g,
+                grads, state.params)
 
         if accum_steps == 1:
             (_, (metrics, updated)), grads = grad_fn(
                 state.params, state.mutable, batch, rngs
             )
+            grads = detyped(grads)
             metrics = dict(metrics)
         else:
             def split_leaf(x):
@@ -140,6 +151,7 @@ def make_train_step(
                 mb, idx = xs
                 mb_rngs = {n: jax.random.fold_in(r, idx) for n, r in rngs.items()}
                 (_, (m, updated)), g = grad_fn(state.params, mutable, mb, mb_rngs)
+                g = detyped(g)
                 mutable = {**mutable, **updated} if mutable_keys else mutable
                 gsum = jax.tree.map(jnp.add, gsum, g)
                 return (mutable, gsum), m

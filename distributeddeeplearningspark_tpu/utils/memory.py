@@ -75,6 +75,9 @@ def llama_param_count(cfg) -> dict[str, int]:
         + 2 * h          # two RMSNorm scales
     )
     base = cfg.num_layers * per_layer + v * h + h + v * h  # + final norm + head
+    if getattr(cfg, "base_quant", None) == "int8":
+        # per-output-channel scale leaves ride next to every int8 kernel
+        base += cfg.num_layers * (3 * h + 2 * kvh + 2 * i)
     lora = 0
     if cfg.lora_rank:
         r = cfg.lora_rank
@@ -120,8 +123,30 @@ def llama_memory_report(
     # 7B) — the byte count must come from the config, not an assumption
     pdt = str(getattr(cfg, "param_dtype", "float32"))
     pbytes = 2 if ("bfloat16" in pdt or "float16" in pdt) else 4
-    comp[f"base_params_{'bf16' if pbytes == 2 else 'f32'}"] = (
-        counts["base"] * pbytes / param_shard)
+    if getattr(cfg, "base_quant", None) == "int8":
+        # int8 projection/FFN kernels + f32 per-out-channel scales; the
+        # embedding and LM head stay at param_dtype (QLoRA convention,
+        # see LlamaConfig.base_quant). Scales are per output channel —
+        # ≤ (heads·hd + i + h) per layer, O(1e-3) of the kernel bytes.
+        emb_head = 2 * cfg.vocab_size * cfg.hidden_size
+        norms = cfg.num_layers * 2 * cfg.hidden_size + cfg.hidden_size
+        scales = cfg.num_layers * (
+            2 * cfg.hidden_size                       # wq out + wo out
+            + 2 * cfg.num_kv_heads * cfg.head_dim     # wk, wv out
+            + 2 * cfg.intermediate_size               # gate, up out
+            + cfg.hidden_size)                        # down out
+        # counts["base"] already includes the scale leaves (param-count
+        # parity with model.init) — subtract them so they aren't charged
+        # once at 1 B here and again at 4 B below
+        kernels = counts["base"] - emb_head - norms - scales
+        comp["base_params_int8"] = (
+            kernels * 1 + (scales + norms) * 4 + emb_head * pbytes
+        ) / param_shard
+        notes.append("base_quant=int8: kernels 1 B + f32 scales; "
+                     "embed/head at param_dtype")
+    else:
+        comp[f"base_params_{'bf16' if pbytes == 2 else 'f32'}"] = (
+            counts["base"] * pbytes / param_shard)
 
     n_lora = counts["lora"]
     if trainable == "lora" and cfg.lora_rank:

@@ -258,6 +258,133 @@ class TestLoRA:
             np.asarray(out_adapted), np.asarray(out_merged), atol=2e-5)
 
 
+class TestInt8Base:
+    """QLoRA-style int8 frozen-base storage (LlamaConfig.base_quant)."""
+
+    def _cfgs(self):
+        dense = LlamaConfig.tiny(remat=False, lora_rank=4)
+        q = LlamaConfig.tiny(remat=False, lora_rank=4, base_quant="int8")
+        return dense, q
+
+    def test_quantize_transform_parity(self):
+        """quantize_base_int8(dense tree) must (a) produce exactly the int8
+        model's param shapes/dtypes and (b) preserve the forward within
+        per-channel absmax quantization error."""
+        dense_cfg, q_cfg = self._cfgs()
+        batch = make_batch()
+        dense_params = LlamaForCausalLM(dense_cfg).init(
+            jax.random.PRNGKey(0), batch, train=False)["params"]
+        q_params = llama_io.quantize_base_int8(
+            jax.tree.map(np.asarray, dense_params))
+        # shapes/dtypes must match the int8 model's own init exactly
+        want = LlamaForCausalLM(q_cfg).init(
+            jax.random.PRNGKey(0), batch, train=False)["params"]
+        flat_q = {path_str(p): x for p, x in
+                  jax.tree_util.tree_flatten_with_path(q_params)[0]}
+        flat_w = {path_str(p): x for p, x in
+                  jax.tree_util.tree_flatten_with_path(want)[0]}
+        assert flat_q.keys() == flat_w.keys()
+        for k in flat_w:
+            assert np.shape(flat_q[k]) == np.shape(flat_w[k]), k
+            if "base_q8" in k:
+                assert np.asarray(flat_q[k]).dtype == np.int8, k
+        out_dense = LlamaForCausalLM(dense_cfg).apply(
+            {"params": dense_params}, batch, train=False)
+        out_q = LlamaForCausalLM(q_cfg).apply(
+            {"params": q_params}, batch, train=False)
+        # int8 absmax error is ≤ scale/2 per weight; at tiny width the
+        # logits stay close — this bounds gross layout/scale mistakes
+        # (a wrong fold axis or scale broadcast blows this to O(1))
+        err = np.abs(np.asarray(out_q, np.float32)
+                     - np.asarray(out_dense, np.float32))
+        ref = np.abs(np.asarray(out_dense, np.float32)).max()
+        assert err.max() < 0.05 * ref, (err.max(), ref)
+
+    def test_frozen_training_step_and_memory(self):
+        """A masked-LoRA train step on the int8 model: loss finite, adapters
+        move, int8 kernels and scales bit-frozen; the memory model prices
+        the base at ~1 byte/weight."""
+        _, q_cfg = self._cfgs()
+        model = LlamaForCausalLM(q_cfg)
+        mesh = MeshSpec(data=-1).build()
+        tx = optim.masked(optax.adamw(1e-2), lora_trainable)
+        batch = stack_examples(
+            [{"input_ids": r} for r in make_batch(8, 16)["input_ids"]])
+        state, shardings = step_lib.init_state(
+            model, tx, batch, mesh, llama_rules(q_cfg))
+        before = {path_str(p): np.asarray(x) for p, x in
+                  jax.tree_util.tree_flatten_with_path(state.params)[0]}
+        train = step_lib.jit_train_step(
+            step_lib.make_train_step(model.apply, tx, losses.causal_lm,
+                                     trainable=lora_trainable),
+            mesh, shardings)
+        state, metrics = train(state, put_global(batch, mesh))
+        assert np.isfinite(float(jax.device_get(metrics["loss"])))
+        after = {path_str(p): np.asarray(x) for p, x in
+                 jax.tree_util.tree_flatten_with_path(state.params)[0]}
+        for pstr, old in before.items():
+            if "lora_b" in pstr:
+                assert np.abs(after[pstr] - old).max() > 0, pstr
+            elif "lora" not in pstr:
+                np.testing.assert_array_equal(after[pstr], old, err_msg=pstr)
+
+        from distributeddeeplearningspark_tpu.utils.memory import (
+            llama_memory_report, llama_param_count)
+
+        # exact param count (incl. scale leaves) vs the real tree
+        n_leaves = sum(int(np.prod(np.shape(x))) for x in before.values())
+        counts = llama_param_count(q_cfg)
+        assert counts["base"] + counts["lora"] == n_leaves
+        rep = llama_memory_report(q_cfg, batch=2, seq=16).to_dict()
+        assert "base_params_int8" in rep["per_chip_gib"]
+
+    def test_7b_int8_budget_headroom(self):
+        """The point of the knob: the 7B base drops ~12.6 → ~6.3 GiB, so the
+        single-chip (16 GiB) budget gains ~6 GiB of batch/context headroom."""
+        from distributeddeeplearningspark_tpu.utils.memory import (
+            llama_memory_report)
+
+        bf16 = LlamaConfig.llama2_7b(lora_rank=16, fused_head_loss=True,
+                                     remat_policy=None)
+        q = LlamaConfig.llama2_7b(lora_rank=16, fused_head_loss=True,
+                                  remat_policy=None, base_quant="int8")
+        r16 = llama_memory_report(bf16, batch=1, seq=2048).to_dict()
+        rq = llama_memory_report(q, batch=1, seq=2048).to_dict()
+        saved = r16["total_gib_per_chip"] - rq["total_gib_per_chip"]
+        assert 5.0 < saved < 7.0, (r16["total_gib_per_chip"],
+                                   rq["total_gib_per_chip"])
+
+    def test_io_guards_on_quantized_trees(self):
+        """merge_lora / export on an int8 tree must refuse loudly — a
+        silent unmerged return or a KeyError would break the deploy path
+        (r4 review finding)."""
+        dense_cfg, q_cfg = self._cfgs()
+        batch = make_batch()
+        dense_params = LlamaForCausalLM(dense_cfg).init(
+            jax.random.PRNGKey(0), batch, train=False)["params"]
+        q_params = llama_io.quantize_base_int8(
+            jax.tree.map(np.asarray, dense_params))
+        with pytest.raises(NotImplementedError, match="dense tree"):
+            llama_io.merge_lora(q_params, q_cfg)
+        with pytest.raises(NotImplementedError, match="DENSE tree"):
+            llama_io.export_llama_safetensors(q_params, q_cfg, "/tmp/x.st")
+
+    def test_guards(self):
+        batch = make_batch()
+        with pytest.raises(ValueError, match="lora_rank"):
+            LlamaForCausalLM(LlamaConfig.tiny(base_quant="int8")).init(
+                jax.random.PRNGKey(0), batch, train=False)
+        with pytest.raises(NotImplementedError, match="expert"):
+            LlamaForCausalLM(LlamaConfig.tiny(
+                base_quant="int8", lora_rank=4, moe_experts=2,
+                intermediate_size=64)).init(
+                    jax.random.PRNGKey(0), batch, train=False)
+        with pytest.raises(ValueError, match="base_quant"):
+            LlamaForCausalLM(LlamaConfig.tiny(
+                base_quant="int4", lora_rank=4)).init(
+                    jax.random.PRNGKey(0), batch, train=False)
+
+
 def test_fsdp_tp_sharded_train_step(eight_devices):
     """FSDP×TP mesh: params actually sharded, step runs, grads sync (config 5)."""
     cfg = LlamaConfig.tiny(lora_rank=4)

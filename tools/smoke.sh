@@ -6,7 +6,7 @@
 # shape). Appends one audit line per driver to SMOKE_LOG.md.
 #
 #   bash tools/smoke.sh          # all five (~10 min on one contended core)
-#   bash tools/smoke.sh mnist    # just one
+#   bash tools/smoke.sh mnist [bert ...]   # a subset
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -30,7 +30,7 @@ GREP[llama]="moe_aux"
 }
 
 overall=0
-for d in ${1:-mnist resnet bert dlrm llama}; do
+for d in "${@:-mnist resnet bert dlrm llama}"; do
   if [ -z "${CMD[$d]:-}" ]; then
     echo "unknown driver '$d'; valid: ${!CMD[*]}" >&2
     exit 2
