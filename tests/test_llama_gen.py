@@ -24,11 +24,10 @@ def tiny():
     return cfg, model, variables["params"], prompt
 
 
-def test_decode_logits_match_teacher_forcing(tiny):
-    """Prefill + per-token decode reproduce the full-forward logits exactly
+def _assert_decode_matches_teacher_forcing(cfg, model, params, seed):
+    """Prefill + per-token decode must reproduce the full-forward logits
     (the KV cache holds the same K/V the training path recomputes)."""
-    cfg, model, params, _ = tiny
-    rng = np.random.default_rng(1)
+    rng = np.random.default_rng(seed)
     ids = rng.integers(0, cfg.vocab_size, (2, 12)).astype(np.int32)
     ref = model.apply({"params": params}, {"input_ids": ids}, train=False)
     dmodel = decode_model(cfg, 12)
@@ -44,6 +43,24 @@ def test_decode_logits_match_teacher_forcing(tiny):
         cache = mut["cache"]
         np.testing.assert_allclose(np.asarray(lo[:, 0]), np.asarray(ref[:, i]),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_decode_logits_match_teacher_forcing(tiny):
+    cfg, model, params, _ = tiny
+    _assert_decode_matches_teacher_forcing(cfg, model, params, seed=1)
+
+
+def test_int8_base_decode_matches_its_own_teacher_forcing():
+    """Serving is where int8 base storage pays (per-token weight reads
+    halve): the int8 model's decode must equal the SAME model's training
+    forward — quantization error cancels in the self-comparison, so any
+    mismatch is a decode-path bug."""
+    cfg = LlamaConfig.tiny(lora_rank=4, base_quant="int8")
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((2, 12), np.int32)},
+                        train=False)["params"]
+    _assert_decode_matches_teacher_forcing(cfg, model, params, seed=3)
 
 
 def test_greedy_generate_matches_full_recompute_rollout(tiny):
