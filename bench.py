@@ -1039,16 +1039,24 @@ CHIP_QUEUE: list[tuple[str, list[str], int]] = [
     # CHIP_QUEUE_r04.jsonl + BASELINE.md): the remaining opportunistic
     # set. Re-running earlier items is harmless (fresh same-day numbers
     # under the current series conditions).
+    # MoE shapes are pinned below the default b=4 s=2048: the expert
+    # bank dominates HBM (bf16 kernels: E=4 4.4 GiB, E=8 8.9 — f32 would
+    # be 2x and E=8 could never fit one chip; MoEMLP.param_dtype follows
+    # the config's bf16 storage under the frozen-base bench series)
     ("llama_moe_e4", ["--model", "llama", "--moe-experts", "4",
+                      "--batch", "2", "--seq", "1024",
                       "--skip-smoke"], 900),
     ("llama_moe_e8", ["--model", "llama", "--moe-experts", "8",
+                      "--batch", "1", "--seq", "1024",
                       "--skip-smoke"], 900),
-    # GShard grouping lever (r4 session-2): g=256 at s=2048 cuts the
-    # dispatch einsums' per-token cost 8×; CPU-relative at the tiny shape
-    # measured 854→707 ms (E=4 top-2). Device A/B vs llama_moe_e4 prices
-    # it at the real shape where the MXU does the dispatch matmuls.
+    # GShard grouping lever (r4 session-2): g=256 at the pinned s=1024
+    # cuts the dispatch einsums' per-token cost 4× vs per-sequence groups;
+    # CPU-relative at the tiny shape measured 854→707 ms (E=4 top-2).
+    # Device A/B vs llama_moe_e4 prices it where the MXU does the
+    # dispatch matmuls.
     ("llama_moe_e4_g256", ["--model", "llama", "--moe-experts", "4",
-                           "--moe-group", "256", "--skip-smoke"], 900),
+                           "--moe-group", "256", "--batch", "2",
+                           "--seq", "1024", "--skip-smoke"], 900),
     ("resnet_b512", ["--model", "resnet", "--batch", "512",
                      "--skip-smoke"], 900),
     ("llama_longctx_16k", ["--model", "llama", "--batch", "1",

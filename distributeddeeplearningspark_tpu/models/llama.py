@@ -404,7 +404,8 @@ class DecoderLayer(nn.Module):
                 top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
                 group_size=cfg.moe_group_size,
-                dtype=cfg.dtype, name="moe")(h)
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="moe")(h)
         else:
             y = LlamaMLP(cfg, name="mlp")(h)
             aux = (jnp.float32(0.0), jnp.float32(0.0))

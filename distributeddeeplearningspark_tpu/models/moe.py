@@ -50,6 +50,11 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     group_size: int = 0  # 0 = one group per sequence (g = S)
     dtype: Any = jnp.bfloat16
+    # STORAGE dtype of the expert kernels. f32 default (experts normally
+    # TRAIN and want f32 masters); bf16 halves resident expert bytes when
+    # the bank is frozen or bf16-trained — at the 0.9b bench shape E=8
+    # f32 kernels alone are 17.7 GiB (> one chip), bf16 8.9 (fits).
+    param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -74,13 +79,13 @@ class MoEMLP(nn.Module):
         cap = max(1, int(self.capacity_factor * s * self.top_k / e))
 
         router = self.param("router", nn.initializers.lecun_normal(),
-                            (h, e), jnp.float32)
+                            (h, e), jnp.float32)  # router math stays f32
         w_gate = self.param("w_gate", nn.initializers.lecun_normal(),
-                            (e, h, i), jnp.float32)
+                            (e, h, i), self.param_dtype)
         w_up = self.param("w_up", nn.initializers.lecun_normal(),
-                          (e, h, i), jnp.float32)
+                          (e, h, i), self.param_dtype)
         w_down = self.param("w_down", nn.initializers.lecun_normal(),
-                            (e, i, h), jnp.float32)
+                            (e, i, h), self.param_dtype)
 
         logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32), router)
         probs = jax.nn.softmax(logits, axis=-1)               # [B, S, E] f32

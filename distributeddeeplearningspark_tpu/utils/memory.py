@@ -67,11 +67,16 @@ def llama_param_count(cfg) -> dict[str, int]:
     """Exact parameter counts by group (validated vs model.init in tests)."""
     h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
     kvh = cfg.num_kv_heads * cfg.head_dim
+    # MoE (moe_experts > 0) replaces the dense FFN with a router + an
+    # E-wide expert bank — the DOMINANT param term (bf16 E=8 at the 0.9b
+    # shape is 8.9 GiB of kernels alone); counted exactly like model.init
+    e = getattr(cfg, "moe_experts", 0)
+    ffn = (h * e + e * 3 * h * i) if e else 3 * h * i
     per_layer = (
         h * h            # wq
         + 2 * h * kvh    # wk, wv
         + h * h          # wo
-        + 3 * h * i      # gate, up, down
+        + ffn            # dense SwiGLU, or router + stacked expert bank
         + 2 * h          # two RMSNorm scales
     )
     base = cfg.num_layers * per_layer + v * h + h + v * h  # + final norm + head

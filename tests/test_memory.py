@@ -29,7 +29,13 @@ def _real_param_count(cfg):
 def test_param_count_matches_model_exactly():
     for cfg in (LlamaConfig.tiny(), LlamaConfig.tiny(lora_rank=4),
                 LlamaConfig.tiny(num_kv_heads=1, lora_rank=2,
-                                 lora_targets=("wq", "wk", "wv", "wo"))):
+                                 lora_targets=("wq", "wk", "wv", "wo")),
+                # MoE: router + E-wide expert bank replace the dense FFN —
+                # the r4 review caught the budget omitting the bank (the
+                # dominant HBM term for the on-chip MoE queue items)
+                LlamaConfig.tiny(moe_experts=4, intermediate_size=64),
+                LlamaConfig.tiny(moe_experts=2, moe_top_k=1, lora_rank=2,
+                                 intermediate_size=64)):
         want = _real_param_count(cfg)
         got = llama_param_count(cfg)
         assert got == want, (got, want, cfg)
