@@ -408,6 +408,38 @@ def test_fsdp_tp_sharded_train_step(eight_devices):
     assert np.isfinite(float(jax.device_get(metrics["loss"])))
 
 
+def test_int8_base_fsdp_tp_sharded_train_step(eight_devices):
+    """The int8 leaves (base_q8/base_scale) must shard like their dense
+    siblings on a data×fsdp×tensor mesh — the rules added for them were
+    otherwise never exercised on more than one device — and the masked
+    step must run with frozen int8 params under real shardings."""
+    cfg = LlamaConfig.tiny(lora_rank=4, base_quant="int8")
+    model = LlamaForCausalLM(cfg)
+    mesh = MeshSpec(data=2, fsdp=2, tensor=2).build(eight_devices)
+    rules = llama_rules(cfg, fsdp_min_size=1)
+    tx = optim.masked(optax.adamw(1e-2), lora_trainable)
+    batch = stack_examples([{"input_ids": r}
+                            for r in make_batch(8, 16)["input_ids"]])
+    state, shardings = step_lib.init_state(model, tx, batch, mesh, rules)
+
+    specs = rules.tree_specs(state.params, mesh)
+    flat = {path_str(p): s for p, s in jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))[0]}
+    assert "tensor" in jax.tree.leaves(tuple(flat["layers/attention/wq/base_q8"])), flat[
+        "layers/attention/wq/base_q8"]
+    assert "tensor" in jax.tree.leaves(tuple(flat["layers/mlp/gate/base_q8"])), flat[
+        "layers/mlp/gate/base_q8"]
+
+    train = step_lib.jit_train_step(
+        step_lib.make_train_step(model.apply, tx, losses.causal_lm,
+                                 trainable=lora_trainable), mesh, shardings)
+    before = jax.device_get(state.params["layers"]["attention"]["wq"]["base_q8"])
+    state, metrics = train(state, put_global(batch, mesh))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    after = jax.device_get(state.params["layers"]["attention"]["wq"]["base_q8"])
+    np.testing.assert_array_equal(before, after)  # int8 base bit-frozen
+
+
 class TestSafetensorsIO:
     def test_roundtrip_loop_layout(self, tmp_path):
         cfg = LlamaConfig.tiny(scan_layers=False, remat=False)
