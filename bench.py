@@ -952,6 +952,13 @@ def bench_memval() -> dict:
         "7b": (LlamaConfig.llama2_7b(
             lora_rank=16, dtype="bfloat16", max_position=1024,
             remat_policy=None, fused_head_loss=True), 1, 1024),
+        # int8 storage model (r4 session-2): 1 B kernels + f32 scales —
+        # validates the quantized-base byte accounting the llama_7b_int8_b2
+        # fit prediction rests on
+        "7b_int8": (LlamaConfig.llama2_7b(
+            lora_rank=16, dtype="bfloat16", max_position=2048,
+            remat_policy=None, fused_head_loss=True,
+            base_quant="int8"), 2, 2048),
     }
     for name, (cfg, b, s) in shapes.items():
         try:
