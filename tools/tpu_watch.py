@@ -1,0 +1,101 @@
+"""Watch for a TPU window and drain the chip queue into it (r5).
+
+VERDICT r4 next-#1 made re-running the armed queue the round's only
+must-do on-chip, and both r3/r4 showed the chip comes and goes in short
+unpredictable windows (BASELINE.md outage records: 20+ failed probes over
+10 h, then a ~30-minute window that executed 9 items). A human-paced
+"probe when you remember to" loses windows; this watcher probes on a
+fixed cadence and fires `bench.py --chip-queue` the moment a probe lands,
+restricted to the items that do not yet have a good record in the output
+file — so a window that dies mid-queue resumes where it left off on the
+next window instead of re-burning completed items.
+
+Usage: python tools/tpu_watch.py [--out CHIP_QUEUE_r05.jsonl]
+         [--interval 300] [--max-hours 12]
+
+Exits 0 when every CHIP_QUEUE item has a successful record, 1 on the
+time budget running out. Every probe attempt is logged with a timestamp
+(the outage evidence BASELINE.md's availability records are built from).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _log(msg: str) -> None:
+    print(f"[{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}] {msg}",
+          flush=True)
+
+
+def done_items(out_path: str) -> set[str]:
+    """Items with a successful record (rc==0 and a parsed metric — the same
+    item_ok rule run_chip_queue uses; a structured 7B OOM-evidence record
+    counts, because the record IS the evidence)."""
+    ok: set[str] = set()
+    if not os.path.exists(out_path):
+        return ok
+    with open(out_path) as f:
+        for ln in f:
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if (rec.get("rc") == 0
+                    and isinstance(rec.get("record"), dict)
+                    and "metric" in rec["record"]):
+                ok.add(rec["item"])
+    return ok
+
+
+def main(argv=None) -> int:
+    import bench
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="CHIP_QUEUE_r05.jsonl")
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between probes while the TPU is down")
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    args = ap.parse_args(argv)
+
+    all_items = [n for n, _, _ in bench.CHIP_QUEUE]
+    deadline = time.time() + args.max_hours * 3600
+    probes = 0
+    while time.time() < deadline:
+        remaining = [n for n in all_items if n not in done_items(args.out)]
+        if not remaining:
+            _log(f"all {len(all_items)} queue items have good records in "
+                 f"{args.out}; watcher done")
+            return 0
+        probes += 1
+        ok, errs = bench.probe_backend(attempts=1, timeout_s=120)
+        if not ok:
+            _log(f"probe #{probes}: TPU down ({'; '.join(errs)[:160]}); "
+                 f"{len(remaining)}/{len(all_items)} items pending; "
+                 f"sleeping {args.interval:.0f}s")
+            time.sleep(args.interval)
+            continue
+        _log(f"probe #{probes}: TPU UP — draining {len(remaining)} items: "
+             f"{','.join(remaining)}")
+        # the queue re-probes internally and aborts on a dead tunnel, so a
+        # window that closes mid-drain just returns us to the poll loop
+        subprocess.run(
+            [sys.executable, "bench.py", "--chip-queue",
+             "--queue-out", args.out,
+             "--queue-items", ",".join(remaining)],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    _log(f"time budget exhausted after {probes} probes; "
+         f"{len([n for n in all_items if n not in done_items(args.out)])} "
+         f"items still pending")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
