@@ -22,6 +22,14 @@ tables were its only user). TPU-first choices:
   exactly this term; smaller groups trade a little routing freedom
   (capacity is enforced per group, so load imbalance *within* a group
   drops tokens a global router would have kept) for dispatch cost.
+  The "tighter constraint" reading holds when ``cf·g·k/E ≥ 1`` — below
+  that, the ≥1 capacity floor (needed so tiny shapes route at all) gives
+  every group a full slot per expert and tiny groups can aggregate MORE
+  capacity than one per-sequence group; per-group ``int()`` truncation
+  also shifts aggregate capacity slightly vs g=S (ADVICE r4). Real
+  configs sit far above the boundary (g=256, E=8, k=2, cf=1.25 →
+  cf·g·k/E = 80), so the floor is a test-shape affordance, not a
+  production regime.
 - **Top-k routing with capacity dropping** (Switch/GShard): tokens beyond
   an expert's capacity fall through (the residual connection carries
   them); an auxiliary load-balance loss (Switch Transformer eq. 4 —
@@ -75,7 +83,12 @@ class MoEMLP(nn.Module):
             x = x.reshape(bb * ss // self.group_size, self.group_size, h)
         b, s, _ = x.shape
         # per-group (= per-sequence) expert capacity, ≥1 so tiny test
-        # shapes still route
+        # shapes still route. The floor means the module-docstring
+        # "small groups only drop more" trade only holds for
+        # cf·g·k/E ≥ 1 (see header); an exact ceil-split of the
+        # sequence-level cap would restore universality but change
+        # routing vs the measured r4 group-size A/B series, so the
+        # claim is qualified instead.
         cap = max(1, int(self.capacity_factor * s * self.top_k / e))
 
         router = self.param("router", nn.initializers.lecun_normal(),
