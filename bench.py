@@ -168,11 +168,12 @@ def _sanity_check_mfu(rec: dict) -> None:
     """MFU > 100% means the timing is an artifact, not a fast chip.
 
     Reads the most-trusted MFU the record carries (``mfu``, then the
-    analytic ``mfu_model``, then ``mfu_approx`` — ADVICE r2: bench_llama's
-    analytically augmented FLOPs would make an impossible value look
-    plausible if the axon early-return timing bug recurred).
+    analytic ``mfu_model``, then the scan-opaque HLO count — ADVICE r2:
+    bench_llama's analytically augmented FLOPs would make an impossible
+    value look plausible if the axon early-return timing bug recurred).
     """
-    mfu = rec.get("mfu", rec.get("mfu_model", rec.get("mfu_approx", 0.0)))
+    mfu = rec.get("mfu", rec.get("mfu_model",
+                                 rec.get("mfu_hlo_scan_opaque", 0.0)))
     if mfu > 1.0:
         rec["timing_suspect"] = (
             f"mfu {mfu:.2f} > 1.0 is physically impossible — the "
@@ -591,12 +592,14 @@ def bench_llama(iters: int, batch_size: int | None = None, seq: int = 2048,
             batch_size, cfg.num_heads, seq, cfg.head_dim,
             causal=True, train=True)
     mfu = (flops / step_time / n_chips / peak) if (flops and peak) else 0.0
-    # Analytic model-FLOPs MFU (the PaLM-convention number): the tunneled
-    # TPU backend's cost analysis drops the backward pass of the scanned
-    # llama step (measured ~fwd-only MACs on the r4 record), so mfu_approx
-    # wildly understates this model family. mfu_model is the honest,
-    # formula-documented series; both are reported so the discrepancy
-    # itself stays visible (metrics.llama_model_flops_per_token docstring).
+    # Analytic model-FLOPs MFU (the PaLM-convention number): XLA cost
+    # analysis reports the layer-scan body ONCE, not ×L (r5 measurement —
+    # metrics.llama_model_flops_per_token docstring), so the compiled
+    # count structurally understates every scanned model. mfu_model is
+    # the honest, formula-documented series; the suspect number is kept
+    # under a name that says so (VERDICT r4 weak-#5: `mfu_approx` read
+    # alone handed a consumer the artifact value) so the discrepancy
+    # itself stays visible in the series.
     from distributeddeeplearningspark_tpu.metrics import (
         llama_model_flops_per_token)
 
@@ -607,7 +610,16 @@ def bench_llama(iters: int, batch_size: int | None = None, seq: int = 2048,
         "tokens_per_sec_per_chip": round(batch_size * seq / step_time / n_chips, 1),
         **_timing_fields(times, iters),
         "mfu_model": round(mfu_model, 4),
-        "mfu_approx": round(mfu, 4),
+        "mfu_convention": ("frozen-base model FLOPs: 4P fwd+dx, dW for "
+                           "LoRA only, +attn matmuls — NOT comparable to "
+                           "full-train MFU denominators"
+                           if cfg.lora_rank else
+                           "full-train model FLOPs (6P + attn)"),
+        "mfu_hlo_scan_opaque": round(mfu, 4),
+        "mfu_hlo_scan_opaque_note": (
+            "from compiled cost analysis, which counts the layer-scan "
+            "body once (not xL) — known structural undercount, kept for "
+            "series continuity with r2-r4 mfu_approx"),
         "variant": variant,
         "params": sum(llama_param_count(cfg).values()),
         "batch_size": batch_size,
@@ -967,9 +979,10 @@ def bench_kernels(*, conv_m: int = 0, scatter_v: int = 0) -> dict:
             flash_attention)
         from distributeddeeplearningspark_tpu.ops.ulysses import (
             ulysses_attention)
-        from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+        from distributeddeeplearningspark_tpu.parallel.mesh import (
+            single_device_mesh)
 
-        mesh1 = MeshSpec(data=1).build([jax.devices()[0]])
+        mesh1 = single_device_mesh()
         b, s, h, d = 2, 1024, 8, 128
         key = jax.random.PRNGKey(7)
         q = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
@@ -1178,6 +1191,24 @@ CHIP_QUEUE: list[tuple[str, list[str], int]] = [
 ]
 
 
+def is_good_record(rc: int, record: object) -> bool:
+    """The shared "this queue item produced its evidence" rule (used by
+    run_chip_queue's item_ok and tools/tpu_watch.py's resume logic — one
+    definition so they can't drift). bench.py's main() catches runner
+    exceptions and still exits 0 with a ``bench_failed`` line, and an
+    all-FAIL kernels run emits ``pallas_kernels_compiled`` value 0 — both
+    are FAILURES for retry purposes, not evidence (r5 review: the watcher
+    was marking them done and never retrying)."""
+    if rc != 0 or not isinstance(record, dict) or "metric" not in record:
+        return False
+    if record["metric"] in ("bench_failed", "backend_unavailable"):
+        return False
+    if (record["metric"] == "pallas_kernels_compiled"
+            and not record.get("value")):
+        return False
+    return True
+
+
 def run_chip_queue(out_path: str, *, items: list[str] | None = None) -> int:
     """Execute the whole chip-window backlog as ONE command (VERDICT r3
     next-#1: "a 30-minute window should yield partial results, not
@@ -1230,7 +1261,7 @@ def run_chip_queue(out_path: str, *, items: list[str] | None = None) -> int:
             except (json.JSONDecodeError, IndexError):
                 record = {"raw_tail": line[:500],
                           "stderr_tail": (out.stderr or "")[-500:]}
-            item_ok = out.returncode == 0 and "metric" in record
+            item_ok = is_good_record(out.returncode, record)
             append({"item": name, "rc": out.returncode,
                     "elapsed_s": round(time.time() - t0, 1), "record": record})
         except subprocess.TimeoutExpired:
@@ -1522,7 +1553,8 @@ def main(argv=None) -> int:
     else:
         emit("bench_failed", 0.0, "none", 0.0, extra)
         return 0
-    mfu = (r.get("mfu", r.get("mfu_model", r.get("mfu_approx", 0.0)))
+    mfu = (r.get("mfu", r.get("mfu_model",
+                              r.get("mfu_hlo_scan_opaque", 0.0)))
            if backend == "tpu" else 0.0)
     if any("timing_suspect" in res for res in results.values()):
         # a physically impossible measurement must not masquerade as a

@@ -69,13 +69,22 @@ def llama_model_flops_per_token(cfg, seq: int, *,
     convention published MFU numbers use, cf. the PaLM appendix formula).
 
     Exists because ``compiled.cost_analysis()`` cannot be trusted for the
-    scanned Llama step on the tunneled TPU backend: the r4 device record
-    reported ~855 MF/token for the 0.9b shape — almost exactly the
-    FORWARD-ONLY matmul MACs (~820M) — i.e. the backward pass through the
-    layer scan went uncounted, deflating the derived MFU to 12% while the
-    same harness's unrolled BERT/ResNet counts are consistent with their
-    rooflines. (CPU cost analysis counts the same step fully, at 1
-    flop/MAC — verified r4 session 2; the undercount is backend-specific.)
+    SCANNED Llama step on any backend. r5 re-measurement (CPU, L∈{2,4,8},
+    scan on/off — tests/test_bench.py::
+    test_llama_model_flops_vs_cpu_cost_analysis): with ``scan_layers=True``
+    the reported count is L-INDEPENDENT (identical at L=2/4/8) — XLA cost
+    analysis reports the while/scan body ONCE, not × trip count — while
+    the unrolled step scales with L and lands within ~6–13% of this
+    formula (XLA counts 2 flops/MAC; the excess is elementwise work the
+    formula excludes). This corrects the r4 story ("the tunneled backend
+    drops the scanned backward; CPU counts fully at 1 flop/MAC"): the r4
+    CPU cross-check passed inside its ±40% window only because the 2×
+    convention error and the scan-body undercount at L=4 happened to
+    cancel. The r4 fwd:frozen:full ratio evidence (1 : 2.11 : 3.01)
+    remains valid — ratios of same-L scanned counts share the undercount.
+    Deflated ``mfu`` from the raw compiled count (12% on the r4 device
+    record vs ~50% analytic) is therefore a structural property of
+    scanned models, not a tunnel bug.
 
     Counted: projection/FFN/head matmuls (embedding lookup is a gather),
     attention score/value matmuls (causal halving, q-head count — GQA does
@@ -111,7 +120,15 @@ def llama_model_flops_per_token(cfg, seq: int, *,
 
 
 def compiled_flops_per_step(compiled) -> float | None:
-    """Total FLOPs of one compiled step from XLA cost analysis (global)."""
+    """Total FLOPs of one compiled step from XLA cost analysis (global).
+
+    CAVEAT: XLA cost analysis reports a ``lax.scan``/while body ONCE, not
+    multiplied by trip count (measured r5: scanned-Llama counts identical
+    at L=2/4/8), so this number undercounts scanned models by ~L× on the
+    scanned terms. Valid for unrolled models (ResNet/BERT reconcile with
+    their rooflines); for scanned Llama use
+    :func:`llama_model_flops_per_token`.
+    """
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, list):  # older jax returns per-device list

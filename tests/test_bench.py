@@ -74,7 +74,7 @@ def test_bench_failure_in_one_model_does_not_kill_the_other(monkeypatch, capsys)
 
     monkeypatch.setattr(bench, "bench_bert", boom)
     monkeypatch.setattr(bench, "bench_llama", lambda iters, **kw: {
-        "tokens_per_sec_per_chip": 1.0, "mfu_approx": 0.1,
+        "tokens_per_sec_per_chip": 1.0, "mfu_hlo_scan_opaque": 0.1,
         "step_time_ms": 1.0, "params": 1, "batch_size": 4, "seq_len": 2048,
         "chips": 1})
     monkeypatch.setattr(bench, "bench_dlrm", lambda iters, **kw: {
@@ -128,7 +128,7 @@ def test_timing_suspect_zeroes_vs_baseline(monkeypatch, capsys):
         "tokens_per_sec_per_chip": 1.0, "mfu": 0.3, "step_time_ms": 1.0,
         "batch_size": 32, "seq_len": 512, "chips": 1})
     monkeypatch.setattr(bench, "bench_llama", lambda iters, **kw: {
-        "tokens_per_sec_per_chip": 1.0, "mfu_approx": 0.1,
+        "tokens_per_sec_per_chip": 1.0, "mfu_hlo_scan_opaque": 0.1,
         "step_time_ms": 1.0, "params": 1, "batch_size": 4, "seq_len": 2048,
         "chips": 1})
     monkeypatch.setattr(bench, "bench_dlrm", lambda iters, **kw: {
@@ -165,9 +165,10 @@ def test_attention_matmul_flops_convention():
 def test_llama_model_flops_formula():
     """The analytic MFU formula (metrics.llama_model_flops_per_token):
     closed-form identities that would catch any ×2/×L bookkeeping slip —
-    the bug class it exists to route around (the tunneled TPU backend's
-    cost analysis drops the scanned backward, deflating llama MFU to 12%
-    on the r4 record while the same step's analytic count puts it ~50%)."""
+    the bug class it exists to route around (XLA cost analysis counts the
+    layer-scan body once, not ×L — r5 finding, see
+    test_cost_analysis_is_scan_opaque — deflating llama MFU to 12% on the
+    r4 device record while the same step's analytic count puts it ~50%)."""
     from distributeddeeplearningspark_tpu.metrics import (
         attention_matmul_flops, llama_model_flops_per_token)
     from distributeddeeplearningspark_tpu.models import LlamaConfig
@@ -207,13 +208,9 @@ def test_llama_model_flops_formula():
         moe_cfg, s, frozen_base=False) == 6 * p_moe + attn
 
 
-def test_llama_model_flops_vs_cpu_cost_analysis():
-    """Cross-check the analytic formula against a backend whose cost
-    analysis we verified counts the whole scanned step (CPU, r4 session-2
-    probe: fwd/frozen/full ratios 1 : 2.11 : 3.01). CPU counts 1 flop per
-    MAC, so analytic/2 must land within a generous envelope of the
-    compiled count (slop: causal-halving convention vs XLA's dense score
-    matmuls, elementwise/optimizer work the formula excludes)."""
+def _compiled_llama_flops(num_layers: int, *, scan: bool):
+    """Compile a tiny frozen-base llama step and return (measured HLO
+    flops, analytic model flops) — shared by the cross-check tests."""
     import optax
 
     from distributeddeeplearningspark_tpu.metrics import (
@@ -224,10 +221,10 @@ def test_llama_model_flops_vs_cpu_cost_analysis():
     from distributeddeeplearningspark_tpu.train import losses, step as step_lib
 
     b, s = 2, 256
-    cfg = LlamaConfig(vocab_size=2048, hidden_size=256, num_layers=4,
-                      num_heads=8, num_kv_heads=4, intermediate_size=512,
-                      max_position=s, lora_rank=8, dtype="float32",
-                      remat=False)
+    cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
+                      num_layers=num_layers, num_heads=8, num_kv_heads=4,
+                      intermediate_size=512, max_position=s, lora_rank=8,
+                      dtype="float32", remat=False, scan_layers=scan)
     model = LlamaForCausalLM(cfg)
     batch = {"input_ids": np.ones((b, s), np.int32),
              "loss_mask": np.ones((b, s), np.float32)}
@@ -242,9 +239,36 @@ def test_llama_model_flops_vs_cpu_cost_analysis():
     measured = compiled_flops_per_step(step.lower(state, batch).compile())
     assert measured is not None
     analytic = llama_model_flops_per_token(cfg, s, frozen_base=True) * b * s
-    # CPU convention is 1 flop/MAC → compare against analytic/2
-    ratio = measured / (analytic / 2)
-    assert 0.6 < ratio < 1.4, (measured, analytic, ratio)
+    return measured, analytic
+
+
+def test_llama_model_flops_vs_cpu_cost_analysis():
+    """Cross-check the analytic formula against the UNROLLED compiled
+    step, whose HLO cost analysis sees every layer (XLA convention:
+    2 flops/MAC, same as the formula). Bounds are tight enough to catch a
+    dropped backward at ANY depth (VERDICT r4 weak-#4: the old ±40%
+    window on the scanned step passed only because a 2× convention error
+    and the scan-body undercount canceled at L=4): measured r5 ratios are
+    1.065 (L=2) and 1.105 (L=4) — the excess over 1.0 is elementwise/
+    optimizer work the formula excludes — while a dropped backward
+    divides the true count by ~2.1 (the measured fwd:frozen-step ratio),
+    putting the ratio at ~0.5, far outside [0.95, 1.30] at every depth."""
+    for num_layers in (2, 4):
+        measured, analytic = _compiled_llama_flops(num_layers, scan=False)
+        ratio = measured / analytic
+        assert 0.95 < ratio < 1.30, (num_layers, measured, analytic, ratio)
+
+
+def test_cost_analysis_is_scan_opaque():
+    """Pin the mechanism `mfu_hlo_scan_opaque` is named for: XLA cost
+    analysis reports the layer-scan body ONCE, not × trip count, so the
+    scanned L=4 count comes in BELOW even the unrolled L=2 count (one
+    body + head < two layers + head). If a jax upgrade starts counting
+    scan trips, this fails and the suspect-number plumbing (bench_llama,
+    metrics docstrings, BASELINE r5 log) should be retired."""
+    scanned4, _ = _compiled_llama_flops(4, scan=True)
+    unrolled2, _ = _compiled_llama_flops(2, scan=False)
+    assert scanned4 < unrolled2, (scanned4, unrolled2)
 
 
 def test_routes_to_flash_matches_router(monkeypatch):
@@ -394,6 +418,25 @@ def test_bench_kernels_interpret_smoke():
     assert rec["ulysses_smoke"]["compile"] == "ok", rec["ulysses_smoke"]
     assert rec["ulysses_smoke"]["finite"]
     assert rec["ulysses_smoke"]["max_abs_err_vs_direct_flash"] < 0.05
+
+
+def test_is_good_record_excludes_failure_shapes():
+    """The shared queue/watcher success rule (r5 review: bench.py exits 0
+    with a bench_failed line on runner exceptions, which the watcher was
+    counting as done — evidence silently never collected)."""
+    good = {"metric": "llama_lora_tokens_per_sec_per_chip", "value": 0.0}
+    assert bench.is_good_record(0, good)           # 7B OOM evidence counts
+    assert not bench.is_good_record(1, good)       # nonzero rc
+    assert not bench.is_good_record(0, {"raw_tail": "boom"})   # no metric
+    assert not bench.is_good_record(0, "not a dict")
+    assert not bench.is_good_record(
+        0, {"metric": "bench_failed", "value": 0.0})
+    assert not bench.is_good_record(
+        0, {"metric": "backend_unavailable", "value": 0.0})
+    assert not bench.is_good_record(
+        0, {"metric": "pallas_kernels_compiled", "value": 0.0})
+    assert bench.is_good_record(
+        0, {"metric": "pallas_kernels_compiled", "value": 3.0})
 
 
 def test_chip_queue_rejects_unknown_item_names(tmp_path):

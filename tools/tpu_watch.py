@@ -35,24 +35,36 @@ def _log(msg: str) -> None:
           flush=True)
 
 
-def done_items(out_path: str) -> set[str]:
-    """Items with a successful record (rc==0 and a parsed metric — the same
-    item_ok rule run_chip_queue uses; a structured 7B OOM-evidence record
-    counts, because the record IS the evidence)."""
+def scan_records(out_path: str) -> tuple[set[str], dict[str, int]]:
+    """Returns (items with a good record, failed-attempt counts).
+
+    "Good" is bench.is_good_record — the SAME rule run_chip_queue's
+    item_ok uses, which excludes ``bench_failed`` / zero-kernel records
+    (bench.py main() catches runner exceptions and still exits 0 with a
+    parseable failure line; counting those as done would silently end the
+    watch with the round's evidence missing). A structured 7B
+    OOM-evidence record counts as good: the record IS the evidence.
+    """
+    import bench
+
     ok: set[str] = set()
+    failed: dict[str, int] = {}
     if not os.path.exists(out_path):
-        return ok
+        return ok, failed
     with open(out_path) as f:
         for ln in f:
             try:
                 rec = json.loads(ln)
             except json.JSONDecodeError:
                 continue
-            if (rec.get("rc") == 0
-                    and isinstance(rec.get("record"), dict)
-                    and "metric" in rec["record"]):
-                ok.add(rec["item"])
-    return ok
+            name = rec.get("item")
+            if name in (None, "probe", "probe_recheck"):
+                continue
+            if bench.is_good_record(rec.get("rc"), rec.get("record")):
+                ok.add(name)
+            else:
+                failed[name] = failed.get(name, 0) + 1
+    return ok, failed
 
 
 def main(argv=None) -> int:
@@ -63,17 +75,28 @@ def main(argv=None) -> int:
     ap.add_argument("--interval", type=float, default=300.0,
                     help="seconds between probes while the TPU is down")
     ap.add_argument("--max-hours", type=float, default=12.0)
+    ap.add_argument("--max-attempts", type=int, default=3,
+                    help="give up on an item after this many failed runs "
+                         "(a persistently wedged compile must not starve "
+                         "the items behind it for the whole watch)")
     args = ap.parse_args(argv)
 
     all_items = [n for n, _, _ in bench.CHIP_QUEUE]
     deadline = time.time() + args.max_hours * 3600
     probes = 0
     while time.time() < deadline:
-        remaining = [n for n in all_items if n not in done_items(args.out)]
+        done, failed = scan_records(args.out)
+        given_up = sorted(n for n, k in failed.items()
+                          if n not in done and k >= args.max_attempts)
+        remaining = [n for n in all_items
+                     if n not in done and n not in given_up]
         if not remaining:
-            _log(f"all {len(all_items)} queue items have good records in "
-                 f"{args.out}; watcher done")
-            return 0
+            _log(f"{len(done)}/{len(all_items)} queue items have good "
+                 f"records in {args.out}"
+                 + (f"; GAVE UP on {given_up} after {args.max_attempts} "
+                    f"failed attempts each" if given_up else "")
+                 + "; watcher done")
+            return 0 if not given_up else 1
         probes += 1
         ok, errs = bench.probe_backend(attempts=1, timeout_s=120)
         if not ok:
@@ -83,7 +106,8 @@ def main(argv=None) -> int:
             time.sleep(args.interval)
             continue
         _log(f"probe #{probes}: TPU UP — draining {len(remaining)} items: "
-             f"{','.join(remaining)}")
+             f"{','.join(remaining)}"
+             + (f" (given up: {given_up})" if given_up else ""))
         # the queue re-probes internally and aborts on a dead tunnel, so a
         # window that closes mid-drain just returns us to the poll loop
         subprocess.run(
@@ -91,9 +115,16 @@ def main(argv=None) -> int:
              "--queue-out", args.out,
              "--queue-items", ",".join(remaining)],
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        done2, _ = scan_records(args.out)
+        if not (done2 - done):
+            # a drain that produced nothing new means the window closed or
+            # every remaining item is failing — don't spin back-to-back
+            _log(f"drain made no progress ({len(done2)} done); cooling "
+                 f"down {args.interval:.0f}s before re-probing")
+            time.sleep(args.interval)
+    pend = [n for n in all_items if n not in scan_records(args.out)[0]]
     _log(f"time budget exhausted after {probes} probes; "
-         f"{len([n for n in all_items if n not in done_items(args.out)])} "
-         f"items still pending")
+         f"{len(pend)} items still pending: {','.join(pend)}")
     return 1
 
 
