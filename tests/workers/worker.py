@@ -134,13 +134,19 @@ def fingerprint_reference(steps: int, batch_size: int, mesh) -> dict:
         }
 
     def local_rows(gb: dict) -> dict:
-        # put_global (multi-process) wants each process's OWN rows; mesh
-        # device order is process-major, so the slice is contiguous
-        if jax.process_count() == 1:
+        # put_global (multi-process) wants each process's OWN rows; the
+        # shard-range math (process-major device order) lives in feed.py —
+        # derive from it rather than duplicating the invariant here
+        from distributeddeeplearningspark_tpu.data.feed import (
+            process_shard_range)
+
+        nshards = num_data_shards(mesh)
+        rng_ = process_shard_range(nshards)
+        if rng_ is None:
             return gb
-        per = batch_size // jax.process_count()
-        lo = jax.process_index() * per
-        return {k: v[lo:lo + per] for k, v in gb.items()}
+        rows_per_shard = batch_size // nshards
+        lo, hi = rng_[0] * rows_per_shard, rng_[1] * rows_per_shard
+        return {k: v[lo:hi] for k, v in gb.items()}
 
     assert batch_size % num_data_shards(mesh) == 0
     model = LeNet5()
