@@ -284,6 +284,112 @@ class PartitionedDataset:
 
         return PartitionedDataset([cached(p) for p in self._parts])
 
+    def reduce_by_key(self, f: Callable[[Any, Any], Any],
+                      num_partitions: int | None = None) -> "PartitionedDataset":
+        """Spark ``reduceByKey`` over (key, value) pairs. Same honest
+        narrow-engine semantics as :meth:`distinct`: values combine
+        per-partition first (Spark's map-side combine — the part that
+        matters for data volume), then the per-partition partials merge in
+        a driver-side dict instead of a shuffle service (SURVEY §7 'what
+        NOT to build'). Output is hash-partitioned over ``num_partitions``
+        (default: the input's count) so downstream stages keep their
+        parallelism; within a partition, keys keep first-occurrence order.
+        """
+        self._require_finite("reduce_by_key")
+        if num_partitions is not None and num_partitions < 1:
+            raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
+        parts = self._parts
+        n_out = num_partitions or len(self._parts)
+        memo: dict = {}  # merge once, serve all output partitions (and
+        # re-iterations) from it — cache() semantics, else each of the
+        # n_out partition generators would re-walk the whole input
+
+        def merged() -> dict:
+            if "acc" not in memo:
+                acc: dict = {}
+                for p in parts:
+                    # map-side combine per partition, then fold into the
+                    # global dict
+                    local: dict = {}
+                    for k, v in p():
+                        local[k] = f(local[k], v) if k in local else v
+                    for k, v in local.items():
+                        acc[k] = f(acc[k], v) if k in acc else v
+                memo["acc"] = acc
+            return memo["acc"]
+
+        def make(idx: int) -> PartitionFn:
+            def gen() -> Iterator[tuple]:
+                for k, v in merged().items():
+                    if hash(k) % n_out == idx:
+                        yield (k, v)
+            return gen
+
+        return PartitionedDataset([make(i) for i in range(n_out)])
+
+    def group_by_key(self, num_partitions: int | None = None) -> "PartitionedDataset":
+        """Spark ``groupByKey``: (key, [values...]) with values in
+        partition-major encounter order. Same driver-side merge caveat as
+        :meth:`reduce_by_key` — and the same Spark guidance applies:
+        prefer ``reduce_by_key`` when the downstream op is a fold, since
+        grouping materializes every value list. Direct dict-of-lists
+        build (appends), NOT reduce_by_key(list concat) — that fold
+        copies the accumulated prefix per element, O(m²) on a hot key.
+        """
+        self._require_finite("group_by_key")
+        if num_partitions is not None and num_partitions < 1:
+            raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
+        parts = self._parts
+        n_out = num_partitions or len(self._parts)
+        memo: dict = {}  # build once (cache() semantics), see reduce_by_key
+
+        def grouped() -> dict:
+            if "acc" not in memo:
+                acc: dict = {}
+                for p in parts:
+                    for k, v in p():
+                        acc.setdefault(k, []).append(v)
+                memo["acc"] = acc
+            return memo["acc"]
+
+        def make(idx: int) -> PartitionFn:
+            def gen() -> Iterator[tuple]:
+                for k, v in grouped().items():
+                    if hash(k) % n_out == idx:
+                        yield (k, v)
+            return gen
+
+        return PartitionedDataset([make(i) for i in range(n_out)])
+
+    def sort_by(self, key: Callable[[Any], Any], *, ascending: bool = True,
+                num_partitions: int | None = None) -> "PartitionedDataset":
+        """Spark ``sortBy``: totally ordered output, range-partitioned so
+        partition i's elements all precede partition i+1's (the property
+        Spark's sort guarantees). Driver-side sort — no shuffle engine —
+        sized for driver-scale data like metric tables and vocab builds.
+        """
+        self._require_finite("sort_by")
+        if num_partitions is not None and num_partitions < 1:
+            raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
+        parts = self._parts
+        n_out = num_partitions or len(self._parts)
+        memo: dict = {}  # sort once (cache() semantics), see reduce_by_key
+
+        def sorted_all() -> list:
+            if "data" not in memo:
+                memo["data"] = sorted((x for p in parts for x in p()),
+                                      key=key, reverse=not ascending)
+            return memo["data"]
+
+        def make(idx: int) -> PartitionFn:
+            def gen() -> Iterator[Any]:
+                data = sorted_all()
+                per = -(-len(data) // n_out) or 1
+                return iter(data[idx * per:(idx + 1) * per])
+            return gen
+
+        return PartitionedDataset([make(i) for i in range(n_out)])
+
     def zip_with_index(self) -> "PartitionedDataset":
         """(elem, global_index) pairs; forces a driver count of prior partitions."""
         self._require_finite("zip_with_index")
@@ -363,6 +469,9 @@ class PartitionedDataset:
     treeAggregate = tree_aggregate
     zipWithIndex = zip_with_index
     foreachPartition = foreach_partition
+    reduceByKey = reduce_by_key
+    groupByKey = group_by_key
+    sortBy = sort_by
 
     def getNumPartitions(self) -> int:
         """pyspark spells this as a method; kept callable for ported code."""

@@ -35,6 +35,36 @@ def lamb(learning_rate: float | optax.Schedule, *, b1: float = 0.9, b2: float = 
     return optax.lamb(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
 
 
+def lars(learning_rate: float | optax.Schedule, *, momentum: float = 0.9,
+         weight_decay: float = 1e-4,
+         trust_coefficient: float = 0.001) -> optax.GradientTransformation:
+    """LARS (layerwise-adaptive rate scaling, You et al. arXiv:1708.03888) —
+    the large-batch CNN counterpart of LAMB: per-layer trust ratios keep
+    SGD stable when config 2's global batch scales across a pod (the
+    original ImageNet-in-minutes recipe trains ResNet-50 at batch 8k–32k).
+    A v4-32 pure-DP layout at b=256/chip is global batch 8192 — exactly
+    the regime plain momentum-SGD starts diverging without an LR retune;
+    pair with :func:`warmup_cosine` (or the paper's polynomial decay).
+
+    Following the paper (and every published batch-8k+ recipe),
+    BatchNorm scales/biases and other 1-D params are EXCLUDED from both
+    weight decay and trust-ratio scaling (decaying BN gamma/beta is the
+    known cause of degraded top-1 at large batch); the rank>1 mask below
+    selects exactly the conv/dense kernels.
+
+    optax convention note: weight decay here rides inside the trust-ratio
+    computation (the LARS formulation), unlike :func:`sgd`'s decoupled
+    ``add_decayed_weights`` chain.
+    """
+    kernels_only = lambda params: jax.tree.map(  # noqa: E731
+        lambda p: p.ndim > 1, params)
+    return optax.lars(learning_rate, weight_decay=weight_decay,
+                      weight_decay_mask=kernels_only,
+                      trust_ratio_mask=kernels_only,
+                      trust_coefficient=trust_coefficient,
+                      momentum=momentum)
+
+
 def adafactor(learning_rate: float | optax.Schedule, *,
               weight_decay: float = 0.0,
               min_dim_size_to_factor: int = 128) -> optax.GradientTransformation:

@@ -60,6 +60,13 @@ def main() -> None:
                    help="validation root (same layout); reports top-1/top-5 "
                         "after training via the exact tail-inclusive evaluator")
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--optimizer", default="sgd", choices=["sgd", "lars"],
+                   help="lars = layerwise-adaptive rate scaling "
+                        "(arXiv:1708.03888), the large-batch recipe: a "
+                        "v4-32 pure-DP run at b=256/chip is global batch "
+                        "8192, where momentum-SGD needs it to stay stable. "
+                        "Base --lr scales with batch under LARS (the paper "
+                        "uses lr = 0.1 * batch/256 with warmup)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace window into this dir")
     p.add_argument("--tensorboard-dir", default=None)
@@ -129,10 +136,10 @@ def main() -> None:
     model = RESNETS[args.variant](num_classes=args.num_classes)
     schedule = optim.warmup_cosine(args.lr, warmup_steps=min(args.steps // 10, 500),
                                    total_steps=args.steps)
-    trainer = Trainer(
-        spark, model, losses.softmax_xent,
-        optim.sgd(schedule, momentum=0.9, weight_decay=1e-4),
-    )
+    tx = (optim.lars(schedule, momentum=0.9, weight_decay=1e-4)
+          if args.optimizer == "lars" else
+          optim.sgd(schedule, momentum=0.9, weight_decay=1e-4))
+    trainer = Trainer(spark, model, losses.softmax_xent, tx)
     if args.weights:
         import torch
 

@@ -108,6 +108,61 @@ def test_distinct_keeps_first_occurrence_order():
     assert ds.distinct().collect() == [3, 1, 2, 5]
 
 
+def test_reduce_by_key_combines_across_partitions():
+    ds = PartitionedDataset.parallelize(
+        [("a", 1), ("b", 2), ("a", 3), ("c", 4), ("b", 5)], 3)
+    out = ds.reduce_by_key(lambda x, y: x + y)
+    assert out.num_partitions == 3
+    assert dict(out.collect()) == {"a": 4, "b": 7, "c": 4}
+    # every pair lands in the partition its key hashes to
+    for i in range(out.num_partitions):
+        for k, _ in out.iter_partition(i):
+            assert hash(k) % 3 == i
+    # num_partitions override + the infinite guard
+    assert dict(ds.reduce_by_key(lambda x, y: x + y,
+                                 num_partitions=1).collect()) == {
+        "a": 4, "b": 7, "c": 4}
+    import pytest
+
+    with pytest.raises(ValueError, match="reduce_by_key"):
+        ds.repeat().reduce_by_key(lambda x, y: x + y)
+
+
+def test_group_by_key_orders_values_partition_major():
+    ds = PartitionedDataset.parallelize(
+        [("a", 1), ("b", 2), ("a", 3), ("a", 5)], 2)
+    got = dict(ds.group_by_key().collect())
+    assert got == {"a": [1, 3, 5], "b": [2]}
+
+
+def test_by_key_camel_aliases_and_guards():
+    ds = PartitionedDataset.parallelize([("a", 1), ("a", 2)], 2)
+    assert dict(ds.reduceByKey(lambda x, y: x + y).collect()) == {"a": 3}
+    assert dict(ds.groupByKey().collect()) == {"a": [1, 2]}
+    assert ds.sortBy(lambda kv: kv[1]).collect() == [("a", 1), ("a", 2)]
+    import pytest
+
+    with pytest.raises(ValueError, match="num_partitions"):
+        ds.reduce_by_key(lambda x, y: x, num_partitions=-2)
+    with pytest.raises(ValueError, match="num_partitions"):
+        ds.group_by_key(num_partitions=-2)
+    with pytest.raises(ValueError, match="num_partitions"):
+        ds.sort_by(lambda x: x, num_partitions=0)
+
+
+def test_sort_by_is_range_partitioned_total_order():
+    ds = PartitionedDataset.parallelize([5, 1, 4, 2, 3, 9, 0], 3)
+    out = ds.sort_by(lambda x: x)
+    assert out.collect() == [0, 1, 2, 3, 4, 5, 9]
+    # range partitioning: max of partition i <= min of partition i+1
+    parts = [list(out.iter_partition(i)) for i in range(out.num_partitions)]
+    flat_bounds = [(min(p), max(p)) for p in parts if p]
+    for (_, hi), (lo, _) in zip(flat_bounds, flat_bounds[1:]):
+        assert hi <= lo
+    assert ds.sort_by(lambda x: x, ascending=False).collect() == [
+        9, 5, 4, 3, 2, 1, 0]
+
+
 def test_cache_materializes_once_and_survives_partial_reads():
     calls = [0]
 

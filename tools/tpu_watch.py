@@ -57,6 +57,8 @@ def scan_records(out_path: str) -> tuple[set[str], dict[str, int]]:
                 rec = json.loads(ln)
             except json.JSONDecodeError:
                 continue
+            if not isinstance(rec, dict):
+                continue  # a JSON scalar line proves nothing
             name = rec.get("item")
             if name in (None, "probe", "probe_recheck"):
                 continue
