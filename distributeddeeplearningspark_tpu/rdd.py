@@ -284,6 +284,35 @@ class PartitionedDataset:
 
         return PartitionedDataset([cached(p) for p in self._parts])
 
+    def _hash_partitioned_by_key(
+        self, op: str, num_partitions: int | None,
+        build: Callable[[], dict],
+    ) -> "PartitionedDataset":
+        """Shared scaffolding for the byKey ops: validate, ``build()`` the
+        full key→value dict ONCE (memoized, cache() semantics — else each
+        output partition would re-walk the input), bucket it ONCE by
+        ``hash(key) % n_out`` (a per-partition filter would rescan the
+        whole dict n_out times), and serve bucket ``i`` as partition
+        ``i``. Keys keep first-occurrence order within their bucket."""
+        self._require_finite(op)
+        if num_partitions is not None and num_partitions < 1:
+            raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
+        n_out = num_partitions or len(self._parts)
+        memo: dict = {}
+
+        def buckets() -> list:
+            if "b" not in memo:
+                b: list = [[] for _ in range(n_out)]
+                for k, v in build().items():
+                    b[hash(k) % n_out].append((k, v))
+                memo["b"] = b
+            return memo["b"]
+
+        def make(idx: int) -> PartitionFn:
+            return lambda: iter(buckets()[idx])
+
+        return PartitionedDataset([make(i) for i in range(n_out)])
+
     def reduce_by_key(self, f: Callable[[Any, Any], Any],
                       num_partitions: int | None = None) -> "PartitionedDataset":
         """Spark ``reduceByKey`` over (key, value) pairs. Same honest
@@ -293,39 +322,23 @@ class PartitionedDataset:
         a driver-side dict instead of a shuffle service (SURVEY §7 'what
         NOT to build'). Output is hash-partitioned over ``num_partitions``
         (default: the input's count) so downstream stages keep their
-        parallelism; within a partition, keys keep first-occurrence order.
+        parallelism.
         """
-        self._require_finite("reduce_by_key")
-        if num_partitions is not None and num_partitions < 1:
-            raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
         parts = self._parts
-        n_out = num_partitions or len(self._parts)
-        memo: dict = {}  # merge once, serve all output partitions (and
-        # re-iterations) from it — cache() semantics, else each of the
-        # n_out partition generators would re-walk the whole input
 
         def merged() -> dict:
-            if "acc" not in memo:
-                acc: dict = {}
-                for p in parts:
-                    # map-side combine per partition, then fold into the
-                    # global dict
-                    local: dict = {}
-                    for k, v in p():
-                        local[k] = f(local[k], v) if k in local else v
-                    for k, v in local.items():
-                        acc[k] = f(acc[k], v) if k in acc else v
-                memo["acc"] = acc
-            return memo["acc"]
+            acc: dict = {}
+            for p in parts:
+                # map-side combine per partition, then fold into the global
+                local: dict = {}
+                for k, v in p():
+                    local[k] = f(local[k], v) if k in local else v
+                for k, v in local.items():
+                    acc[k] = f(acc[k], v) if k in acc else v
+            return acc
 
-        def make(idx: int) -> PartitionFn:
-            def gen() -> Iterator[tuple]:
-                for k, v in merged().items():
-                    if hash(k) % n_out == idx:
-                        yield (k, v)
-            return gen
-
-        return PartitionedDataset([make(i) for i in range(n_out)])
+        return self._hash_partitioned_by_key(
+            "reduce_by_key", num_partitions, merged)
 
     def group_by_key(self, num_partitions: int | None = None) -> "PartitionedDataset":
         """Spark ``groupByKey``: (key, [values...]) with values in
@@ -336,30 +349,17 @@ class PartitionedDataset:
         build (appends), NOT reduce_by_key(list concat) — that fold
         copies the accumulated prefix per element, O(m²) on a hot key.
         """
-        self._require_finite("group_by_key")
-        if num_partitions is not None and num_partitions < 1:
-            raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
         parts = self._parts
-        n_out = num_partitions or len(self._parts)
-        memo: dict = {}  # build once (cache() semantics), see reduce_by_key
 
         def grouped() -> dict:
-            if "acc" not in memo:
-                acc: dict = {}
-                for p in parts:
-                    for k, v in p():
-                        acc.setdefault(k, []).append(v)
-                memo["acc"] = acc
-            return memo["acc"]
+            acc: dict = {}
+            for p in parts:
+                for k, v in p():
+                    acc.setdefault(k, []).append(v)
+            return acc
 
-        def make(idx: int) -> PartitionFn:
-            def gen() -> Iterator[tuple]:
-                for k, v in grouped().items():
-                    if hash(k) % n_out == idx:
-                        yield (k, v)
-            return gen
-
-        return PartitionedDataset([make(i) for i in range(n_out)])
+        return self._hash_partitioned_by_key(
+            "group_by_key", num_partitions, grouped)
 
     def sort_by(self, key: Callable[[Any], Any], *, ascending: bool = True,
                 num_partitions: int | None = None) -> "PartitionedDataset":
