@@ -10,6 +10,7 @@ from distributeddeeplearningspark_tpu.data.dataframe import (
     Column,
     DataFrame,
     DataFrameReader,
+    GroupedData,
     col,
     from_dataset,
     from_rows,
@@ -39,6 +40,7 @@ __all__ = [
     "Column",
     "DataFrame",
     "DataFrameReader",
+    "GroupedData",
     "col",
     "from_dataset",
     "from_rows",

@@ -331,6 +331,27 @@ class DataFrame:
             outs.append(self._map_chunks(f, self._columns))
         return outs
 
+    def groupBy(self, *keys: str) -> "GroupedData":
+        """Spark ``groupBy(...).agg(...)`` — the aggregation half of the
+        Criteo feature-engineering surface (per-category counts/means are
+        the classic CTR count-features). Chunk-vectorized per partition
+        (np.unique over stacked key rows + bincount / ufunc.at — no
+        per-row Python), then per-chunk partials merge in a driver dict:
+        the same honest narrow-engine stance as ``rdd.reduce_by_key``
+        (SURVEY §7: no shuffle service), sized for grouped results that
+        fit the driver — which category vocabularies do.
+
+        Multi-column keys are stacked for the unique pass, so mixed key
+        dtypes coerce to the numpy common type (int+str keys become
+        strings in the output); keep keys same-typed when that matters.
+        """
+        missing = [k for k in keys if k not in self._columns]
+        if missing or not keys:
+            raise ValueError(
+                f"groupBy keys {missing or '()'} not in columns "
+                f"{self._columns}")
+        return GroupedData(self, list(keys))
+
     def repartition(self, n: int) -> "DataFrame":
         """Down: concatenate adjacent partitions. Up: split each partition's
         chunk stream round-robin (each new partition re-walks its source
@@ -427,6 +448,110 @@ class DataFrame:
     def __repr__(self) -> str:
         return (f"DataFrame(columns={self._columns}, "
                 f"num_partitions={self.num_partitions})")
+
+
+#: supported GroupedData aggregations; mean derives from (sum, count) so
+#: every entry here is mergeable across chunk partials
+_AGG_FNS = ("count", "sum", "mean", "min", "max")
+
+
+class GroupedData:
+    """Result of :meth:`DataFrame.groupBy`; terminal ops produce a
+    single-partition DataFrame of one row per group."""
+
+    def __init__(self, df: DataFrame, keys: list[str]):
+        self._df = df
+        self._keys = keys
+
+    def count(self) -> DataFrame:
+        """Group sizes as a ``count`` column (pyspark's ``.count()``)."""
+        out = self.agg({self._keys[0]: "count"})
+        return out.withColumnRenamed(f"count({self._keys[0]})", "count")
+
+    def agg(self, spec: Mapping[str, str]) -> DataFrame:
+        """``{"col": "sum"|"mean"|"min"|"max"|"count"}`` → one row per
+        distinct key tuple, pyspark-style ``fn(col)`` output names."""
+        keys, df = self._keys, self._df
+        bad = {c: f for c, f in spec.items()
+               if f not in _AGG_FNS or c not in df.columns}
+        if bad or not spec:
+            raise ValueError(
+                f"unsupported agg spec {bad or spec!r}; columns="
+                f"{df.columns}, fns={_AGG_FNS}")
+
+        # per-chunk vectorized partials: (count, sum, min, max) per value
+        # column — everything mean needs, all mergeable
+        def partial(ch: Chunk) -> dict:
+            n = _chunk_rows(ch)
+            if n == 0:
+                return {}
+            key_arrays = [np.asarray(ch[k]) for k in keys]
+            for k, a in zip(keys, key_arrays):
+                if a.dtype == object:
+                    # np.unique(axis=0) can't take object arrays and its
+                    # TypeError names neither column nor fix — fail clearly
+                    raise ValueError(
+                        f"groupBy key '{k}' has object dtype (e.g. None "
+                        f"among values); fillna()/hash_bucket it to a "
+                        f"concrete dtype first")
+            stacked = np.stack(key_arrays, axis=1)
+            uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
+            g = uniq.shape[0]
+            cnt = np.bincount(inv, minlength=g)
+            out: dict = {}
+            for c, fn in spec.items():
+                if fn == "count":
+                    # bincount already carries the answer; coercing the
+                    # column would also crash string-typed count() keys
+                    out[c] = None
+                    continue
+                v = np.asarray(ch[c], np.float64)
+                s = np.bincount(inv, weights=v, minlength=g)
+                mn = np.full(g, np.inf)
+                mx = np.full(g, -np.inf)
+                np.minimum.at(mn, inv, v)
+                np.maximum.at(mx, inv, v)
+                out[c] = (s, mn, mx)
+            return {tuple(uniq[i]): (int(cnt[i]),
+                                     {c: (None if out[c] is None else
+                                          (out[c][0][i], out[c][1][i],
+                                           out[c][2][i])) for c in spec})
+                    for i in range(g)}
+
+        acc: dict = {}
+        for ch in df._iter_chunks():
+            for key, (cnt, per_col) in partial(ch).items():
+                if key not in acc:
+                    acc[key] = [cnt, dict(per_col)]
+                else:
+                    acc[key][0] += cnt
+                    for c, stats in per_col.items():
+                        if stats is None:  # count-only column: no values
+                            continue
+                        s, mn, mx = stats
+                        s0, mn0, mx0 = acc[key][1][c]
+                        acc[key][1][c] = (s0 + s, min(mn0, mn), max(mx0, mx))
+
+        names = keys + [f"{f}({c})" for c, f in spec.items()]
+        rows_keys = list(acc.keys())
+        chunk: Chunk = {
+            k: np.asarray([rk[i] for rk in rows_keys])
+            for i, k in enumerate(keys)
+        }
+        for c, f in spec.items():
+            if f == "count":
+                vals = [acc[rk][0] for rk in rows_keys]
+            else:
+                vals = [
+                    {"sum": s, "mean": s / cnt_ if cnt_ else np.nan,
+                     "min": mn, "max": mx}[f]
+                    for rk in rows_keys
+                    for cnt_, (s, mn, mx) in [(acc[rk][0], acc[rk][1][c])]
+                ]
+            chunk[f"{f}({c})"] = np.asarray(vals)
+        return DataFrame(
+            PartitionedDataset.from_generators([lambda: iter([chunk])]),
+            names)
 
 
 # ---------------------------------------------------------------------------

@@ -293,3 +293,56 @@ def test_read_parquet_directory(tmp_path):
                        d / f"part-{i}.parquet")
     df = read_parquet(str(d), num_partitions=2)
     assert df.count() == 6
+
+
+def test_groupby_agg_across_partitions():
+    """groupBy().agg(): per-chunk vectorized partials must merge exactly —
+    groups spanning partitions get one output row with the global
+    count/sum/mean/min/max."""
+    rows = [{"cat": i % 3, "x": float(i)} for i in range(12)]
+    df = df_mod.from_rows(rows, num_partitions=3, chunk_rows=2)
+    out = df.groupBy("cat").agg({"x": "mean"}).collect()
+    got = {r["cat"]: r["mean(x)"] for r in out}
+    want = {c: np.mean([r["x"] for r in rows if r["cat"] == c])
+            for c in (0, 1, 2)}
+    assert got == want
+    # every agg fn, one pass each
+    for fn, expect in [("sum", 18.0), ("min", 0.0), ("max", 9.0),
+                       ("count", 4)]:
+        r0 = {r["cat"]: r[f"{fn}(x)"]
+              for r in df.groupBy("cat").agg({"x": fn}).collect()}
+        assert r0[0] == expect, (fn, r0)
+
+
+def test_groupby_count_and_multikey():
+    rows = [{"a": 1, "b": 10, "x": 1.0}, {"a": 1, "b": 10, "x": 2.0},
+            {"a": 1, "b": 20, "x": 3.0}, {"a": 2, "b": 10, "x": 4.0}]
+    df = df_mod.from_rows(rows, num_partitions=2, chunk_rows=1)
+    counts = {(r["a"], r["b"]): r["count"]
+              for r in df.groupBy("a", "b").count().collect()}
+    assert counts == {(1, 10): 2, (1, 20): 1, (2, 10): 1}
+
+
+def test_groupby_rejects_bad_keys_and_spec():
+    df = df_mod.from_rows([{"a": 1, "x": 2.0}])
+    with pytest.raises(ValueError, match="groupBy keys"):
+        df.groupBy("nope")
+    with pytest.raises(ValueError, match="agg spec"):
+        df.groupBy("a").agg({"x": "median"})
+    with pytest.raises(ValueError, match="agg spec"):
+        df.groupBy("a").agg({})
+
+
+def test_groupby_count_on_string_keys_and_null_guard():
+    """count() must not coerce the key column to float (string categories
+    are the primary count-feature case), and None-bearing object keys must
+    fail with a message naming the column, not a numpy internals error."""
+    rows = [{"cat": "a", "x": 1.0}, {"cat": "b", "x": 2.0},
+            {"cat": "a", "x": 3.0}]
+    df = df_mod.from_rows(rows, num_partitions=2, chunk_rows=1)
+    got = {r["cat"]: r["count"] for r in df.groupBy("cat").count().collect()}
+    assert got == {"a": 2, "b": 1}
+    bad = df_mod.from_rows([{"cat": "a", "x": 1.0},
+                            {"cat": None, "x": 2.0}], num_partitions=1)
+    with pytest.raises(ValueError, match="groupBy key 'cat'"):
+        bad.groupBy("cat").agg({"x": "sum"})
