@@ -15,10 +15,12 @@ rebuilds that surface TPU-first:
 - **Lazy + partition-parallel**, riding :class:`~..rdd.PartitionedDataset`:
   transformations compose chunk functions; actions materialize. One
   partition ≙ one data shard, same as the RDD plane.
-- **No shuffle engine** (SURVEY.md §7 "What NOT to build"): verbs that need a
-  cross-partition exchange (joins, groupBy aggregations) are out of scope;
-  the Criteo feature pipeline — typed read, fillna, log-scaling, categorical
-  hashing, split — is narrow and fully covered.
+- **No shuffle engine** (SURVEY.md §7 "What NOT to build"): joins remain
+  out of scope, and ``groupBy(...).agg(...)`` exists WITHOUT one — chunk
+  partials merge in a driver dict (vocab-sized results), the same honest
+  narrow-engine stance as ``rdd.reduce_by_key``. The Criteo feature
+  pipeline — typed read, fillna, log-scaling, categorical hashing,
+  count-features, split — is fully covered.
 
 Expressions are :class:`Column` trees built from :func:`col` / :func:`lit`
 and composed with operators and functions (:func:`log1p`,
@@ -465,12 +467,24 @@ class GroupedData:
 
     def count(self) -> DataFrame:
         """Group sizes as a ``count`` column (pyspark's ``.count()``)."""
+        if "count" in self._keys:
+            # withColumnRenamed would REPLACE the key column with the
+            # counts, silently losing the group identities
+            raise ValueError(
+                "a groupBy key is literally named 'count'; use "
+                "agg({key: 'count'}) (keeps the 'count(key)' name) or "
+                "rename the key column first")
         out = self.agg({self._keys[0]: "count"})
         return out.withColumnRenamed(f"count({self._keys[0]})", "count")
 
     def agg(self, spec: Mapping[str, str]) -> DataFrame:
         """``{"col": "sum"|"mean"|"min"|"max"|"count"}`` → one row per
-        distinct key tuple, pyspark-style ``fn(col)`` output names."""
+        distinct key tuple, pyspark-style ``fn(col)`` output names.
+
+        Lazy like every other verb (the module's contract): the source
+        scan runs on the output's first iteration, memoized cache()-style
+        after that.
+        """
         keys, df = self._keys, self._df
         bad = {c: f for c, f in spec.items()
                if f not in _AGG_FNS or c not in df.columns}
@@ -479,8 +493,10 @@ class GroupedData:
                 f"unsupported agg spec {bad or spec!r}; columns="
                 f"{df.columns}, fns={_AGG_FNS}")
 
-        # per-chunk vectorized partials: (count, sum, min, max) per value
-        # column — everything mean needs, all mergeable
+        # per-chunk vectorized partials: per value column, only the stats
+        # its fn needs (ufunc.at is a per-element C loop — paying min/max
+        # passes for a sum-only spec would undercut the vectorized claim);
+        # mean is derived from (sum, count)
         def partial(ch: Chunk) -> dict:
             n = _chunk_rows(ch)
             if n == 0:
@@ -494,6 +510,14 @@ class GroupedData:
                         f"groupBy key '{k}' has object dtype (e.g. None "
                         f"among values); fillna()/hash_bucket it to a "
                         f"concrete dtype first")
+                if np.issubdtype(a.dtype, np.floating) and np.isnan(a).any():
+                    # tuple(nan) dict keys never compare equal, so NaN
+                    # groups would silently split per chunk instead of
+                    # merging — the fillna-first flow is the documented fix
+                    raise ValueError(
+                        f"groupBy key '{k}' contains NaN; fillna() it "
+                        f"first (NaN never equals NaN, so NaN groups "
+                        f"cannot merge)")
             stacked = np.stack(key_arrays, axis=1)
             uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
             g = uniq.shape[0]
@@ -507,50 +531,69 @@ class GroupedData:
                     continue
                 v = np.asarray(ch[c], np.float64)
                 s = np.bincount(inv, weights=v, minlength=g)
-                mn = np.full(g, np.inf)
-                mx = np.full(g, -np.inf)
-                np.minimum.at(mn, inv, v)
-                np.maximum.at(mx, inv, v)
+                mn = mx = None
+                if fn == "min":
+                    mn = np.full(g, np.inf)
+                    np.minimum.at(mn, inv, v)
+                elif fn == "max":
+                    mx = np.full(g, -np.inf)
+                    np.maximum.at(mx, inv, v)
                 out[c] = (s, mn, mx)
             return {tuple(uniq[i]): (int(cnt[i]),
                                      {c: (None if out[c] is None else
-                                          (out[c][0][i], out[c][1][i],
-                                           out[c][2][i])) for c in spec})
+                                          (out[c][0][i],
+                                           None if out[c][1] is None
+                                           else out[c][1][i],
+                                           None if out[c][2] is None
+                                           else out[c][2][i]))
+                                      for c in spec})
                     for i in range(g)}
 
-        acc: dict = {}
-        for ch in df._iter_chunks():
-            for key, (cnt, per_col) in partial(ch).items():
-                if key not in acc:
-                    acc[key] = [cnt, dict(per_col)]
+        memo: dict = {}
+
+        def result_chunk() -> Chunk:
+            if "chunk" in memo:
+                return memo["chunk"]
+            acc: dict = {}
+            for ch in df._iter_chunks():
+                for key, (cnt, per_col) in partial(ch).items():
+                    if key not in acc:
+                        acc[key] = [cnt, dict(per_col)]
+                    else:
+                        acc[key][0] += cnt
+                        for c, stats in per_col.items():
+                            if stats is None:  # count-only column
+                                continue
+                            s, mn, mx = stats
+                            s0, mn0, mx0 = acc[key][1][c]
+                            acc[key][1][c] = (
+                                s0 + s,
+                                mn0 if mn is None else min(mn0, mn),
+                                mx0 if mx is None else max(mx0, mx))
+            rows_keys = list(acc.keys())
+            chunk: Chunk = {
+                k: np.asarray([rk[i] for rk in rows_keys])
+                for i, k in enumerate(keys)
+            }
+            for c, f in spec.items():
+                if f == "count":
+                    vals = [acc[rk][0] for rk in rows_keys]
                 else:
-                    acc[key][0] += cnt
-                    for c, stats in per_col.items():
-                        if stats is None:  # count-only column: no values
-                            continue
-                        s, mn, mx = stats
-                        s0, mn0, mx0 = acc[key][1][c]
-                        acc[key][1][c] = (s0 + s, min(mn0, mn), max(mx0, mx))
+                    vals = [
+                        {"sum": s, "mean": s / cnt_ if cnt_ else np.nan,
+                         "min": mn, "max": mx}[f]
+                        for rk in rows_keys
+                        for cnt_, (s, mn, mx) in [(acc[rk][0],
+                                                   acc[rk][1][c])]
+                    ]
+                chunk[f"{f}({c})"] = np.asarray(vals)
+            memo["chunk"] = chunk
+            return chunk
 
         names = keys + [f"{f}({c})" for c, f in spec.items()]
-        rows_keys = list(acc.keys())
-        chunk: Chunk = {
-            k: np.asarray([rk[i] for rk in rows_keys])
-            for i, k in enumerate(keys)
-        }
-        for c, f in spec.items():
-            if f == "count":
-                vals = [acc[rk][0] for rk in rows_keys]
-            else:
-                vals = [
-                    {"sum": s, "mean": s / cnt_ if cnt_ else np.nan,
-                     "min": mn, "max": mx}[f]
-                    for rk in rows_keys
-                    for cnt_, (s, mn, mx) in [(acc[rk][0], acc[rk][1][c])]
-                ]
-            chunk[f"{f}({c})"] = np.asarray(vals)
         return DataFrame(
-            PartitionedDataset.from_generators([lambda: iter([chunk])]),
+            PartitionedDataset.from_generators(
+                [lambda: iter([result_chunk()])]),
             names)
 
 

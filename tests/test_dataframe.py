@@ -345,4 +345,34 @@ def test_groupby_count_on_string_keys_and_null_guard():
     bad = df_mod.from_rows([{"cat": "a", "x": 1.0},
                             {"cat": None, "x": 2.0}], num_partitions=1)
     with pytest.raises(ValueError, match="groupBy key 'cat'"):
-        bad.groupBy("cat").agg({"x": "sum"})
+        # agg is lazy (module contract) — the guard fires on first scan
+        bad.groupBy("cat").agg({"x": "sum"}).collect()
+
+
+def test_groupby_nan_key_guard_and_lazy():
+    """NaN keys must fail loudly (NaN != NaN would split groups per chunk),
+    a key literally named 'count' must not be silently destroyed by
+    .count(), and agg() must stay lazy like every other verb."""
+    bad = df_mod.from_rows([{"k": np.nan, "x": 1.0},
+                            {"k": np.nan, "x": 2.0}], num_partitions=1)
+    with pytest.raises(ValueError, match="contains NaN"):
+        bad.groupBy("k").agg({"x": "sum"}).collect()
+    named = df_mod.from_rows([{"count": 1, "x": 2.0}])
+    with pytest.raises(ValueError, match="named 'count'"):
+        named.groupBy("count").count()
+    # laziness: constructing the agg must not scan the source
+    scans = [0]
+
+    def gen():
+        scans[0] += 1
+        yield {"k": np.asarray([1, 1]), "x": np.asarray([1.0, 2.0])}
+
+    from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+    lazy_df = df_mod.DataFrame(
+        PartitionedDataset.from_generators([gen]), ["k", "x"])
+    out = lazy_df.groupBy("k").agg({"x": "sum"})
+    assert scans[0] == 0  # construction scanned nothing
+    assert out.collect() == [{"k": 1, "sum(x)": 3.0}]
+    assert scans[0] == 1
+    out.collect()
+    assert scans[0] == 1  # memoized, cache() semantics

@@ -1,0 +1,100 @@
+"""Render a CHIP_QUEUE .jsonl into the BASELINE.md-ready summary.
+
+VERDICT r4 next-#1's done-condition is "BASELINE.md updated same-day; no
+headline number without a record". The window may open minutes before a
+session ends, so the record→prose step must be mechanical: this tool
+reads the append-only queue file and prints, per item, the headline
+number, timing spread, and the A/B fields that BASELINE.md rows cite —
+ready to paste, with the artifact name attached to every value.
+
+Usage: python tools/queue_report.py CHIP_QUEUE_r05.jsonl [--md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def _per_item(rec: dict) -> str | None:
+    item, r = rec.get("item"), rec.get("record")
+    if item in (None, "probe", "probe_recheck") or not isinstance(r, dict):
+        return None
+    if rec.get("rc") != 0 or "metric" not in r:
+        err = (r.get("error") or r.get("raw_tail")
+               or f"rc={rec.get('rc')}")
+        return f"- **{item}**: FAILED ({str(err)[:160]})"
+    extra = r.get("extra", {})
+    lines = [f"- **{item}**: {r['metric']} = **{r['value']}** {r['unit']}"
+             f" (ts {rec.get('ts', '?')}, {rec.get('elapsed_s', '?')}s)"]
+    for wl in ("resnet50", "bert_base_mlm", "llama_lora", "dlrm",
+               "pallas_kernels", "memory_validation"):
+        w = extra.get(wl)
+        if not isinstance(w, dict):
+            continue
+        bits = []
+        for k in ("step_time_ms", "spread_pct", "mfu", "mfu_model",
+                  "batch_size", "seq_len", "variant", "base_quant",
+                  "moe_experts", "moe_group_size", "moe_dropped_frac",
+                  "segment_ids", "fused_head_loss", "oom_suspected"):
+            if k in w and w[k] not in (None, False, ""):
+                bits.append(f"{k}={w[k]}")
+        if "scatter_ab" in w:
+            sa = w["scatter_ab"]
+            bits.append(
+                f"scatter xla={sa.get('xla_ns_per_row')}ns/row "
+                f"(spread {sa.get('xla_spread_pct')}%) vs pallas="
+                f"{sa.get('pallas_ns_per_row')}ns/row "
+                f"(spread {sa.get('pallas_spread_pct')}%), "
+                f"winner={sa.get('winner')}, "
+                f"spread_met={sa.get('spread_met')}")
+        if "op_breakdown" in w and isinstance(w["op_breakdown"], dict):
+            ops = w["op_breakdown"].get("ops") or []
+            bits.append("op_breakdown top3: " + "; ".join(
+                f"{o['name']} {o['pct']}%" for o in ops[:3]))
+        if "packing_economics" in w:
+            pe = w["packing_economics"]
+            bits.append(
+                f"packing pad_frac {pe.get('per_document_pad_frac')}→"
+                f"{pe.get('packed_pad_frac')} "
+                f"(x{pe.get('packing_speedup_effective')} effective)")
+        if "ulysses_smoke" in w:
+            bits.append(f"ulysses_smoke={w['ulysses_smoke'].get('compile')}")
+        if "error_memory_lines" in w and w["error_memory_lines"]:
+            bits.append(f"oom_lines={w['error_memory_lines'][:2]}")
+        if bits:
+            lines.append(f"    {wl}: " + ", ".join(bits))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path")
+    args = ap.parse_args(argv)
+    n_good = n_fail = 0
+    print(f"## Chip-queue report: {args.path}\n")
+    with open(args.path) as f:
+        for ln in f:
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if rec.get("item") == "probe":
+                print(f"- probe ok={rec.get('ok')} ts={rec.get('ts')}")
+                continue
+            s = _per_item(rec)
+            if s:
+                print(s)
+                n_good += "FAILED" not in s.splitlines()[0]
+                n_fail += "FAILED" in s.splitlines()[0]
+    print(f"\n{n_good} good records, {n_fail} failed — every number above "
+          f"is citable as `{args.path}`")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
