@@ -141,3 +141,65 @@ def test_dlrm_learns(eight_devices):
         accs.append(float(m["accuracy"]))
     assert np.mean(accs[-10:]) > 0.62  # decisively above chance on synthetic CTR
     assert float(m["loss"]) < first
+
+
+def test_dlrm_matches_torch_reference():
+    """Numerical parity vs an independent torch implementation of the same
+    DLRM math (SURVEY §4: torch parity replaces 'compare against the
+    reference' for the absent repo; ResNet/BERT/Llama have theirs — this
+    closes config 4). Weights copied flax→torch; f32 both sides so the
+    comparison is about the MATH (fused-table offsets, log1p dense
+    transform, lower-triangle dot interaction, MLP activations), not bf16
+    rounding."""
+    import torch
+
+    from distributeddeeplearningspark_tpu.models.dlrm import fused_flat_ids
+
+    vocabs = (11, 7, 19)
+    model = DLRM(vocab_sizes=vocabs, embed_dim=8, bottom_mlp=(16, 8),
+                 top_mlp=(16, 1), dtype=np.float32)
+    rng = np.random.default_rng(3)
+    batch = {
+        "dense": rng.normal(0, 2, (4, 13)).astype(np.float32),
+        "sparse": np.stack([rng.integers(0, v, 4) for v in vocabs],
+                           axis=1).astype(np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(1), batch, train=False)["params"]
+    ours = np.asarray(model.apply({"params": params}, batch, train=False))
+
+    def lin(dense_params):
+        """flax Dense {kernel [in,out], bias [out]} → torch Linear."""
+        w = torch.tensor(np.asarray(dense_params["kernel"]).T)
+        b = torch.tensor(np.asarray(dense_params["bias"]))
+        m = torch.nn.Linear(w.shape[1], w.shape[0])
+        with torch.no_grad():
+            m.weight.copy_(w)
+            m.bias.copy_(b)
+        return m
+
+    bot = [lin(params["bottom_mlp"][f"dense_{i}"]) for i in range(2)]
+    top = [lin(params["top_mlp"][f"dense_{i}"]) for i in range(2)]
+    table = torch.tensor(
+        np.asarray(params["embedding"]["embedding_table"]))
+
+    with torch.no_grad():
+        dense = torch.log1p(
+            torch.clamp(torch.tensor(batch["dense"]), min=0.0))
+        x = dense
+        for m in bot:  # final_activation=True: relu after every layer
+            x = torch.relu(m(x))
+        flat = np.asarray(fused_flat_ids(vocabs, batch["sparse"]))
+        emb = table[torch.tensor(flat)]                      # [B, N, D]
+        z = torch.cat([x[:, None, :], emb], dim=1)           # [B, N+1, D]
+        gram = torch.einsum("bnd,bmd->bnm", z, z)
+        li, lj = np.tril_indices(z.shape[1], k=-1)           # row-major,
+        # same enumeration as the flax side's jnp.tril_indices
+        feats = torch.cat([x, gram[:, li, lj]], dim=1)
+        y = feats
+        for i, m in enumerate(top):  # final_activation=False
+            y = m(y)
+            if i < len(top) - 1:
+                y = torch.relu(y)
+        theirs = y[:, 0].numpy()
+
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-5)

@@ -256,3 +256,49 @@ def test_evaluate_raises_when_loss_ignores_eval_mask(eight_devices):
     trainer.init(stack_examples(ds.take(4)))
     with pytest.raises(RuntimeError, match="eval_mask"):
         trainer.evaluate(ds, batch_size=32)
+
+
+def test_lenet_matches_torch_reference():
+    """Numerical parity vs an independent torch LeNet-5 (SURVEY §4: torch
+    parity stands in for the unreachable reference; config 1's model was
+    the last family without one). Weights copied flax→torch; the flatten
+    order is the one real translation hazard (NHWC [B,4,4,16] vs torch's
+    NCHW) and is exercised explicitly."""
+    import torch
+
+    from distributeddeeplearningspark_tpu.models import LeNet5
+
+    model = LeNet5()
+    rng = np.random.default_rng(5)
+    batch = {"image": rng.normal(0, 1, (3, 28, 28, 1)).astype(np.float32)}
+    params = model.init(jax.random.PRNGKey(2), batch, train=False)["params"]
+    ours = np.asarray(model.apply({"params": params}, batch, train=False))
+
+    def conv(p, padding):
+        w = np.asarray(p["kernel"]).transpose(3, 2, 0, 1)  # HWIO→OIHW
+        m = torch.nn.Conv2d(w.shape[1], w.shape[0], w.shape[2],
+                            padding=padding)
+        with torch.no_grad():
+            m.weight.copy_(torch.tensor(w))
+            m.bias.copy_(torch.tensor(np.asarray(p["bias"])))
+        return m
+
+    def lin(p):
+        m = torch.nn.Linear(p["kernel"].shape[0], p["kernel"].shape[1])
+        with torch.no_grad():
+            m.weight.copy_(torch.tensor(np.asarray(p["kernel"]).T))
+            m.bias.copy_(torch.tensor(np.asarray(p["bias"])))
+        return m
+
+    c0, c1 = conv(params["Conv_0"], 2), conv(params["Conv_1"], 0)
+    d0, d1, d2 = (lin(params[f"Dense_{i}"]) for i in range(3))
+    with torch.no_grad():
+        x = torch.tensor(batch["image"].transpose(0, 3, 1, 2))  # NHWC→NCHW
+        x = torch.max_pool2d(torch.relu(c0(x)), 2, 2)
+        x = torch.max_pool2d(torch.relu(c1(x)), 2, 2)
+        # flatten in the flax (NHWC) order, not torch's NCHW order
+        x = x.permute(0, 2, 3, 1).reshape(x.shape[0], -1)
+        x = torch.relu(d0(x))
+        x = torch.relu(d1(x))
+        theirs = d2(x).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-5)
