@@ -637,6 +637,69 @@ def bench_llama(iters: int, batch_size: int | None = None, seq: int = 2048,
     return rec
 
 
+def bench_llama_decode(iters: int, batch_size: int = 8,
+                       prompt_len: int = 128, new_tokens: int = 128,
+                       base_quant: str | None = None) -> dict:
+    """KV-cached decode throughput at the 0.9b bench geometry — the
+    serving-side axis (models/llama_gen.py: prefill + one-token
+    lax.scan). Decode is weight-read-bound per token (batch 8 reads the
+    whole base per step), so this is where int8 base storage should pay
+    beyond fit: the ``--base-quant int8`` A/B measures the "per-token
+    weight reads halve" claim (BASELINE r4 int8 row) that training
+    throughput cannot see.
+    """
+    import jax
+
+    from distributeddeeplearningspark_tpu.models import LlamaForCausalLM
+    from distributeddeeplearningspark_tpu.models.llama_gen import generate
+
+    total = prompt_len + new_tokens
+    cfg = _llama_09b_cfg(seq=total, base_quant=base_quant)
+    rng = np.random.default_rng(11)
+    prompt_ids = rng.integers(
+        0, cfg.vocab_size, (batch_size, prompt_len)).astype(np.int32)
+    params = LlamaForCausalLM(cfg).init(
+        jax.random.PRNGKey(0), {"input_ids": prompt_ids[:, :8]},
+        train=False)["params"]
+
+    def run(seed: int, n: int):
+        out = generate(params, prompt_ids, cfg=cfg, max_new_tokens=n,
+                       temperature=0.0, seed=seed,
+                       max_cache_len=total)
+        return int(jax.device_get(out[0, -1]))  # real sync (axon quirk)
+
+    def timed(n: int, reps: int) -> float:
+        run(0, n)  # compile this shape
+        t0 = time.perf_counter()
+        for i in range(reps):
+            run(i, n)
+        return (time.perf_counter() - t0) / reps
+
+    # prefill is compute-bound and identical in both arms of the int8 A/B
+    # (the bench's whole point is the weight-read-bound DECODE steps), so
+    # subtract a prompt-only run: full − (prefill + 1 step) isolates the
+    # remaining new_tokens−1 scan steps. max_cache_len pinned to `total`
+    # for both shapes so they share cache geometry.
+    reps = max(3, iters // 5)
+    dt_full = timed(new_tokens, reps)
+    dt_prefill = timed(1, reps)
+    per_tok = (dt_full - dt_prefill) / (new_tokens - 1)
+    return {
+        "decode_tokens_per_sec_per_chip": round(batch_size / per_tok, 1),
+        "ms_per_decode_step": round(per_tok * 1e3, 3),
+        "prefill_plus_first_token_ms": round(dt_prefill * 1e3, 1),
+        "end_to_end_tokens_per_sec": round(
+            batch_size * new_tokens / dt_full, 1),
+        "batch_size": batch_size,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "generate_calls_timed": reps,
+        "base_quant": cfg.base_quant,
+        "param_dtype": str(cfg.param_dtype),
+        "chips": 1,
+    }
+
+
 def bench_dlrm(iters: int, batch_size: int = 8192,
                scatter_ab: bool = False) -> dict:
     """DLRM examples/sec/chip (config 4 shape: 13 dense + 26 embeddings).
@@ -1188,6 +1251,12 @@ CHIP_QUEUE: list[tuple[str, list[str], int]] = [
                              "--skip-smoke"], 900),
     ("fused_conv_bn_ab", ["--model", "resnet", "--fused-conv-bn",
                           "--skip-smoke"], 900),
+    # serving-side axis (r5): KV-cached decode tok/s, and the int8 A/B
+    # that measures the "per-token weight reads halve" claim decode-side
+    ("llama_decode", ["--model", "llama", "--decode",
+                      "--skip-smoke"], 900),
+    ("llama_decode_int8", ["--model", "llama", "--decode",
+                           "--base-quant", "int8", "--skip-smoke"], 900),
 ]
 
 
@@ -1352,6 +1421,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fused-head-loss", action="store_true",
                     help="llama only: fuse the LM-head matmul into the loss "
                          "(A/B vs materialized [B,S,V] logits)")
+    ap.add_argument("--decode", action="store_true",
+                    help="llama only: KV-cached generation throughput at "
+                         "the 0.9b shape instead of the train step; with "
+                         "--base-quant int8 it prices the halved per-token "
+                         "weight reads (the serving-side int8 claim)")
     ap.add_argument("--allow-cpu", action="store_true",
                     help="bench on CPU if TPU never initializes (debug only)")
     ap.add_argument("--skip-probe", action="store_true")
@@ -1366,6 +1440,17 @@ def main(argv=None) -> int:
         # mirror --moe-group: a silently ignored flag would let a bf16 run
         # masquerade as the int8 number
         parser.error("--base-quant only applies to the llama bench")
+    if args.decode and args.model != "llama":
+        parser.error("--decode only applies to the llama bench")
+    if args.decode and (args.seq or args.variant != "0.9b"
+                        or args.fused_head_loss or args.segment_ids
+                        or args.moe_experts or args.moe_group):
+        # no silently-ignored flags (the --base-quant/--moe-group guard
+        # pattern): the decode bench pins the 0.9b dense geometry at
+        # prompt=128/new=128 — a requested shape that was dropped would
+        # masquerade as a measured series number
+        parser.error("--decode supports only --batch/--iters/--base-quant; "
+                     "it pins the 0.9b dense prompt=128/new=128 shape")
     if args.moe_group and not args.moe_experts:
         # mirror the config-5 driver's guard: with moe_experts=0 no MoE
         # layer is built, so the flag would silently bench plain dense
@@ -1459,7 +1544,7 @@ def main(argv=None) -> int:
                     "input_pipeline"),
             "resnet": ("resnet50",),
             "bert": ("bert_base_mlm",),
-            "llama": ("llama_lora",),
+            "llama": ("llama_decode",) if args.decode else ("llama_lora",),
             "dlrm": ("dlrm",),
             "input": ("input_pipeline",),
             "kernels": ("pallas_kernels",),
@@ -1488,6 +1573,9 @@ def main(argv=None) -> int:
             args.iters, **({"batch_size": args.batch} if args.batch else {})),
         "dlrm": lambda: bench_dlrm(
             args.iters, scatter_ab=args.scatter_ab,
+            **({"batch_size": args.batch} if args.batch else {})),
+        "llama_decode": lambda: bench_llama_decode(
+            args.iters, base_quant=args.base_quant,
             **({"batch_size": args.batch} if args.batch else {})),
         "pallas_kernels": bench_kernels,
         "memory_validation": bench_memval,
@@ -1523,6 +1611,10 @@ def main(argv=None) -> int:
         # round's evidence)
         value, unit = r.get("tokens_per_sec_per_chip", 0.0), "tokens/sec/chip"
         metric = "llama_lora_tokens_per_sec_per_chip"
+    elif "llama_decode" in results:
+        name, r = "llama_decode", results["llama_decode"]
+        value, unit = r["decode_tokens_per_sec_per_chip"], "tokens/sec/chip"
+        metric = "llama_decode_tokens_per_sec_per_chip"
     elif "dlrm" in results:
         name, r = "dlrm", results["dlrm"]
         value, unit = r["examples_per_sec_per_chip"], "examples/sec/chip"

@@ -458,3 +458,40 @@ def test_llama_09b_cfg_long_context_flip():
     assert long.remat_policy is None and long.fused_head_loss
     # explicit --fused-head-loss still wins at short seq
     assert bench._llama_09b_cfg(seq=2048, fused_head=True).fused_head_loss
+
+
+def test_bench_llama_decode_record(monkeypatch):
+    """--decode mode: the KV-cache generation bench produces its record
+    shape off-chip at a tiny geometry (the 0.9b default is monkeypatched —
+    128 sequential 0.9b decode steps on CPU would take minutes)."""
+    from distributeddeeplearningspark_tpu.models import LlamaConfig
+
+    def tiny_cfg(*, seq=2048, fused_head=False, moe_experts=0, moe_group=0,
+                 base_quant=None):
+        return LlamaConfig(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, intermediate_size=128, max_position=seq,
+            lora_rank=4, dtype="float32", remat=False,
+            base_quant=base_quant)
+
+    monkeypatch.setattr(bench, "_llama_09b_cfg", tiny_cfg)
+    rec = bench.bench_llama_decode(5, batch_size=2, prompt_len=8,
+                                   new_tokens=8)
+    assert rec["decode_tokens_per_sec_per_chip"] > 0
+    assert rec["ms_per_decode_step"] > 0
+    # prefill subtracted: a decode step must be cheaper than the whole
+    # prefill+decode call
+    assert rec["ms_per_decode_step"] * 7 < rec["prefill_plus_first_token_ms"] * 8
+    assert rec["batch_size"] == 2 and rec["new_tokens"] == 8
+    assert rec["base_quant"] is None
+    # int8 composition: same record shape, quantized base leaves
+    rec8 = bench.bench_llama_decode(5, batch_size=2, prompt_len=8,
+                                    new_tokens=8, base_quant="int8")
+    assert rec8["base_quant"] == "int8"
+    # no silently-ignored flags with --decode (the house guard pattern)
+    import pytest
+
+    with pytest.raises(SystemExit):
+        bench.main(["--model", "llama", "--decode", "--seq", "8192"])
+    with pytest.raises(SystemExit):
+        bench.main(["--model", "llama", "--decode", "--variant", "7b"])
