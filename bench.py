@@ -680,12 +680,26 @@ def bench_llama_decode(iters: int, batch_size: int = 8,
     # subtract a prompt-only run: full − (prefill + 1 step) isolates the
     # remaining new_tokens−1 scan steps. max_cache_len pinned to `total`
     # for both shapes so they share cache geometry.
+    if new_tokens < 2:
+        raise ValueError("decode bench needs new_tokens >= 2 (the prompt-"
+                         "only arm subtracts away the first token)")
     reps = max(3, iters // 5)
     dt_full = timed(new_tokens, reps)
     dt_prefill = timed(1, reps)
     per_tok = (dt_full - dt_prefill) / (new_tokens - 1)
+    rec_suspect = {}
+    if per_tok <= 0:
+        # a scheduling hiccup in the prompt-only window can exceed the
+        # full run at small reps — the house timing_suspect convention:
+        # never let a physically impossible number head a series record
+        rec_suspect["timing_suspect"] = (
+            f"prefill-only run ({dt_prefill * 1e3:.1f} ms) >= full run "
+            f"({dt_full * 1e3:.1f} ms); per-step decode time is "
+            f"unmeasurable this run — treat throughput as invalid")
+        per_tok = float("inf")
     return {
         "decode_tokens_per_sec_per_chip": round(batch_size / per_tok, 1),
+        **rec_suspect,
         "ms_per_decode_step": round(per_tok * 1e3, 3),
         "prefill_plus_first_token_ms": round(dt_prefill * 1e3, 1),
         "end_to_end_tokens_per_sec": round(

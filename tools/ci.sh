@@ -37,12 +37,27 @@ run_tier() {  # name, marker-expr
   echo '|---|---|---|---|---|' >> SUITE_LOG.md
 }
 
+run_script_tier() {  # name, script
+  local t0 rc secs
+  t0=$(date +%s)
+  bash "$2"
+  rc=$?
+  secs=$(( $(date +%s) - t0 ))
+  log "$1" "(see SMOKE_LOG.md rows)" "${rc}" "${secs}"
+  echo "[$1] rc=${rc} (${secs}s)"
+  return $rc
+}
+
 overall=0
 case "${1:-both}" in
   fast) run_tier fast "not slow" || overall=$? ;;
   slow) run_tier slow "slow" || overall=$? ;;
   both) run_tier fast "not slow" || overall=$?
         run_tier slow "slow" || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both]"; exit 2 ;;
+  # the executable pod-day scripts, logged with the same audit trail
+  # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
+  smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
+  rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
