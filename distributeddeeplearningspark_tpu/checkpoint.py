@@ -19,12 +19,26 @@ Alongside the model state a small JSON ``data_state`` rides in the same
 checkpoint step (examples seen, epoch), giving deterministic input pipelines
 enough to fast-forward on resume — the analogue of Spark re-running from a
 partition boundary rather than from scratch.
+
+**Crash consistency.** The whole elasticity chain above hinges on the latest
+step being intact — a host killed mid-finalize (or a torn write on a
+non-atomic filesystem) leaves a partial step that a naive ``restore()`` picks
+as latest, and every supervised relaunch then dies at the same restore until
+``max_restarts`` is burned on a poisoned checkpoint. So each committed step
+gets a small **integrity manifest** (per-file size + CRC32, written atomically
+*after* the async save finalizes); :meth:`Checkpointer.verify` recomputes it,
+and ``restore()`` walks back from latest to the newest step that verifies,
+renaming bad steps to ``<step>.corrupt-N`` (quarantine) so they neither get
+retried nor count toward orbax's ``max_to_keep`` retention window.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import threading
+import zlib
 from typing import Any
 
 import jax
@@ -33,6 +47,16 @@ logger = logging.getLogger("distributeddeeplearningspark_tpu.checkpoint")
 
 _STATE = "state"
 _DATA = "data"
+
+#: Integrity manifest filename, written inside each committed step dir.
+MANIFEST_NAME = "dls_manifest.json"
+#: Marker orbax itself writes into a step dir at commit time — its presence
+#: is the structural "this step finalized" signal for manifest-less steps.
+_ORBAX_COMMIT_MARKER = "_CHECKPOINT_METADATA"
+
+
+class RestoreError(RuntimeError):
+    """No intact checkpoint could be restored (all steps corrupt/partial)."""
 
 
 def abstract_like(tree: Any, shardings: Any = None) -> Any:
@@ -44,6 +68,136 @@ def abstract_like(tree: Any, shardings: Any = None) -> Any:
         tree,
         shardings,
     )
+
+
+# -- integrity manifests (plain-filesystem; no orbax dependency) -------------
+
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
+
+
+def _manifest_entries(step_dir: str) -> dict[str, dict[str, int]]:
+    """{relpath: {bytes, crc32}} over every file in the step dir (manifest
+    excluded). Checkpoints here are chip-local shards, so a full-content
+    CRC32 runs at memory bandwidth and stays a rounding error next to the
+    tensorstore write it certifies."""
+    entries: dict[str, dict[str, int]] = {}
+    for root, _, files in os.walk(step_dir):
+        for name in files:
+            if name == MANIFEST_NAME:
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, step_dir)
+            entries[rel] = {"bytes": os.path.getsize(path),
+                            "crc32": _file_crc32(path)}
+    return entries
+
+
+def write_manifest(step_dir: str, *, step: int) -> dict:
+    """Scan a *committed* step dir and commit its manifest atomically
+    (tmp file + ``os.replace`` — a crash mid-write leaves no half manifest,
+    only an unverified step)."""
+    manifest = {
+        "format": 1,
+        "step": int(step),
+        "items": sorted(d for d in os.listdir(step_dir)
+                        if os.path.isdir(os.path.join(step_dir, d))),
+        "files": _manifest_entries(step_dir),
+    }
+    tmp = os.path.join(step_dir, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(step_dir, MANIFEST_NAME))
+    return manifest
+
+
+def read_manifest(step_dir: str) -> dict | None:
+    try:
+        with open(os.path.join(step_dir, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def verify_step_dir(step_dir: str) -> tuple[bool, str]:
+    """(ok, reason) for one step dir.
+
+    With a manifest: every listed file must exist with matching size+CRC and
+    no extra files may have appeared. Without one (the step committed but the
+    writer died before the manifest flush): fall back to the structural
+    check — orbax commits a step by atomic rename *after* writing its
+    ``_CHECKPOINT_METADATA`` marker, so marker + a non-empty ``state`` item
+    means the rename happened and the step is whole on any POSIX filesystem.
+    """
+    if not os.path.isdir(step_dir):
+        return False, "step dir missing"
+    manifest = read_manifest(step_dir)
+    if manifest is None:
+        if not os.path.exists(os.path.join(step_dir, _ORBAX_COMMIT_MARKER)):
+            return False, "no manifest and no orbax commit marker"
+        state_dir = os.path.join(step_dir, _STATE)
+        if not (os.path.isdir(state_dir) and os.listdir(state_dir)):
+            return False, "no manifest and state item missing/empty"
+        return True, "no manifest; structurally committed"
+    want = manifest.get("files", {})
+    have = _manifest_entries(step_dir)
+    missing = sorted(set(want) - set(have))
+    if missing:
+        return False, f"missing files {missing[:3]}"
+    extra = sorted(set(have) - set(want))
+    if extra:
+        return False, f"unexpected files {extra[:3]}"
+    for rel, meta in want.items():
+        got = have[rel]
+        if got["bytes"] != meta["bytes"]:
+            return False, (f"{rel}: size {got['bytes']} != "
+                           f"manifest {meta['bytes']}")
+        if got["crc32"] != meta["crc32"]:
+            return False, f"{rel}: content checksum mismatch"
+    return True, "manifest verified"
+
+
+def quarantine_step_dir(directory: str, step: int) -> str | None:
+    """Rename ``<directory>/<step>`` to ``<directory>/<step>.corrupt-N``.
+
+    Pure filesystem (usable by the supervisor without an orbax manager).
+    Quarantined dirs are invisible to orbax, so they are neither re-picked
+    as latest nor counted toward ``max_to_keep``; operators can autopsy or
+    delete them (docs/POD_PLAYBOOK.md 'Recovery runbook'). Returns the new
+    path, or None if the step dir was already gone (e.g. another process in
+    the gang won the rename race)."""
+    src = os.path.join(directory, str(int(step)))
+    if not os.path.isdir(src):
+        return None
+    n = 0
+    while os.path.exists(f"{src}.corrupt-{n}"):
+        n += 1
+    dst = f"{src}.corrupt-{n}"
+    try:
+        os.rename(src, dst)
+    except OSError:  # lost the rename race to a gang peer — same outcome
+        return None
+    logger.warning("quarantined corrupt checkpoint step %s -> %s", step, dst)
+    return dst
+
+
+def latest_step_in(directory: str) -> int | None:
+    """Newest committed step number by directory listing (no orbax)."""
+    try:
+        steps = [int(d) for d in os.listdir(directory)
+                 if d.isdigit() and os.path.isdir(os.path.join(directory, d))]
+    except OSError:
+        return None
+    return max(steps) if steps else None
 
 
 class Checkpointer:
@@ -59,22 +213,40 @@ class Checkpointer:
         Write in a background thread so training continues during the save
         (the TPU-first replacement for the reference's blocking driver-side
         ``torch.save``). ``wait()`` or ``close()`` joins outstanding writes.
+    verify_on_restore:
+        Walk back from latest to the newest step passing :meth:`verify` when
+        restoring without an explicit ``step``, quarantining corrupt steps.
+        ``False`` restores the pre-manifest behavior (latest, sight unseen).
     quiet_deps:
         orbax narrates every save/restore phase at INFO through the root
         logger; by default the 'orbax'/'absl' loggers are capped to WARNING
         *here* (not at import time, so merely importing this package never
         mutates global logging state). Pass ``False`` to keep their output.
+
+    Manifest lifecycle: ``save()`` queues the async write and the step's
+    manifest is committed at the next natural finalize point — the following
+    ``save()`` call (orbax serializes async saves, so by then the previous
+    step is durable), or ``wait()``/``close()``/``restore()``. Only process 0
+    writes manifests (shared-filesystem contract, same as orbax metadata).
     """
 
     def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 3,
-                 async_save: bool = True, quiet_deps: bool = True):
+                 async_save: bool = True, verify_on_restore: bool = True,
+                 quiet_deps: bool = True):
         import orbax.checkpoint as ocp
 
         if quiet_deps:
             for _name in ("orbax", "absl"):
                 logging.getLogger(_name).setLevel(logging.WARNING)
         self.directory = os.path.abspath(os.fspath(directory))
+        self.verify_on_restore = verify_on_restore
         os.makedirs(self.directory, exist_ok=True)
+        self._pending_manifest: set[int] = set()
+        self._manifest_lock = threading.Lock()
+        # manifests flush on a helper thread so the full-content CRC of a
+        # multi-GB shard never stalls the training loop that async_save
+        # exists to keep unblocked
+        self._manifest_thread: threading.Thread | None = None
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -82,6 +254,9 @@ class Checkpointer:
                 enable_async_checkpointing=async_save,
             ),
         )
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
 
     # -- write ---------------------------------------------------------------
 
@@ -94,9 +269,80 @@ class Checkpointer:
         if data_state is not None:
             items[_DATA] = ocp.args.JsonSave(data_state)
         saved = self._mgr.save(int(step), args=ocp.args.Composite(**items), force=force)
+        # orbax waited out any previous in-flight save before starting this
+        # one, so every earlier pending step is committed — manifest time
+        # (on the helper thread: CRCing the previous step's shards overlaps
+        # the next training steps, like the save itself does)
+        self._join_manifest_thread()
         if saved:
+            with self._manifest_lock:
+                self._pending_manifest.add(int(step))
             logger.info("checkpoint step %d queued → %s", step, self.directory)
+        self._manifest_thread = threading.Thread(
+            target=self._flush_manifests, kwargs={"exclude": int(step)},
+            daemon=True)
+        self._manifest_thread.start()
         return saved
+
+    def _join_manifest_thread(self) -> None:
+        if self._manifest_thread is not None:
+            self._manifest_thread.join()
+            self._manifest_thread = None
+
+    def _flush_manifests(self, exclude: int | None = None) -> None:
+        """Write manifests for every pending step whose save has finalized.
+
+        Steps GC'd by retention before (or during) their manifest flush
+        simply drop out — their dir is gone, or the CRC walk hits a vanishing
+        file and the step is retried at the next flush point. Multi-process:
+        process 0 writes; other processes drop their pending set in lockstep
+        (they verify by *reading* the shared manifest, never by writing)."""
+        with self._manifest_lock:
+            pending = sorted(self._pending_manifest)
+        for step in pending:
+            if step == exclude:
+                continue
+            step_dir = self._step_dir(step)
+            try:
+                if os.path.isdir(step_dir):
+                    if jax.process_index() == 0:
+                        write_manifest(step_dir, step=step)
+                        logger.info(
+                            "manifest committed for checkpoint step %d", step)
+            except OSError:  # GC raced the walk: retry at the next flush
+                continue
+            with self._manifest_lock:
+                self._pending_manifest.discard(step)
+
+    # -- integrity -----------------------------------------------------------
+
+    def verify(self, step: int) -> bool:
+        """True iff ``step``'s on-disk bytes match its integrity manifest
+        (or, for a manifest-less step, orbax's structural commit marker)."""
+        ok, reason = verify_step_dir(self._step_dir(step))
+        if not ok:
+            logger.warning("checkpoint step %d failed integrity: %s", step, reason)
+        return ok
+
+    def latest_verified_step(self) -> int | None:
+        """Newest step that passes :meth:`verify` (no quarantining)."""
+        for step in sorted(self.all_steps(), reverse=True):
+            if verify_step_dir(self._step_dir(step))[0]:
+                return step
+        return None
+
+    def quarantine(self, step: int) -> None:
+        """Rename ``step`` out of orbax's sight (``<step>.corrupt-N``) — used
+        internally for integrity failures, and by the Trainer's rollback when
+        a byte-intact checkpoint turns out to hold non-finite state."""
+        if jax.process_index() == 0:
+            quarantine_step_dir(self.directory, step)
+        # the manager caches its step list; re-read the filesystem so the
+        # quarantined step vanishes from latest/all_steps and GC accounting
+        try:
+            self._mgr.reload()
+        except Exception:  # older orbax without reload(): listing is live
+            pass
 
     # -- read ----------------------------------------------------------------
 
@@ -106,9 +352,42 @@ class Checkpointer:
     def all_steps(self) -> list[int]:
         return sorted(self._mgr.all_steps())
 
+    def _pick_step(self) -> int:
+        """Latest step when trusted; else newest *verified* step, quarantining
+        every corrupt step passed over on the way down."""
+        steps = sorted(self.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if not self.verify_on_restore:
+            return steps[0]
+        for step in steps:
+            step_dir = self._step_dir(step)
+            if not os.path.isdir(step_dir):
+                if any(e.startswith(f"{int(step)}.corrupt-")
+                       for e in os.listdir(self.directory)):
+                    # a gang peer won the quarantine race mid-walk — keep
+                    # walking back, exactly as if we had renamed it ourselves
+                    continue
+                # non-default orbax step-name format: nothing at the default
+                # path to verify (or quarantine) — trust the manager's
+                # listing, exactly as the metadata fallback in restore() does
+                return step
+            ok, reason = verify_step_dir(step_dir)
+            if ok:
+                return step
+            logger.error(
+                "checkpoint step %d is corrupt/partial (%s); quarantining "
+                "and falling back to the previous step", step, reason)
+            self.quarantine(step)
+        raise RestoreError(
+            f"no intact checkpoint under {self.directory}: every step "
+            f"{sorted(steps)} failed integrity verification (quarantined as "
+            f"*.corrupt-N)")
+
     def restore(self, state_template: Any, *, step: int | None = None,
                 shardings: Any = None) -> tuple[Any, dict | None]:
-        """Restore ``(state, data_state)`` at ``step`` (default: latest).
+        """Restore ``(state, data_state)`` at ``step`` (default: newest step
+        that passes integrity verification — see :meth:`verify`).
 
         ``state_template`` provides structure/shapes/dtypes (concrete arrays
         or ``jax.eval_shape`` output both work). ``shardings`` — typically the
@@ -116,16 +395,30 @@ class Checkpointer:
         read only its slice; this is what makes cross-topology restore work.
         With ``shardings=None`` arrays restore with the layout recorded in the
         checkpoint (same-topology resume only).
+
+        An explicitly requested ``step`` is verified but never walked back
+        from: if its bytes don't match its manifest, :class:`RestoreError`
+        is raised (the caller asked for *that* step).
         """
         import orbax.checkpoint as ocp
 
+        # join any in-flight save and commit its manifest first: restore
+        # must see a stable directory (rollback-mid-fit restores the step
+        # whose save may still be finalizing)
+        self.wait()
         if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+            step = self._pick_step()
+        elif self.verify_on_restore and os.path.isdir(self._step_dir(step)):
+            # (a step living under a non-default step-name format has no
+            # default-path dir to verify — fall through to orbax)
+            ok, reason = verify_step_dir(self._step_dir(step))
+            if not ok:
+                raise RestoreError(
+                    f"requested checkpoint step {step} failed integrity "
+                    f"verification: {reason}")
         abstract = abstract_like(state_template, shardings)
         items = {_STATE: ocp.args.StandardRestore(abstract)}
-        step_dir = os.path.join(self.directory, str(int(step)))
+        step_dir = self._step_dir(step)
         if os.path.isdir(step_dir):
             present = set(os.listdir(step_dir))
         else:  # non-default step-name format; fall back to orbax metadata
@@ -143,10 +436,16 @@ class Checkpointer:
     # -- lifecycle -----------------------------------------------------------
 
     def wait(self) -> None:
-        """Block until queued async saves are durable."""
+        """Block until queued async saves are durable (and manifested)."""
         self._mgr.wait_until_finished()
+        self._join_manifest_thread()
+        self._flush_manifests()
 
     def close(self) -> None:
+        try:
+            self.wait()
+        except Exception:  # closing must not mask the original failure
+            logger.exception("checkpoint finalize during close() failed")
         self._mgr.close()
 
     def __enter__(self) -> "Checkpointer":

@@ -1,0 +1,151 @@
+"""Deterministic, env-driven fault injection for recovery drills.
+
+Spark's fault-tolerance story is exercised in the reference by killing
+executors under a `local[2]` testbed (SURVEY.md §4); the rebuild's equivalent
+is a gang worker that hurts *itself* at a declared step. Faults are declared
+through one env var so the same unmodified driver script can be driven
+through every failure mode by the supervisor tests::
+
+    DLS_FAULT=crash@15          # SIGKILL self before train step 15
+    DLS_FAULT=hang@15           # stop making progress at step 15 (sleep)
+    DLS_FAULT=nan@15            # poison the step-15 batch with NaNs
+    DLS_FAULT=truncate_ckpt@20  # after the step-20 checkpoint finalizes,
+                                # tear a byte range out of it, then SIGKILL
+                                # (the kill-mid-finalize torn write)
+
+Determinism rules:
+
+- A fault fires on **attempt 0 only** (``DLS_RESTART`` != "0" disables it),
+  so a supervisor relaunch runs clean — set ``DLS_FAULT_ALL_ATTEMPTS=1`` to
+  keep faulting across restarts (for testing that the supervisor gives up).
+- In a multi-process gang every process sees the same env; set
+  ``DLS_FAULT_RANK=k`` to restrict the fault to ``jax.process_index() == k``.
+- ``nan`` fires exactly once (the equality-matched step); ``crash``/``hang``
+  never return; ``truncate_ckpt`` fires at the first checkpoint boundary at
+  or after its step.
+
+:class:`~.train.trainer.Trainer` consults :func:`get` once per ``fit`` and
+pays zero per-step cost when no fault is declared (the common case).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import time
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.faults")
+
+KINDS = ("crash", "hang", "nan", "truncate_ckpt")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One declared fault: ``kind`` fires at train step ``step`` (1-based,
+    i.e. the step whose completion would set ``state.step == step``)."""
+
+    kind: str
+    step: int
+
+
+def parse(spec: str) -> Fault:
+    """Parse ``kind@step`` (raises ValueError on malformed specs — a typo'd
+    drill must fail loudly, not run fault-free and "pass")."""
+    kind, sep, at = spec.partition("@")
+    if not sep or kind not in KINDS:
+        raise ValueError(
+            f"bad DLS_FAULT {spec!r}: expected one of "
+            f"{'|'.join(KINDS)}@<step>")
+    try:
+        step = int(at)
+    except ValueError:
+        raise ValueError(f"bad DLS_FAULT step in {spec!r}: {at!r} is not an int")
+    if step < 1:
+        raise ValueError(f"bad DLS_FAULT step {step}: steps are 1-based")
+    return Fault(kind, step)
+
+
+def get() -> Fault | None:
+    """The fault this process should inject, or None (the common case).
+
+    Reads ``DLS_FAULT`` fresh each call (faults are rare; caching would only
+    complicate tests) and applies the attempt/rank gating documented above.
+    """
+    spec = os.environ.get("DLS_FAULT")
+    if not spec:
+        return None
+    if (os.environ.get("DLS_RESTART", "0") != "0"
+            and os.environ.get("DLS_FAULT_ALL_ATTEMPTS") != "1"):
+        return None
+    rank = os.environ.get("DLS_FAULT_RANK")
+    if rank is not None:
+        import jax
+
+        if jax.process_index() != int(rank):
+            return None
+    return parse(spec)
+
+
+# -- the injections ----------------------------------------------------------
+
+
+def crash() -> None:
+    """SIGKILL this process — no atexit, no flush, exactly like a pod host
+    dropping off the ICI fabric."""
+    logger.warning("fault injection: SIGKILL self (pid %d)", os.getpid())
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def hang(seconds: float = 3600.0) -> None:
+    """Stop making progress without exiting — the silent stuck-collective
+    shape. The supervisor's hang watchdog is what should end this."""
+    logger.warning("fault injection: hanging for %.0fs", seconds)
+    time.sleep(seconds)
+
+
+def nan_batch(batch: dict) -> dict:
+    """Poison every float leaf of the batch with NaNs (a torn input record /
+    bad shard read — the transient divergence trigger)."""
+    import jax
+    import jax.numpy as jnp
+
+    logger.warning("fault injection: NaN batch")
+    return jax.tree.map(
+        lambda x: x * jnp.nan if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        batch,
+    )
+
+
+def truncate_latest_checkpoint(directory: str) -> str | None:
+    """Tear the newest committed checkpoint step: truncate the largest data
+    file in half. The manifest (already committed) now disagrees with the
+    bytes on disk — exactly the torn-write a SIGKILL mid-finalize leaves on
+    a non-atomic filesystem. Returns the truncated file path (None if there
+    was nothing to tear)."""
+    from distributeddeeplearningspark_tpu.checkpoint import (
+        MANIFEST_NAME,
+        latest_step_in,
+    )
+
+    step = latest_step_in(directory)
+    if step is None:
+        return None
+    step_dir = os.path.join(directory, str(step))
+    victim, vsize = None, 0
+    for root, _, files in os.walk(step_dir):
+        for f in files:
+            if f == MANIFEST_NAME:
+                continue  # the manifest must survive to tell on the tear
+            p = os.path.join(root, f)
+            sz = os.path.getsize(p)
+            if sz > vsize:
+                victim, vsize = p, sz
+    if victim is None:
+        return None
+    with open(victim, "r+b") as fh:
+        fh.truncate(max(1, vsize // 2))
+    logger.warning("fault injection: truncated %s (%d -> %d bytes)",
+                   victim, vsize, max(1, vsize // 2))
+    return victim

@@ -351,6 +351,18 @@ class MetricLogger:
             for k, v in metrics.items():
                 self._tb.add_scalar(k, v, step)
 
+    def event(self, step: int, kind: str, **fields) -> None:
+        """Surface a recovery event (divergence skip, rollback, restore
+        fallback) as its own WARNING log line + a ``recovery/<kind>`` TB
+        scalar — these are the lines an operator greps for after an incident,
+        so they must not drown in the per-step metric stream."""
+        if jax.process_index() != 0:
+            return
+        logger.warning("recovery event at step %d: %s %s", step, kind,
+                       json.dumps(fields, default=str))
+        if self._tb is not None:
+            self._tb.add_scalar(f"recovery/{kind}", 1.0, step)
+
     def close(self) -> None:
         if self._tb is not None:
             self._tb.close()

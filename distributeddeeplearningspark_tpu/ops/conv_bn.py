@@ -48,7 +48,9 @@ from jax.experimental import pallas as pl
 def _grid_params(*semantics: str):
     from jax.experimental.pallas import tpu as pltpu
 
-    return pltpu.CompilerParams(dimension_semantics=semantics)
+    # jax >= 0.5 renamed TPUCompilerParams -> CompilerParams
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=semantics)
 
 
 def _vmem():

@@ -90,7 +90,9 @@ def _grid_params(*semantics: str):
     dominates (~90µs/step — 10× slower than XLA attention at s=512)."""
     from jax.experimental.pallas import tpu as pltpu
 
-    return pltpu.CompilerParams(dimension_semantics=semantics)
+    # jax >= 0.5 renamed TPUCompilerParams -> CompilerParams
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=semantics)
 
 
 def _block_mask(qb, kb, s_blk, *, causal, mask_blk, block_q, block_k,

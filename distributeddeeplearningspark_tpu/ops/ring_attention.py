@@ -49,6 +49,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributeddeeplearningspark_tpu.parallel import collectives
+from distributeddeeplearningspark_tpu.parallel.collectives import shard_map
 from distributeddeeplearningspark_tpu.parallel.mesh import (
     AXIS_SEQ,
     AXIS_TENSOR,
@@ -118,8 +120,11 @@ def _ring_fwd_local(q, k, v, mask, segs, *, axis_name, causal, scale):
     flash kernel) and every einsum runs grouped — the KV blocks riding the
     ring are never copied up to Q-head width.
     """
-    axis_size = lax.axis_size(axis_name)
-    my_idx = lax.axis_index(axis_name)
+    axis_size = collectives.axis_size(axis_name)
+    # ring position is only consumed by the causal positional mask; a
+    # dead axis_index would leave a naked PartitionId op that older
+    # (jax<0.5) SPMD partitioners refuse to partition
+    my_idx = lax.axis_index(axis_name) if causal else 0
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     g = h // hkv
@@ -199,8 +204,11 @@ def _ring_bwd_local(q, k, v, mask, segs, o, lse, do, *, axis_name, causal,
     never stored across hops) — O(S/ring) residuals, per the Ring Attention
     paper's blockwise backward.
     """
-    axis_size = lax.axis_size(axis_name)
-    my_idx = lax.axis_index(axis_name)
+    axis_size = collectives.axis_size(axis_name)
+    # ring position is only consumed by the causal positional mask; a
+    # dead axis_index would leave a naked PartitionId op that older
+    # (jax<0.5) SPMD partitioners refuse to partition
+    my_idx = lax.axis_index(axis_name) if causal else 0
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     g = h // hkv
@@ -314,8 +322,11 @@ def _ring_fwd_flash(q, k, v, mask, segs, *, axis_name, causal, scale,
     """
     from distributeddeeplearningspark_tpu.ops import flash_attention as fa
 
-    axis_size = lax.axis_size(axis_name)
-    my_idx = lax.axis_index(axis_name)
+    axis_size = collectives.axis_size(axis_name)
+    # ring position is only consumed by the causal positional mask; a
+    # dead axis_index would leave a naked PartitionId op that older
+    # (jax<0.5) SPMD partitioners refuse to partition
+    my_idx = lax.axis_index(axis_name) if causal else 0
     b, sq, h, d = q.shape
     hkv = k.shape[2]
     group = h // hkv
@@ -373,8 +384,11 @@ def _ring_bwd_flash(q, k, v, mask, segs, o, lse, do, *, axis_name, causal,
     """
     from distributeddeeplearningspark_tpu.ops import flash_attention as fa
 
-    axis_size = lax.axis_size(axis_name)
-    my_idx = lax.axis_index(axis_name)
+    axis_size = collectives.axis_size(axis_name)
+    # ring position is only consumed by the causal positional mask; a
+    # dead axis_index would leave a naked PartitionId op that older
+    # (jax<0.5) SPMD partitioners refuse to partition
+    my_idx = lax.axis_index(axis_name) if causal else 0
     b, sq, h, d = q.shape
     hkv = k.shape[2]
     group = h // hkv
@@ -609,7 +623,7 @@ def ring_attention(
         return _ring_attention_local(
             qq, kk, vv, mm, ss, AXIS_SEQ, causal, scale, impl)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(spec, spec, spec,

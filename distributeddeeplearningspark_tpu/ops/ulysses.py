@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distributeddeeplearningspark_tpu.parallel.collectives import shard_map
 from distributeddeeplearningspark_tpu.parallel.mesh import (
     AXIS_SEQ,
     AXIS_TENSOR,
@@ -199,7 +200,7 @@ def ulysses_attention(
                               tiled=True)
 
     spec = P(BATCH_AXES, AXIS_SEQ, AXIS_TENSOR, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(spec, spec, spec,

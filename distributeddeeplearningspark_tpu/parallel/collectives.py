@@ -35,6 +35,27 @@ from distributeddeeplearningspark_tpu.parallel.mesh import BATCH_AXES
 AxisNames = str | Sequence[str]
 
 
+def axis_size(axis_name: AxisNames) -> int:
+    """``lax.axis_size`` for jax versions that predate it (the classic
+    ``psum(1, axis)`` constant-folds to the static mesh axis size)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` across the 0.4→0.5 API move: older jax keeps it in
+    ``jax.experimental.shard_map`` and spells ``check_vma`` as ``check_rep``.
+    Every shard_map in this package goes through here."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    if "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _sm(f, **kwargs)
+
+
 def all_reduce_sum(tree: Any, axis: AxisNames = BATCH_AXES) -> Any:
     """Horovod ``allreduce(op=Sum)`` ≙ ``lax.psum`` over the mesh axis."""
     return jax.tree.map(lambda x: lax.psum(x, axis), tree)

@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import random
 import signal
 import socket
 import subprocess
@@ -33,6 +34,14 @@ import sys
 import time
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.supervisor")
+
+#: Sentinel exit code workers use to say "I died restoring the checkpoint,
+#: not training" (tests/workers/worker.py exits with it when
+#: ``Trainer.restore`` raises). A relaunch after this code is doomed to the
+#: identical crash unless the checkpoint it restores changes — so the
+#: supervisor quarantines the latest step and falls back to the previous one
+#: instead of burning ``max_restarts`` on a poisoned checkpoint.
+RESTORE_FAILED_EXIT = 13
 
 
 def free_port() -> int:
@@ -48,6 +57,14 @@ class Attempt:
     ordinal: int
     returncodes: list[int]
     duration_s: float
+    #: Failure class: "clean" | "training-crash" | "restore-failure" | "hang"
+    #: (see :meth:`Supervisor._classify`). Drives the restart strategy and
+    #: gives operators one log line naming WHICH recovery path fired.
+    classification: str = ""
+    #: Whether any progress evidence (heartbeat/checkpoint mtime) appeared
+    #: during the attempt — the signal separating "crashed at restore" from
+    #: "crashed mid-training" when no sentinel exit code arrives.
+    made_progress: bool = False
 
     @property
     def ok(self) -> bool:
@@ -82,6 +99,25 @@ class Supervisor:
     the silent stuck all-reduce. Progress is observed as mtime changes under
     ``progress_path`` (typically the checkpoint dir), the same signal a human
     operator would watch.
+
+    **Failure classification & restore fallback.** Each failed attempt is
+    classified (``Attempt.classification``): a worker exiting with
+    :data:`RESTORE_FAILED_EXIT` — or a gang that dies on a restart attempt
+    without ever producing progress evidence while a checkpoint exists — is a
+    **restore-failure**: relaunching against the same checkpoint would crash
+    identically. On the *explicit sentinel* (and only then — circumstantial
+    evidence also fits a crash-right-after-restore and must not destroy a
+    healthy step), up to ``max_restore_fallbacks`` times per run, the latest
+    step under ``ckpt_dir`` is quarantined to ``<step>.corrupt-N`` before
+    the relaunch, forcing the gang onto the previous step. Everything else
+    is a **training-crash** (or **hang**), where plain restart-from-latest
+    is right.
+
+    **Backoff.** Restart delay grows exponentially from
+    ``restart_backoff_s`` (doubling per attempt, capped at
+    ``restart_backoff_max_s``) with ``±backoff_jitter`` relative jitter so a
+    fleet of supervisors recovering from a shared-infra blip doesn't
+    stampede the storage/coordinator in lockstep.
     """
 
     def __init__(
@@ -93,9 +129,14 @@ class Supervisor:
         env: dict[str, str] | None = None,
         poll_interval: float = 0.2,
         restart_backoff_s: float = 0.5,
+        restart_backoff_max_s: float = 30.0,
+        backoff_jitter: float = 0.25,
         hang_timeout_s: float | None = None,
         progress_path: str | None = None,
         startup_grace_s: float | None = None,
+        ckpt_dir: str | None = None,
+        fallback_on_restore_failure: bool = True,
+        max_restore_fallbacks: int = 1,
     ):
         self.argv = list(argv)
         self.num_processes = num_processes
@@ -103,8 +144,18 @@ class Supervisor:
         self.env = dict(env or {})
         self.poll_interval = poll_interval
         self.restart_backoff_s = restart_backoff_s
+        self.restart_backoff_max_s = restart_backoff_max_s
+        self.backoff_jitter = backoff_jitter
         self.hang_timeout_s = hang_timeout_s
         self.progress_path = progress_path
+        # checkpoint root for the restore-failure fallback; progress_path is
+        # "typically the checkpoint dir", so it doubles as the default
+        self.ckpt_dir = ckpt_dir if ckpt_dir is not None else progress_path
+        self.fallback_on_restore_failure = fallback_on_restore_failure
+        # bound on latest-step quarantines per run: exit-13 can also mean a
+        # transient storage error, and unbounded fallback would let a blip
+        # lasting max_restarts attempts eat the whole retention window
+        self.max_restore_fallbacks = max_restore_fallbacks
         # First-progress latency includes JIT compile + checkpoint_every steps,
         # which can dwarf the steady-state checkpoint cadence — give startup
         # its own (longer) window so a healthy gang isn't killed mid-compile.
@@ -172,17 +223,62 @@ class Supervisor:
                 pass
         return latest
 
+    def _has_checkpoint(self) -> bool:
+        """A committed (numeric) step dir exists under ckpt_dir — i.e. the
+        relaunch WILL go down the restore path."""
+        if not self.ckpt_dir or not os.path.isdir(self.ckpt_dir):
+            return False
+        from distributeddeeplearningspark_tpu.checkpoint import latest_step_in
+
+        return latest_step_in(self.ckpt_dir) is not None
+
+    def _classify(self, codes: list[int], *, ordinal: int, hang: bool,
+                  made_progress: bool) -> str:
+        """Name the failure mode so run() can pick the right recovery.
+
+        ``restore-failure`` needs either the explicit sentinel exit code or
+        the circumstantial case: on a RESTART attempt (ordinal > 0 — attempt
+        0 may legitimately crash pre-progress for non-restore reasons, e.g.
+        compile OOM, and must not get a healthy checkpoint quarantined), a
+        checkpoint exists to restore yet the gang died before producing any
+        progress evidence — the shape of "every relaunch crashes at the same
+        restore". Without progress tracking (no progress_path/heartbeats)
+        the circumstantial branch stays quiet: ``made_progress`` is then
+        reported True to avoid misclassifying.
+        """
+        if all(c == 0 for c in codes):
+            return "clean"
+        if hang:
+            return "hang"
+        if any(c == RESTORE_FAILED_EXIT for c in codes):
+            return "restore-failure"
+        if ordinal > 0 and not made_progress and self._has_checkpoint():
+            return "restore-failure"
+        return "training-crash"
+
     def _run_attempt(self, ordinal: int) -> Attempt:
         t0 = time.monotonic()
         procs = self._launch(ordinal)
         last_progress = time.monotonic()
-        stamp = self._progress_stamp()
+        track_progress = self._hb_dir is not None or self.progress_path is not None
+        stamp0 = stamp = self._progress_stamp() if track_progress else 0.0
         seen_progress = False
+        hang = False
+
+        def finish(codes: list[int]) -> Attempt:
+            progressed = (not track_progress
+                          or seen_progress
+                          or self._progress_stamp() > stamp0)
+            cls = self._classify(codes, ordinal=ordinal, hang=hang,
+                                 made_progress=progressed)
+            return Attempt(ordinal, codes, time.monotonic() - t0,
+                           classification=cls, made_progress=progressed)
+
         try:
             while True:
                 codes = [p.poll() for p in procs]
                 if all(c is not None for c in codes):
-                    return Attempt(ordinal, [int(c) for c in codes], time.monotonic() - t0)
+                    return finish([int(c) for c in codes])
                 if any(c is not None and c != 0 for c in codes):
                     failed = [i for i, c in enumerate(codes) if c not in (None, 0)]
                     logger.warning(
@@ -190,8 +286,7 @@ class Supervisor:
                         ordinal, failed, [codes[i] for i in failed],
                     )
                     self._kill(procs)
-                    codes = [p.wait() for p in procs]
-                    return Attempt(ordinal, [int(c) for c in codes], time.monotonic() - t0)
+                    return finish([int(p.wait()) for p in procs])
                 if self.hang_timeout_s is not None:
                     now_stamp = self._progress_stamp()
                     limit = (self.hang_timeout_s if seen_progress
@@ -205,9 +300,16 @@ class Supervisor:
                             ordinal, limit,
                             "steady state" if seen_progress else "startup grace",
                         )
+                        hang = True
                         self._kill(procs)
-                        codes = [p.wait() for p in procs]
-                        return Attempt(ordinal, [int(c) for c in codes], time.monotonic() - t0)
+                        return finish([int(p.wait()) for p in procs])
+                elif track_progress and not seen_progress:
+                    # no hang watchdog, but classification still wants the
+                    # progress bit; sample on the same poll cadence
+                    now_stamp = self._progress_stamp()
+                    if now_stamp > stamp:
+                        stamp = now_stamp
+                        seen_progress = True
                 time.sleep(self.poll_interval)
         except BaseException:
             self._kill(procs)
@@ -229,8 +331,36 @@ class Supervisor:
 
     # -- the restart loop ----------------------------------------------------
 
+    def _backoff_delay(self, ordinal: int) -> float:
+        """Exponential backoff before relaunching after failed attempt
+        ``ordinal``: base · 2^ordinal, capped, with relative jitter."""
+        delay = min(self.restart_backoff_s * (2.0 ** ordinal),
+                    self.restart_backoff_max_s)
+        if self.backoff_jitter:
+            delay *= 1.0 + random.uniform(-self.backoff_jitter,
+                                          self.backoff_jitter)
+        return max(0.0, delay)
+
+    def _fallback_to_previous_step(self) -> None:
+        """Quarantine the latest checkpoint step so the relaunch restores the
+        previous one — the recovery for a verified-but-poisoned checkpoint
+        (restore crashes even though the bytes match the manifest)."""
+        from distributeddeeplearningspark_tpu.checkpoint import (
+            latest_step_in,
+            quarantine_step_dir,
+        )
+
+        step = latest_step_in(self.ckpt_dir)
+        if step is None:
+            return
+        logger.warning(
+            "restore-failure: quarantining checkpoint step %d under %s and "
+            "falling back to the previous step", step, self.ckpt_dir)
+        quarantine_step_dir(self.ckpt_dir, step)
+
     def run(self) -> SupervisorResult:
         attempts: list[Attempt] = []
+        fallbacks = 0
         try:
             for ordinal in range(self.max_restarts + 1):
                 attempt = self._run_attempt(ordinal)
@@ -243,10 +373,30 @@ class Supervisor:
                     return SupervisorResult(attempts)
                 if ordinal < self.max_restarts:
                     logger.warning(
-                        "attempt %d failed (codes %s); restarting from latest checkpoint",
-                        ordinal, attempt.returncodes,
+                        "attempt %d failed (codes %s, classified %s); "
+                        "restarting from checkpoint",
+                        ordinal, attempt.returncodes, attempt.classification,
                     )
-                    time.sleep(self.restart_backoff_s)
+                    # destructive fallback only on the EXPLICIT sentinel: the
+                    # circumstantial classification (no progress + checkpoint
+                    # present) can also fit a deterministic training crash
+                    # right after a successful restore, and quarantining a
+                    # healthy step there would throw away real work — it
+                    # stays a log label + backoff input only
+                    if (RESTORE_FAILED_EXIT in attempt.returncodes
+                            and self.fallback_on_restore_failure
+                            and self.ckpt_dir):
+                        if fallbacks < self.max_restore_fallbacks:
+                            fallbacks += 1
+                            self._fallback_to_previous_step()
+                        else:
+                            logger.warning(
+                                "restore-failure again but %d fallback "
+                                "quarantine(s) already spent — relaunching "
+                                "against the same step (a transient storage "
+                                "error must not eat the retention window)",
+                                fallbacks)
+                    time.sleep(self._backoff_delay(ordinal))
             logger.error("giving up after %d attempt(s)", len(attempts))
             return SupervisorResult(attempts)
         finally:
@@ -269,6 +419,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--hang-timeout", type=float, default=None)
     p.add_argument("--progress-path", default=None,
                    help="dir watched for mtime progress (checkpoint dir)")
+    p.add_argument("--restart-backoff", type=float, default=0.5,
+                   help="base restart delay (doubles per attempt, jittered)")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint root for the restore-failure fallback "
+                        "(defaults to --progress-path)")
+    p.add_argument("--no-restore-fallback", action="store_true",
+                   help="never quarantine the latest step on restore-failure")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command (prefix with --)")
     args = p.parse_args(argv)
@@ -282,6 +439,9 @@ def main(argv: list[str] | None = None) -> int:
         max_restarts=args.max_restarts,
         hang_timeout_s=args.hang_timeout,
         progress_path=args.progress_path,
+        restart_backoff_s=args.restart_backoff,
+        ckpt_dir=args.ckpt_dir,
+        fallback_on_restore_failure=not args.no_restore_fallback,
     ).run()
     return 0 if result.ok else 1
 

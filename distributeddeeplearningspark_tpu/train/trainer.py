@@ -23,6 +23,7 @@ from distributeddeeplearningspark_tpu.data.feed import (
     stack_examples,
 )
 from distributeddeeplearningspark_tpu.data.prefetch import prefetch_to_device
+from distributeddeeplearningspark_tpu import faults
 from distributeddeeplearningspark_tpu.metrics import (
     Meter,
     MetricLogger,
@@ -127,6 +128,9 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
+        # device-side skip guard (fit(on_nonfinite="skip")) — set before
+        # init() builds the jitted step, or fit() rebuilds it on change
+        self._guard_nonfinite = False
 
     # -- setup --------------------------------------------------------------
 
@@ -138,6 +142,23 @@ class Trainer:
         )
         if self.mutable_keys == () and self.state.mutable:
             self.mutable_keys = tuple(self.state.mutable.keys())
+        self._build_train_step()
+        ev = step_lib.make_eval_step(self._apply_fn(), self.loss_fn)
+        self._eval_step = step_lib.jit_eval_step(
+            ev, self.mesh, self.state_shardings, seq_sharded=self.context_parallel
+        )
+        self._predict_step = step_lib.jit_predict_step(
+            step_lib.make_predict_step(self._apply_fn()),
+            self.mesh, self.state_shardings,
+        )
+        logger.info("initialized %s params over mesh %s",
+                    f"{self.state.num_params:,}", dict(self.mesh.shape))
+        return self.state
+
+    def _build_train_step(self) -> None:
+        """(Re)compile the jitted train step from the current trainer config
+        — the ONE place the (accum_steps, guard_nonfinite, trainable, ...)
+        knobs meet make_train_step, shared by init() and fit()'s rebuilds."""
         if self.sparse_embed:
             from distributeddeeplearningspark_tpu.train.embed import (
                 make_sparse_embed_train_step,
@@ -152,21 +173,12 @@ class Trainer:
                 self._apply_fn(), self.tx, self.loss_fn,
                 mutable_keys=self.mutable_keys, rng_names=self.rng_names,
                 accum_steps=self.accum_steps, trainable=self.trainable,
+                guard_nonfinite=self._guard_nonfinite,
             )
         self._train_step = step_lib.jit_train_step(
-            train, self.mesh, self.state_shardings, seq_sharded=self.context_parallel
+            train, self.mesh, self.state_shardings,
+            seq_sharded=self.context_parallel,
         )
-        ev = step_lib.make_eval_step(self._apply_fn(), self.loss_fn)
-        self._eval_step = step_lib.jit_eval_step(
-            ev, self.mesh, self.state_shardings, seq_sharded=self.context_parallel
-        )
-        self._predict_step = step_lib.jit_predict_step(
-            step_lib.make_predict_step(self._apply_fn()),
-            self.mesh, self.state_shardings,
-        )
-        logger.info("initialized %s params over mesh %s",
-                    f"{self.state.num_params:,}", dict(self.mesh.shape))
-        return self.state
 
     def _apply_fn(self):
         """The forward used by train/eval steps — the model's own apply, or
@@ -278,8 +290,17 @@ class Trainer:
         dictated by this trainer's shardings. Call after ``init()``.
         """
         ckpt = checkpointer or self.checkpointer
-        assert ckpt is not None, "no checkpointer configured"
-        assert self.state is not None, "call init() before restore()"
+        # real exceptions, not asserts: restore is the recovery path, and a
+        # python -O relaunch silently skipping these guards would turn a
+        # wiring mistake into an undiagnosable crash deep inside orbax
+        if ckpt is None:
+            raise RuntimeError(
+                "Trainer.restore: no checkpointer configured — pass one to "
+                "the constructor or to restore()")
+        if self.state is None:
+            raise RuntimeError(
+                "Trainer.restore: state is uninitialized — call init() "
+                "(with a sample batch) before restore()")
         self.state, data_state = ckpt.restore(
             self.state, step=step, shardings=self.state_shardings
         )
@@ -323,6 +344,9 @@ class Trainer:
         measure_flops: bool = False,
         tensorboard_dir: str | None = None,
         accum_steps: int | None = None,
+        on_nonfinite: str = "raise",
+        nonfinite_budget: int = 10,
+        max_rollbacks: int = 2,
     ) -> tuple[TrainState, dict[str, float]]:
         """Train until ``steps`` (or dataset exhaustion × ``epochs``).
 
@@ -330,9 +354,44 @@ class Trainer:
         (``batch_size`` stays the GLOBAL batch; it is split into this many
         micro-batches inside the jitted step). Overrides the constructor value.
 
+        ``on_nonfinite`` — the divergence-recovery policy for NaN/Inf losses:
+
+        - ``"raise"`` (default): fail fast at the next log boundary — the
+          historical ``assert_all_finite`` behavior.
+        - ``"skip"``: the jitted step itself withholds the optimizer update
+          on non-finite gradients (params/opt-state/mutables keep their
+          previous values; the poisoned batch is consumed) — a transient
+          NaN spike costs one batch, not the gang. At most
+          ``nonfinite_budget`` steps may be skipped before the run fails
+          (persistent divergence must not masquerade as progress). The
+          summary reports ``skipped_steps``.
+        - ``"rollback"``: on a non-finite loss at a log boundary, reload the
+          newest *verified* checkpoint and keep consuming the data stream
+          from the current position — the model rewinds, the feed does not,
+          so the poisonous batch window is fast-forwarded past. Requires a
+          ``checkpointer`` with at least one saved step; bounded by
+          ``max_rollbacks``. The summary reports ``rollbacks``.
+
+        Recovery events surface through :class:`~..metrics.MetricLogger`
+        WARNING lines (and ``recovery/*`` TensorBoard scalars).
+
         Returns (final state, summary metrics). The loop never blocks on the
         device except at metric log points — steps dispatch asynchronously.
         """
+        if on_nonfinite not in ("raise", "skip", "rollback"):
+            raise ValueError(
+                f"on_nonfinite must be 'raise'|'skip'|'rollback', got "
+                f"{on_nonfinite!r}")
+        if on_nonfinite == "skip" and self.sparse_embed:
+            raise ValueError(
+                "on_nonfinite='skip' is not supported with sparse_embed "
+                "tables (the row-sparse step has no update guard); use "
+                "'rollback' or 'raise'")
+        rebuild = False
+        need_guard = on_nonfinite == "skip"
+        if need_guard != self._guard_nonfinite:
+            self._guard_nonfinite = need_guard
+            rebuild = True
         if accum_steps is not None and accum_steps != self.accum_steps:
             if self.sparse_embed:
                 raise ValueError(
@@ -340,17 +399,10 @@ class Trainer:
                     "(train/embed.py) — recommender batches are already large; "
                     "scale batch_size instead")
             self.accum_steps = accum_steps
-            if self.state is not None:
-                # rebuild the jitted step with the new microbatching
-                train = step_lib.make_train_step(
-                    self._apply_fn(), self.tx, self.loss_fn,
-                    mutable_keys=self.mutable_keys, rng_names=self.rng_names,
-                    accum_steps=self.accum_steps, trainable=self.trainable,
-                )
-                self._train_step = step_lib.jit_train_step(
-                    train, self.mesh, self.state_shardings,
-                    seq_sharded=self.context_parallel,
-                )
+            rebuild = True
+        if rebuild and self.state is not None:
+            # recompile once with the settled (guard, accum) combination
+            self._build_train_step()
         if self.state is None:
             sample = self._sample_batch(dataset, batch_size)
             self.init(sample)
@@ -392,6 +444,16 @@ class Trainer:
                     f"land mid-batch; resume with the original batch size")
             skip = int(data_state["examples_seen"]) // batch_size
         got_batch = False
+        fault = faults.get()
+        skipped_dev = None  # device-side cumulative skip count (stays async)
+        n_skipped = 0
+        rollbacks = 0
+        # extra batches the feed consumed beyond step_i (rollback rewinds the
+        # model, never the stream) — folded into examples_seen so a resume
+        # fast-forwards to the TRUE stream position, not step_i's. A resumed
+        # run inherits the previous run's offset (skip beyond state.step IS
+        # that drift) so re-checkpointing doesn't quietly drop it.
+        rolled_back_batches = max(0, skip - step_i)
         try:
             for batch in self._feed(dataset, batch_size, skip_batches=skip):
                 got_batch = True
@@ -400,6 +462,19 @@ class Trainer:
                 if flops_pending:
                     meter.set_flops(self.compiled_cost(batch))
                     flops_pending = False
+                if fault is not None and step_i + 1 == fault.step \
+                        and fault.kind in ("nan", "crash", "hang"):
+                    kind = fault.kind
+                    # one-shot: a rollback rewinds step_i past the trigger,
+                    # and re-poisoning the retrained window would turn one
+                    # injected spike into an unrecoverable loop
+                    fault = None
+                    if kind == "nan":
+                        batch = faults.nan_batch(batch)
+                    elif kind == "crash":
+                        faults.crash()
+                    else:
+                        faults.hang()
                 profiler.observe(step_i)
                 with profiling.step_annotation(step_i) if profile is not None \
                         else contextlib.nullcontext():
@@ -407,14 +482,91 @@ class Trainer:
                 metrics = dict(metrics)
                 metrics.pop("weight", None)  # eval-aggregation detail, not a log line
                 step_i += 1
+                if self._guard_nonfinite and "skipped" in metrics:
+                    # eager device-side add per step — no host sync; fetched
+                    # only at log boundaries
+                    s = metrics["skipped"]
+                    skipped_dev = s if skipped_dev is None else skipped_dev + s
                 if step_i % log_every == 0 or (steps is not None and step_i >= steps):
                     # device_get blocks until this step's metrics exist, so the
                     # lap boundary is a true device-sync point — timing is honest.
                     last_metrics = meter.lap(step_i - lap_start, jax.device_get(metrics))
                     lap_start = step_i
                     mlog.log(step_i, {**last_metrics, **meter.summary()})
-                    sanitize.assert_all_finite(last_metrics, step=step_i)
                     _touch_heartbeat()
+                    if on_nonfinite == "raise":
+                        sanitize.assert_all_finite(last_metrics, step=step_i)
+                    elif on_nonfinite == "skip":
+                        if skipped_dev is not None:
+                            new_skipped = int(jax.device_get(skipped_dev))
+                            if new_skipped > n_skipped:
+                                mlog.event(
+                                    step_i, "skip",
+                                    skipped_steps=new_skipped,
+                                    nonfinite=sanitize.nonfinite_metrics(last_metrics))
+                            n_skipped = new_skipped
+                            if n_skipped > nonfinite_budget:
+                                raise FloatingPointError(
+                                    f"skipped {n_skipped} non-finite steps, "
+                                    f"over nonfinite_budget={nonfinite_budget} "
+                                    f"— this divergence is persistent, not a "
+                                    f"transient spike; last metrics: "
+                                    f"{last_metrics}")
+                    else:  # rollback
+                        bad = sanitize.nonfinite_metrics(last_metrics)
+                        if bad:
+                            rollbacks += 1
+                            if rollbacks > max_rollbacks:
+                                raise FloatingPointError(
+                                    f"non-finite metrics at step {step_i} "
+                                    f"after exhausting max_rollbacks="
+                                    f"{max_rollbacks}: {bad}")
+                            if self.checkpointer is None:
+                                raise FloatingPointError(
+                                    f"on_nonfinite='rollback' needs a "
+                                    f"checkpointer with a saved step; "
+                                    f"non-finite at step {step_i}: {bad}")
+                            try:
+                                last_bad = None
+                                while True:
+                                    self.restore()
+                                    if sanitize.tree_all_finite(
+                                            self.state.params):
+                                        break
+                                    # byte-intact but numerically poisoned
+                                    # (divergence was checkpointed before a
+                                    # log boundary could see it): discard
+                                    # and walk back further
+                                    ckpt_step = int(
+                                        jax.device_get(self.state.step))
+                                    if ckpt_step == last_bad:
+                                        # quarantine didn't take (read-only
+                                        # fs, non-0 process): refuse to spin
+                                        raise RuntimeError(
+                                            f"could not quarantine poisoned "
+                                            f"checkpoint step {ckpt_step}")
+                                    last_bad = ckpt_step
+                                    logger.warning(
+                                        "rollback target step %d holds "
+                                        "non-finite params; quarantining "
+                                        "and walking back further",
+                                        ckpt_step)
+                                    self.checkpointer.quarantine(ckpt_step)
+                            except Exception as e:
+                                raise FloatingPointError(
+                                    f"rollback from non-finite metrics at "
+                                    f"step {step_i} failed ({e}); bad "
+                                    f"metrics: {bad}") from e
+                            rolled_to = int(jax.device_get(self.state.step))
+                            mlog.event(step_i, "rollback", to_step=rolled_to,
+                                       window=step_i - rolled_to, nonfinite=bad)
+                            rolled_back_batches += step_i - rolled_to
+                            step_i = rolled_to
+                            lap_start = step_i
+                            last_metrics = {}
+                            # the feed keeps streaming forward — the model
+                            # rewound, the poisonous batch window did not
+                            continue
                 if sanitize_every and step_i % sanitize_every == 0:
                     sanitize.assert_replicas_in_sync(self.state.params)
                 for cb in callbacks:
@@ -422,9 +574,18 @@ class Trainer:
                 if checkpoint_every and self.checkpointer and step_i % checkpoint_every == 0:
                     self.checkpointer.save(
                         step_i, self.state,
-                        data_state={"examples_seen": step_i * batch_size,
+                        data_state={"examples_seen":
+                                    (step_i + rolled_back_batches) * batch_size,
                                     "batch_size": batch_size},
                     )
+                    if (fault is not None and fault.kind == "truncate_ckpt"
+                            and step_i >= fault.step):
+                        # kill-mid-finalize drill: make the save durable +
+                        # manifested, tear its bytes, die without warning
+                        self.checkpointer.wait()
+                        faults.truncate_latest_checkpoint(
+                            self.checkpointer.directory)
+                        faults.crash()
                 if eval_every and eval_dataset is not None and step_i % eval_every == 0:
                     emetrics = self.evaluate(eval_dataset, batch_size=batch_size)
                     mlog.log(step_i, {f"eval_{k}": v for k, v in emetrics.items()})
@@ -443,10 +604,20 @@ class Trainer:
                 f"dataset or fewer epochs-already-trained")
         jax.block_until_ready(self.state.params)
         summary = {**meter.summary(), **last_metrics}
+        if on_nonfinite == "skip":
+            if skipped_dev is not None:
+                n_skipped = int(jax.device_get(skipped_dev))
+            summary["skipped_steps"] = float(n_skipped)
+            if n_skipped:
+                logger.warning("run skipped %d non-finite step(s) "
+                               "(on_nonfinite='skip')", n_skipped)
+        elif on_nonfinite == "rollback":
+            summary["rollbacks"] = float(rollbacks)
         if self.checkpointer and checkpoint_every:
             self.checkpointer.save(
                 step_i, self.state,
-                data_state={"examples_seen": step_i * batch_size,
+                data_state={"examples_seen":
+                            (step_i + rolled_back_batches) * batch_size,
                             "batch_size": batch_size},
             )
             self.checkpointer.wait()

@@ -38,3 +38,5 @@ def apply_env_platform_config(min_cpu_devices: int | None = None) -> None:
                 jax.config.update("jax_num_cpu_devices", n)
     except RuntimeError:
         pass  # backend already live; the caller's device checks will report
+    except AttributeError:
+        pass  # jax < 0.5: no jax_num_cpu_devices; XLA_FLAGS env already took

@@ -120,14 +120,39 @@ def assert_replicas_in_sync(
         )
 
 
+def nonfinite_metrics(metrics: dict[str, Any]) -> dict[str, float]:
+    """The NaN/Inf entries of a metrics dict (empty when healthy).
+
+    The non-raising primitive under :func:`assert_all_finite` — the Trainer's
+    divergence-recovery policies (``on_nonfinite="skip"|"rollback"``) need to
+    *observe* a blowup and keep going, not die on it.
+    """
+    return {k: float(v) for k, v in metrics.items()
+            if np.issubdtype(np.asarray(v).dtype, np.floating)
+            and not np.all(np.isfinite(np.asarray(v)))}
+
+
 def assert_all_finite(metrics: dict[str, Any], *, step: int | None = None) -> None:
     """Raise FloatingPointError on NaN/Inf metric values (loss blowup guard)."""
-    bad = {k: float(v) for k, v in metrics.items()
-           if np.issubdtype(np.asarray(v).dtype, np.floating)
-           and not np.all(np.isfinite(np.asarray(v)))}
+    bad = nonfinite_metrics(metrics)
     if bad:
         at = f" at step {step}" if step is not None else ""
         raise FloatingPointError(f"non-finite metrics{at}: {bad}")
+
+
+def tree_all_finite(tree: Any) -> bool:
+    """True iff every float leaf of ``tree`` is entirely finite — the guard
+    a rollback runs on a restored state before trusting it (a checkpoint's
+    integrity manifest certifies bytes, not numerics: a NaN state checkpoints
+    and restores byte-perfectly). One device-side reduction, one host sync.
+    """
+    acc = None
+    for leaf in jax.tree.leaves(tree):
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            continue
+        ok = jnp.all(jnp.isfinite(leaf))
+        acc = ok if acc is None else jnp.logical_and(acc, ok)
+    return True if acc is None else bool(jax.device_get(acc))
 
 
 def enable_nan_checks(enable: bool = True) -> None:

@@ -28,6 +28,9 @@ def main() -> None:
     p.add_argument("--checkpoint-dir", default=None, help="enable checkpointing to this dir")
     p.add_argument("--checkpoint-every", type=int, default=25)
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint")
+    p.add_argument("--on-nonfinite", default="raise",
+                   choices=["raise", "skip", "rollback"],
+                   help="divergence recovery policy (see Trainer.fit)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -58,7 +61,7 @@ def main() -> None:
     state, summary = trainer.fit(
         train_ds.repeat(), batch_size=args.batch_size, steps=args.steps, log_every=25,
         checkpoint_every=args.checkpoint_every if ckpt else None,
-        data_state=data_state,
+        data_state=data_state, on_nonfinite=args.on_nonfinite,
     )
     metrics = trainer.evaluate(test_ds, batch_size=args.batch_size)
     print(f"train summary: {summary}")

@@ -1,0 +1,329 @@
+"""Chaos drills — deterministic fault injection through the full recovery
+chain (faults.py → checkpoint manifests → supervisor classification →
+trainer divergence policies).
+
+Each drill is the end-to-end shape of one production failure mode:
+
+- **kill-mid-checkpoint-finalize** (``DLS_FAULT=truncate_ckpt@N``): the
+  latest step is torn after its manifest committed; the relaunch must walk
+  back to the newest *verified* step, quarantine the torn one, and finish.
+- **restore-poisoned checkpoint**: a step that verifies byte-for-byte but
+  crashes restore (sentinel exit 13); the supervisor must quarantine it and
+  fall back instead of burning every restart on it.
+- **hang** (``DLS_FAULT=hang@N``): progress stops without an exit; the
+  watchdog must kill, classify, and relaunch to completion.
+- **NaN spike** (``DLS_FAULT=nan@N``): ``fit(on_nonfinite=...)`` must
+  contain the divergence (skip) or rewind past it (rollback).
+
+Run via ``bash tools/ci.sh chaos`` (appends its own SUITE_LOG.md line).
+"""
+
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu import faults
+from distributeddeeplearningspark_tpu.supervisor import (
+    RESTORE_FAILED_EXIT,
+    Supervisor,
+)
+
+WORKER = os.path.join(os.path.dirname(__file__), "workers", "worker.py")
+
+# Workers are single-device gang members; they must not inherit the test
+# process's 8-fake-device XLA_FLAGS (same contract as test_supervisor.py).
+_CLEAN_ENV = {"XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"}
+
+
+def _corrupt_dirs(path):
+    return [d for d in os.listdir(path) if re.match(r"\d+\.corrupt-\d+$", d)]
+
+
+# -- fault spec parsing (fast tier: no gangs) --------------------------------
+
+
+def test_fault_parse():
+    f = faults.parse("truncate_ckpt@20")
+    assert (f.kind, f.step) == ("truncate_ckpt", 20)
+    for bad in ("nan", "nan@", "nan@x", "frobnicate@3", "crash@0"):
+        with pytest.raises(ValueError):
+            faults.parse(bad)
+
+
+def test_fault_gating(monkeypatch):
+    monkeypatch.setenv("DLS_FAULT", "nan@3")
+    monkeypatch.delenv("DLS_RESTART", raising=False)
+    assert faults.get() == faults.Fault("nan", 3)
+    monkeypatch.setenv("DLS_RESTART", "1")  # relaunch attempts run clean
+    assert faults.get() is None
+    monkeypatch.setenv("DLS_FAULT_ALL_ATTEMPTS", "1")
+    assert faults.get() == faults.Fault("nan", 3)
+    monkeypatch.delenv("DLS_FAULT")
+    assert faults.get() is None
+
+
+# -- drill 1: SIGKILL mid-checkpoint-finalize --------------------------------
+
+
+@pytest.mark.slow
+def test_kill_mid_finalize_recovers_from_verified_step(tmp_path):
+    """THE acceptance drill: a worker dies mid-checkpoint-finalize leaving a
+    partial latest step (torn bytes, manifest already committed); the
+    supervised relaunch restores from the newest VERIFIED earlier step —
+    quarantining the torn one — and completes within max_restarts."""
+    sup = Supervisor(
+        [sys.executable, WORKER, "train", "--ckpt-dir", str(tmp_path),
+         "--steps", "30", "--checkpoint-every", "10"],
+        num_processes=1, max_restarts=2, restart_backoff_s=0.05,
+        env={**_CLEAN_ENV, "DLS_FAULT": "truncate_ckpt@20"},
+        progress_path=str(tmp_path),
+    )
+    result = sup.run()
+    assert result.ok, f"attempts: {[(a.ordinal, a.returncodes, a.classification) for a in result.attempts]}"
+    assert result.restarts == 1
+    # attempt 0 died by SIGKILL right after tearing step 20
+    assert -9 in result.attempts[0].returncodes
+    step, attempt = open(tmp_path / "DONE").read().split()
+    assert int(step) == 30 and int(attempt) == 1
+    # the torn step 20 was quarantined, not retried and not GC-counted
+    quarantined = _corrupt_dirs(tmp_path)
+    assert any(d.startswith("20.corrupt-") for d in quarantined), (
+        quarantined, sorted(os.listdir(tmp_path)))
+    # training continued past the tear on the relaunch: step 30 committed
+    assert os.path.isdir(tmp_path / "30")
+
+
+# -- drill 2: verified-but-poisoned restore → supervisor fallback ------------
+
+
+def test_restore_failure_falls_back_to_previous_step(tmp_path):
+    """A checkpoint whose BYTES verify but whose restore crashes (sentinel
+    exit 13) must not burn max_restarts: the supervisor quarantines the
+    latest step and the relaunch succeeds on the previous one. Workers are
+    plain python (no jax) so this drill stays in the fast tier."""
+    (tmp_path / "10").mkdir()
+    (tmp_path / "10" / "ok").write_text("good step")
+    (tmp_path / "20").mkdir()
+    (tmp_path / "20" / "ok").write_text("poisoned step")
+    script = (
+        "import os, sys\n"
+        "root = sys.argv[1]\n"
+        "steps = sorted(int(d) for d in os.listdir(root) if d.isdigit())\n"
+        f"if steps[-1] == 20: sys.exit({RESTORE_FAILED_EXIT})\n"
+        "open(os.path.join(root, 'DONE'), 'w').write(str(steps[-1]))\n"
+    )
+    sup = Supervisor(
+        [sys.executable, "-c", script, str(tmp_path)],
+        num_processes=1, max_restarts=2, restart_backoff_s=0.01,
+        backoff_jitter=0.0, ckpt_dir=str(tmp_path),
+    )
+    result = sup.run()
+    assert result.ok, [(a.returncodes, a.classification) for a in result.attempts]
+    assert result.restarts == 1
+    assert result.attempts[0].classification == "restore-failure"
+    assert result.attempts[1].classification == "clean"
+    assert _corrupt_dirs(tmp_path) == ["20.corrupt-0"]
+    assert open(tmp_path / "DONE").read() == "10"
+
+
+def test_restore_failure_without_fallback_burns_restarts(tmp_path):
+    """Control for the drill above: fallback disabled → every attempt dies
+    on the same poisoned step (the pre-PR behavior the ISSUE describes)."""
+    (tmp_path / "20").mkdir()
+    script = f"import sys; sys.exit({RESTORE_FAILED_EXIT})\n"
+    sup = Supervisor(
+        [sys.executable, "-c", script],
+        num_processes=1, max_restarts=2, restart_backoff_s=0.01,
+        backoff_jitter=0.0, ckpt_dir=str(tmp_path),
+        fallback_on_restore_failure=False,
+    )
+    result = sup.run()
+    assert not result.ok
+    assert [a.classification for a in result.attempts] == ["restore-failure"] * 3
+    assert _corrupt_dirs(tmp_path) == []
+
+
+# -- drill 3: hang -----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_hang_is_killed_classified_and_relaunched(tmp_path):
+    """DLS_FAULT=hang@8: attempt 0 stops progressing mid-run; the watchdog
+    kills it, the attempt is classified 'hang', and the relaunch (fault
+    disarmed by DLS_RESTART=1) resumes from the step-5 checkpoint."""
+    sup = Supervisor(
+        [sys.executable, WORKER, "train", "--ckpt-dir", str(tmp_path),
+         "--steps", "15", "--checkpoint-every", "5"],
+        num_processes=1, max_restarts=2, restart_backoff_s=0.05,
+        env={**_CLEAN_ENV, "DLS_FAULT": "hang@8"},
+        hang_timeout_s=8.0, startup_grace_s=240.0,
+        progress_path=str(tmp_path),
+    )
+    result = sup.run()
+    assert result.ok, f"attempts: {[(a.ordinal, a.returncodes, a.classification) for a in result.attempts]}"
+    assert result.restarts == 1
+    assert result.attempts[0].classification == "hang"
+    step, attempt = open(tmp_path / "DONE").read().split()
+    assert int(step) == 15 and int(attempt) == 1
+
+
+# -- drill 4: NaN spike vs the divergence policies ---------------------------
+
+
+def _mnist_trainer(checkpointer=None, seed=1):
+    import optax
+
+    from distributeddeeplearningspark_tpu import (
+        PartitionedDataset,
+        Session,
+        Trainer,
+    )
+    from distributeddeeplearningspark_tpu.models import LeNet5
+    from distributeddeeplearningspark_tpu.train import losses
+
+    rng = np.random.default_rng(0)
+    examples = [
+        {"image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+         "label": np.int32(i % 10)}
+        for i in range(128)
+    ]
+    sess = Session.builder.master("local[2]").getOrCreate()
+    ds = PartitionedDataset.parallelize(examples, 2).repeat()
+    t = Trainer(sess, LeNet5(), losses.softmax_xent,
+                optax.sgd(0.05, momentum=0.9), checkpointer=checkpointer,
+                seed=seed)
+    return t, ds
+
+
+@pytest.mark.slow
+def test_nan_spike_skip_policy_finishes_finite(monkeypatch):
+    """Acceptance: fit(on_nonfinite='skip') + DLS_FAULT=nan@N finishes with
+    finite final metrics and reports the skipped-step count in its summary;
+    params never absorb the poisoned update."""
+    import jax
+
+    monkeypatch.setenv("DLS_FAULT", "nan@5")
+    monkeypatch.delenv("DLS_RESTART", raising=False)
+    t, ds = _mnist_trainer()
+    state, summary = t.fit(ds, batch_size=16, steps=10, log_every=2,
+                           on_nonfinite="skip")
+    assert summary["skipped_steps"] == 1.0
+    assert np.isfinite(summary["loss"]) and np.isfinite(summary["grad_norm"])
+    assert int(jax.device_get(state.step)) == 10
+    for leaf in jax.tree.leaves(state.params):
+        assert np.all(np.isfinite(np.asarray(jax.device_get(leaf))))
+
+
+@pytest.mark.slow
+def test_nan_every_step_exhausts_skip_budget(monkeypatch):
+    """Persistent divergence must not masquerade as progress: a loss that is
+    non-finite from init (lr=inf blows up step 1 and never recovers) has to
+    fail once the skip budget is exhausted."""
+    import optax
+
+    from distributeddeeplearningspark_tpu import PartitionedDataset, Session, Trainer
+    from distributeddeeplearningspark_tpu.models import LeNet5
+    from distributeddeeplearningspark_tpu.train import losses
+
+    monkeypatch.delenv("DLS_FAULT", raising=False)
+    rng = np.random.default_rng(0)
+    examples = [
+        {"image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+         "label": np.int32(i % 10)}
+        for i in range(64)
+    ]
+    sess = Session.builder.master("local[2]").getOrCreate()
+    ds = PartitionedDataset.parallelize(examples, 2).repeat()
+    t = Trainer(sess, LeNet5(), losses.softmax_xent,
+                optax.sgd(float("inf")), seed=1)
+    with pytest.raises(FloatingPointError, match="nonfinite_budget"):
+        t.fit(ds, batch_size=16, steps=50, log_every=2,
+              on_nonfinite="skip", nonfinite_budget=3)
+
+
+@pytest.mark.slow
+def test_nan_spike_rollback_policy(tmp_path, monkeypatch):
+    """fit(on_nonfinite='rollback'): the model rewinds to the last verified
+    checkpoint while the data stream keeps moving, so the poisoned window is
+    fast-forwarded past and training completes with finite metrics."""
+    import jax
+
+    from distributeddeeplearningspark_tpu import Checkpointer
+
+    monkeypatch.setenv("DLS_FAULT", "nan@6")
+    monkeypatch.delenv("DLS_RESTART", raising=False)
+    with Checkpointer(tmp_path / "ck") as ck:
+        t, ds = _mnist_trainer(checkpointer=ck)
+        state, summary = t.fit(ds, batch_size=16, steps=12, log_every=2,
+                               checkpoint_every=4, on_nonfinite="rollback")
+        assert summary["rollbacks"] == 1.0
+        assert np.isfinite(summary["loss"])
+        assert int(jax.device_get(state.step)) == 12
+        for leaf in jax.tree.leaves(state.params):
+            assert np.all(np.isfinite(np.asarray(jax.device_get(leaf))))
+        # the final checkpoint's data_state must record the TRUE stream
+        # position: 12 steps of state + the 2-batch rolled-back window the
+        # feed consumed (model rewound 6→4, stream did not)
+        _, data_state = ck.restore(state)
+        assert data_state["examples_seen"] == (12 + 2) * 16, data_state
+
+
+@pytest.mark.slow
+def test_rollback_walks_past_nan_checkpoints(tmp_path, monkeypatch):
+    """Checkpoint cadence finer than the detection window: the newest
+    byte-verified checkpoints hold NaN params (divergence was saved before a
+    log boundary saw it). Rollback must detect the poisoned restore, \
+quarantine those steps, and walk back to the last numerically clean one."""
+    import jax
+
+    from distributeddeeplearningspark_tpu import Checkpointer
+
+    monkeypatch.setenv("DLS_FAULT", "nan@2")
+    monkeypatch.delenv("DLS_RESTART", raising=False)
+    with Checkpointer(tmp_path / "ck", max_to_keep=20) as ck:
+        t, ds = _mnist_trainer(checkpointer=ck)
+        state, summary = t.fit(ds, batch_size=16, steps=10, log_every=5,
+                               checkpoint_every=1, on_nonfinite="rollback")
+        assert summary["rollbacks"] == 1.0
+        assert np.isfinite(summary["loss"])
+        assert int(jax.device_get(state.step)) == 10
+        for leaf in jax.tree.leaves(state.params):
+            assert np.all(np.isfinite(np.asarray(jax.device_get(leaf))))
+    # the NaN-holding steps (2..4 — step 5's save is pre-empted by the
+    # rollback itself) were quarantined; clean step 1 survived and was the
+    # restore target
+    quarantined = {d.split(".")[0] for d in _corrupt_dirs(tmp_path / "ck")}
+    assert quarantined >= {"2", "3", "4"}, sorted(os.listdir(tmp_path / "ck"))
+    assert os.path.isdir(tmp_path / "ck" / "1")
+
+
+def test_rollback_without_checkpointer_raises(monkeypatch):
+    monkeypatch.delenv("DLS_FAULT", raising=False)
+    import optax
+
+    from distributeddeeplearningspark_tpu import PartitionedDataset, Session, Trainer
+    from distributeddeeplearningspark_tpu.models import LeNet5
+    from distributeddeeplearningspark_tpu.train import losses
+
+    rng = np.random.default_rng(0)
+    examples = [
+        {"image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+         "label": np.int32(i % 10)}
+        for i in range(64)
+    ]
+    sess = Session.builder.master("local[2]").getOrCreate()
+    ds = PartitionedDataset.parallelize(examples, 2).repeat()
+    t = Trainer(sess, LeNet5(), losses.softmax_xent, optax.sgd(float("inf")),
+                seed=1)
+    with pytest.raises(FloatingPointError, match="checkpointer"):
+        t.fit(ds, batch_size=16, steps=10, log_every=2,
+              on_nonfinite="rollback")
+
+
+def test_on_nonfinite_validation():
+    t, ds = _mnist_trainer()
+    with pytest.raises(ValueError, match="on_nonfinite"):
+        t.fit(ds, batch_size=16, steps=2, on_nonfinite="retry")

@@ -169,6 +169,133 @@ def test_resume_exhausted_feed_raises(tmp_path):
               data_state={"examples_seen": 64, "batch_size": 16})
 
 
+def test_manifest_written_and_verified(tmp_path, eight_devices):
+    """Every committed step gets an integrity manifest at the next finalize
+    point; verify() passes on intact bytes and latest_verified_step tracks."""
+    from distributeddeeplearningspark_tpu.checkpoint import MANIFEST_NAME
+
+    mesh = MeshSpec(data=8).build()
+    state, _ = step_lib.init_state(
+        LeNet5(), optax.sgd(0.1), _sample_batch(), mesh, REPLICATED
+    )
+    with Checkpointer(tmp_path / "ckpt", async_save=True) as ckpt:
+        ckpt.save(1, state)
+        ckpt.save(2, state)  # finalizes step 1 → manifest 1 flush queued
+        ckpt._join_manifest_thread()  # (flush runs on the helper thread)
+        assert (tmp_path / "ckpt" / "1" / MANIFEST_NAME).exists()
+        assert not (tmp_path / "ckpt" / "2" / MANIFEST_NAME).exists()
+        ckpt.wait()  # finalizes step 2 → manifest 2 committed
+        assert (tmp_path / "ckpt" / "2" / MANIFEST_NAME).exists()
+        assert ckpt.verify(1) and ckpt.verify(2)
+        assert ckpt.latest_verified_step() == 2
+
+
+def test_restore_walks_back_past_corrupt_step(tmp_path, eight_devices):
+    """A torn latest step (bytes disagree with its manifest) is quarantined
+    to <step>.corrupt-N and restore lands on the newest verified step; the
+    quarantined dir no longer counts as a checkpoint."""
+    import os
+
+    from distributeddeeplearningspark_tpu import faults
+
+    mesh = MeshSpec(data=8).build()
+    state, shardings = step_lib.init_state(
+        LeNet5(), optax.sgd(0.1), _sample_batch(), mesh, REPLICATED, seed=3
+    )
+    with Checkpointer(tmp_path / "ckpt", async_save=False) as ckpt:
+        ckpt.save(1, state, data_state={"examples_seen": 8})
+        ckpt.save(2, state, data_state={"examples_seen": 16})
+        ckpt.wait()
+        assert faults.truncate_latest_checkpoint(str(tmp_path / "ckpt"))
+        assert not ckpt.verify(2)
+        restored, data_state = ckpt.restore(state, shardings=shardings)
+        assert data_state == {"examples_seen": 8}
+        _assert_trees_equal(_host_tree(state), _host_tree(restored))
+        assert ckpt.latest_step() == 1
+    entries = os.listdir(tmp_path / "ckpt")
+    assert any(e.startswith("2.corrupt-") for e in entries), entries
+
+
+def test_restore_raises_when_all_steps_corrupt(tmp_path, eight_devices):
+    from distributeddeeplearningspark_tpu import faults
+    from distributeddeeplearningspark_tpu.checkpoint import RestoreError
+
+    mesh = MeshSpec(data=8).build()
+    state, _ = step_lib.init_state(
+        LeNet5(), optax.sgd(0.1), _sample_batch(), mesh, REPLICATED
+    )
+    with Checkpointer(tmp_path / "ckpt", async_save=False) as ckpt:
+        ckpt.save(1, state)
+        ckpt.wait()
+        faults.truncate_latest_checkpoint(str(tmp_path / "ckpt"))
+        with pytest.raises(RestoreError, match="no intact checkpoint"):
+            ckpt.restore(state)
+
+
+def test_manifestless_step_restores_structurally(tmp_path, eight_devices):
+    """A step whose writer died between orbax finalize and the manifest
+    flush (commit marker present, no manifest) is still restorable — atomic
+    rename means it is whole; only manifest-contradicting bytes walk back."""
+    import os
+
+    from distributeddeeplearningspark_tpu.checkpoint import MANIFEST_NAME
+
+    mesh = MeshSpec(data=8).build()
+    state, shardings = step_lib.init_state(
+        LeNet5(), optax.sgd(0.1), _sample_batch(), mesh, REPLICATED
+    )
+    with Checkpointer(tmp_path / "ckpt", async_save=False) as ckpt:
+        ckpt.save(3, state)
+        ckpt.wait()
+        os.remove(tmp_path / "ckpt" / "3" / MANIFEST_NAME)
+        assert ckpt.verify(3)  # structural fallback
+        restored, _ = ckpt.restore(state, shardings=shardings)
+    _assert_trees_equal(_host_tree(state), _host_tree(restored))
+
+
+def test_restore_metadata_fallback_path(tmp_path, eight_devices, monkeypatch):
+    """The non-default step-name branch: when the step dir isn't at
+    <root>/<step>, item presence comes from orbax item_metadata — and when
+    even that raises, restore still proceeds assuming the default items."""
+    mesh = MeshSpec(data=8).build()
+    state, shardings = step_lib.init_state(
+        LeNet5(), optax.sgd(0.1), _sample_batch(), mesh, REPLICATED
+    )
+    with Checkpointer(tmp_path / "ckpt", async_save=False,
+                      verify_on_restore=False) as ckpt:
+        ckpt.save(1, state, data_state={"examples_seen": 8})
+        ckpt.wait()
+        # simulate a step-name format whose dir we can't list directly:
+        # the path probe misses, forcing the orbax item_metadata branch
+        monkeypatch.setattr(
+            ckpt, "_step_dir",
+            lambda step: str(tmp_path / "ckpt" / f"nope-{step}"))
+        restored, data_state = ckpt.restore(state, shardings=shardings)
+        assert data_state == {"examples_seen": 8}
+        _assert_trees_equal(_host_tree(state), _host_tree(restored))
+
+        # the `except Exception` arm: item_metadata itself blows up → the
+        # default {state, data} item set is assumed and restore still works
+        monkeypatch.setattr(
+            ckpt._mgr, "item_metadata",
+            lambda step: (_ for _ in ()).throw(RuntimeError("boom")))
+        restored2, data_state2 = ckpt.restore(state, shardings=shardings)
+        assert data_state2 == {"examples_seen": 8}
+        _assert_trees_equal(_host_tree(state), _host_tree(restored2))
+
+
+def test_trainer_restore_before_init_raises(tmp_path):
+    """Satellite: the restore guards are real exceptions (visible under
+    python -O), with a call-init()-first message."""
+    sess = Session.builder.master("local[2]").getOrCreate()
+    t = Trainer(sess, LeNet5(), losses.softmax_xent, optax.sgd(0.1))
+    with pytest.raises(RuntimeError, match="no checkpointer"):
+        t.restore()
+    with Checkpointer(tmp_path / "ck") as ck:
+        with pytest.raises(RuntimeError, match=r"call init\(\)"):
+            t.restore(ck)
+
+
 def test_roundtrip_preserves_sparse_embed_state(tmp_path, eight_devices):
     """embed_state (row accumulators of the sparse embedding optimizer) must
     survive save→restore with its expert-axis sharding, and a restored state

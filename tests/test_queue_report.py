@@ -1,0 +1,67 @@
+"""tools/queue_report.py — the record→prose step must apply the SAME success
+rule as the queue runner (bench.is_good_record), so a failed measurement can
+never be pasted into BASELINE.md as a citable number (ADVICE r5)."""
+
+import json
+import subprocess
+import sys
+import os
+
+TOOL = os.path.join(os.path.dirname(__file__), "..", "tools", "queue_report.py")
+
+
+def _run(path):
+    out = subprocess.run([sys.executable, TOOL, str(path)],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+def test_drifted_success_records_report_as_failed(tmp_path):
+    records = [
+        # a genuinely good record
+        {"item": "resnet50", "rc": 0, "ts": "t", "elapsed_s": 1,
+         "record": {"metric": "resnet50_images_per_sec_per_chip",
+                    "value": 100.0, "unit": "images/sec/chip"}},
+        # rc=0 but the runner caught an exception: NOT citable
+        {"item": "bert_mlm", "rc": 0, "ts": "t", "elapsed_s": 1,
+         "record": {"metric": "bench_failed", "value": 1, "unit": "",
+                    "error": "XlaRuntimeError: ..."}},
+        # rc=0 but the backend was gone: NOT citable
+        {"item": "llama_lora", "rc": 0, "ts": "t", "elapsed_s": 1,
+         "record": {"metric": "backend_unavailable", "value": 1, "unit": ""}},
+        # rc=0 but zero kernels compiled: NOT citable
+        {"item": "kernels", "rc": 0, "ts": "t", "elapsed_s": 1,
+         "record": {"metric": "pallas_kernels_compiled", "value": 0,
+                    "unit": "kernels"}},
+        # nonzero rc stays failed
+        {"item": "dlrm", "rc": 2, "ts": "t", "elapsed_s": 1,
+         "record": {"metric": "dlrm_examples_per_sec_per_chip", "value": 5,
+                    "unit": "ex/s"}},
+    ]
+    p = tmp_path / "q.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in records))
+    out = _run(p)
+    assert "1 good records, 4 failed" in out, out
+    assert "**resnet50**: resnet50_images_per_sec_per_chip = **100.0**" in out
+    for item in ("bert_mlm", "llama_lora", "kernels", "dlrm"):
+        line = next(ln for ln in out.splitlines() if f"**{item}**" in ln)
+        assert "FAILED" in line, line
+    # the reason names the actual cause, not a phantom zero value
+    assert "FAILED (rc=2)" in out
+    assert "pallas_kernels_compiled=0" in out
+    assert "XlaRuntimeError" in out
+
+
+def test_usage_line_advertises_no_unparsed_flags():
+    """The docstring usage must only name flags argparse accepts (the old
+    [--md] exited 2 when someone followed the docs)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("qr", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "--md" not in (mod.__doc__ or "")
+    out = subprocess.run([sys.executable, TOOL, "/nonexistent", "--md"],
+                         capture_output=True, text=True)
+    assert out.returncode == 2  # argparse rejects it, and we don't advertise it

@@ -108,6 +108,43 @@ def test_supervisor_gives_up_after_max_restarts():
     assert not result.ok
     assert len(result.attempts) == 3
     assert all(a.returncodes == [7] for a in result.attempts)
+    # no progress tracking configured and no checkpoint dir → a plain crash,
+    # never misclassified as a restore failure
+    assert all(a.classification == "training-crash" for a in result.attempts)
+
+
+def test_restart_backoff_grows_exponentially_with_cap():
+    """Satellite: the relaunch delay doubles per attempt from the base and
+    saturates at restart_backoff_max_s (jitter disabled for determinism)."""
+    s = Supervisor(["true"], restart_backoff_s=0.5, restart_backoff_max_s=3.0,
+                   backoff_jitter=0.0)
+    assert [s._backoff_delay(i) for i in range(5)] == [0.5, 1.0, 2.0, 3.0, 3.0]
+    j = Supervisor(["true"], restart_backoff_s=1.0, restart_backoff_max_s=8.0,
+                   backoff_jitter=0.25)
+    for i in range(4):
+        base = min(1.0 * 2 ** i, 8.0)
+        d = j._backoff_delay(i)
+        assert 0.75 * base <= d <= 1.25 * base, (i, d)
+
+
+def test_restart_backoff_timing_observed(monkeypatch):
+    """The run loop actually waits the exponential delays between attempts
+    (sleep calls recorded; poll-interval sleeps are distinguishable)."""
+    from distributeddeeplearningspark_tpu import supervisor as sup_mod
+
+    sleeps: list[float] = []
+    real_sleep = sup_mod.time.sleep
+    monkeypatch.setattr(
+        sup_mod.time, "sleep",
+        lambda s: (sleeps.append(s), real_sleep(min(s, 0.01)))[1])
+    result = Supervisor(
+        [sys.executable, "-c", "import sys; sys.exit(7)"],
+        max_restarts=2, restart_backoff_s=0.15, backoff_jitter=0.0,
+        poll_interval=0.01,
+    ).run()
+    assert len(result.attempts) == 3
+    backoffs = [s for s in sleeps if s > 0.01]
+    assert backoffs == [0.15, 0.3], backoffs
 
 
 def test_result_shapes():

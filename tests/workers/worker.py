@@ -62,7 +62,19 @@ def mode_train(args) -> int:
     data_state = None
     if ckpt.latest_step() is not None:
         trainer.init(trainer._sample_batch(ds, args.batch_size))
-        _, data_state = trainer.restore()
+        try:
+            _, data_state = trainer.restore()
+        except Exception:
+            # the supervisor contract: dying AT restore is a different
+            # failure class than dying mid-training — relaunching against
+            # the same checkpoint would crash identically, so say so
+            from distributeddeeplearningspark_tpu.supervisor import (
+                RESTORE_FAILED_EXIT)
+
+            import traceback
+
+            traceback.print_exc()
+            return RESTORE_FAILED_EXIT
 
     attempt = int(os.environ.get("DLS_RESTART", "0"))
     fault_cbs = []
@@ -76,6 +88,7 @@ def mode_train(args) -> int:
         ds, batch_size=args.batch_size, steps=args.steps, log_every=5,
         checkpoint_every=args.checkpoint_every, data_state=data_state,
         sanitize_every=5, callbacks=fault_cbs,
+        on_nonfinite=args.on_nonfinite,
     )
     ckpt.wait()
     final_step = int(jax.device_get(state.step))
@@ -181,6 +194,8 @@ def main() -> int:
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--checkpoint-every", type=int, default=10)
     p.add_argument("--fault-step", type=int, default=0)
+    p.add_argument("--on-nonfinite", default="raise",
+                   choices=["raise", "skip", "rollback"])
     p.add_argument("--out", default="/tmp/fingerprint.npz")
     args = p.parse_args()
     if args.mode == "fingerprint":

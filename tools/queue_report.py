@@ -7,22 +7,39 @@ reads the append-only queue file and prints, per item, the headline
 number, timing spread, and the A/B fields that BASELINE.md rows cite —
 ready to paste, with the artifact name attached to every value.
 
-Usage: python tools/queue_report.py CHIP_QUEUE_r05.jsonl [--md]
+Usage: python tools/queue_report.py CHIP_QUEUE_r05.jsonl
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
+
+# run as a script from anywhere: the repo root (where bench.py lives) must be
+# importable for the shared good-record rule
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import is_good_record  # noqa: E402
 
 
 def _per_item(rec: dict) -> str | None:
     item, r = rec.get("item"), rec.get("record")
     if item in (None, "probe", "probe_recheck") or not isinstance(r, dict):
         return None
-    if rec.get("rc") != 0 or "metric" not in r:
-        err = (r.get("error") or r.get("raw_tail")
-               or f"rc={rec.get('rc')}")
+    # the SAME success rule the queue runner and tpu_watch use — a record
+    # with rc=0 but bench_failed/backend_unavailable/0-kernels-compiled is
+    # a FAILURE, not a citable number (ADVICE r5: this rule had drifted)
+    if not is_good_record(rec.get("rc"), r):
+        if rec.get("rc") != 0:  # nonzero exit outranks any record content
+            err = r.get("error") or r.get("raw_tail") or f"rc={rec.get('rc')}"
+        else:
+            err = (r.get("error") or r.get("raw_tail")
+                   or (r.get("metric") if r.get("metric") in
+                       ("bench_failed", "backend_unavailable") else None)
+                   or (f"{r.get('metric')}=0" if "metric" in r
+                       else f"rc={rec.get('rc')}"))
         return f"- **{item}**: FAILED ({str(err)[:160]})"
     extra = r.get("extra", {})
     lines = [f"- **{item}**: {r['metric']} = **{r['value']}** {r['unit']}"
