@@ -21,14 +21,45 @@ Public API (stable surface):
     Session, PartitionedDataset, MeshSpec, Trainer, TrainState
 """
 
-from distributeddeeplearningspark_tpu.session import Session
-from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
-from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
-from distributeddeeplearningspark_tpu.train.state import TrainState
-from distributeddeeplearningspark_tpu.train.trainer import Trainer
-from distributeddeeplearningspark_tpu.checkpoint import Checkpointer
+import importlib
+from typing import TYPE_CHECKING
 
 __version__ = "0.1.0"
+
+#: public name -> defining submodule. Resolved lazily (PEP 562) so that
+#: importing a light submodule (``telemetry``, ``status`` — what the
+#: ``dlstatus`` CLI does, possibly on a box without jax while inspecting a
+#: copied-out run directory) does not drag in the whole jax/flax/orbax
+#: training stack through this package __init__.
+_EXPORTS = {
+    "Session": "distributeddeeplearningspark_tpu.session",
+    "PartitionedDataset": "distributeddeeplearningspark_tpu.rdd",
+    "MeshSpec": "distributeddeeplearningspark_tpu.parallel.mesh",
+    "TrainState": "distributeddeeplearningspark_tpu.train.state",
+    "Trainer": "distributeddeeplearningspark_tpu.train.trainer",
+    "Checkpointer": "distributeddeeplearningspark_tpu.checkpoint",
+}
+
+if TYPE_CHECKING:  # static analyzers see the real names
+    from distributeddeeplearningspark_tpu.checkpoint import Checkpointer
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+    from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+    from distributeddeeplearningspark_tpu.session import Session
+    from distributeddeeplearningspark_tpu.train.state import TrainState
+    from distributeddeeplearningspark_tpu.train.trainer import Trainer
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        value = getattr(importlib.import_module(_EXPORTS[name]), name)
+        globals()[name] = value  # cache: next access skips the import
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
 
 __all__ = [
     "Session",

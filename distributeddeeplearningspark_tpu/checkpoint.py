@@ -43,6 +43,8 @@ from typing import Any
 
 import jax
 
+from distributeddeeplearningspark_tpu import telemetry
+
 logger = logging.getLogger("distributeddeeplearningspark_tpu.checkpoint")
 
 _STATE = "state"
@@ -268,12 +270,18 @@ class Checkpointer:
         items = {_STATE: ocp.args.StandardSave(state)}
         if data_state is not None:
             items[_DATA] = ocp.args.JsonSave(data_state)
-        saved = self._mgr.save(int(step), args=ocp.args.Composite(**items), force=force)
-        # orbax waited out any previous in-flight save before starting this
-        # one, so every earlier pending step is committed — manifest time
-        # (on the helper thread: CRCing the previous step's shards overlaps
-        # the next training steps, like the save itself does)
-        self._join_manifest_thread()
+        # the phase spans only save()'s BLOCKING portion (waiting out the
+        # previous async save + queueing this one) — that is the time stolen
+        # from training; the background write itself overlaps steps and is
+        # deliberately not accounted as overhead (telemetry.PHASE_CATEGORY)
+        with telemetry.phase("checkpoint", step=int(step)):
+            saved = self._mgr.save(int(step), args=ocp.args.Composite(**items),
+                                   force=force)
+            # orbax waited out any previous in-flight save before starting
+            # this one, so every earlier pending step is committed — manifest
+            # time (on the helper thread: CRCing the previous step's shards
+            # overlaps the next training steps, like the save itself does)
+            self._join_manifest_thread()
         if saved:
             with self._manifest_lock:
                 self._pending_manifest.add(int(step))
@@ -319,7 +327,8 @@ class Checkpointer:
     def verify(self, step: int) -> bool:
         """True iff ``step``'s on-disk bytes match its integrity manifest
         (or, for a manifest-less step, orbax's structural commit marker)."""
-        ok, reason = verify_step_dir(self._step_dir(step))
+        with telemetry.phase("checkpoint-verify", step=int(step)):
+            ok, reason = verify_step_dir(self._step_dir(step))
         if not ok:
             logger.warning("checkpoint step %d failed integrity: %s", step, reason)
         return ok
@@ -337,6 +346,10 @@ class Checkpointer:
         a byte-intact checkpoint turns out to hold non-finite state."""
         if jax.process_index() == 0:
             quarantine_step_dir(self.directory, step)
+            # inside the process-0 guard: one quarantine action must leave
+            # ONE recovery record, not one per gang member
+            telemetry.emit("recovery", step=int(step), event="quarantine",
+                           directory=self.directory)
         # the manager caches its step list; re-read the filesystem so the
         # quarantined step vanishes from latest/all_steps and GC accounting
         try:
@@ -372,7 +385,8 @@ class Checkpointer:
                 # path to verify (or quarantine) — trust the manager's
                 # listing, exactly as the metadata fallback in restore() does
                 return step
-            ok, reason = verify_step_dir(step_dir)
+            with telemetry.phase("checkpoint-verify", step=int(step)):
+                ok, reason = verify_step_dir(step_dir)
             if ok:
                 return step
             logger.error(
@@ -428,7 +442,12 @@ class Checkpointer:
                 present = {_STATE, _DATA}
         if _DATA in present:
             items[_DATA] = ocp.args.JsonRestore()
-        restored = self._mgr.restore(int(step), args=ocp.args.Composite(**items))
+        # phase spans the orbax read only — wait()'s checkpoint-wait and the
+        # verify walk's checkpoint-verify spans precede it, so the goodput
+        # categories stay disjoint and sum cleanly
+        with telemetry.phase("restore", step=int(step)):
+            restored = self._mgr.restore(int(step),
+                                         args=ocp.args.Composite(**items))
         data_state = restored[_DATA] if _DATA in items else None
         logger.info("restored checkpoint step %d from %s", step, self.directory)
         return restored[_STATE], data_state
@@ -437,9 +456,10 @@ class Checkpointer:
 
     def wait(self) -> None:
         """Block until queued async saves are durable (and manifested)."""
-        self._mgr.wait_until_finished()
-        self._join_manifest_thread()
-        self._flush_manifests()
+        with telemetry.phase("checkpoint-wait"):
+            self._mgr.wait_until_finished()
+            self._join_manifest_thread()
+            self._flush_manifests()
 
     def close(self) -> None:
         try:

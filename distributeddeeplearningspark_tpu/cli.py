@@ -37,6 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--num-executors", type=int, default=None,
         help="alias for --conf spark.executor.instances=N",
     )
+    p.add_argument(
+        "--workdir", default=None,
+        help="run directory: telemetry events append to <workdir>/telemetry "
+             "and `dlstatus <workdir>` reads the run report",
+    )
     p.add_argument("script", help="driver script to run")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
@@ -75,6 +80,12 @@ def main(argv: list[str] | None = None) -> int:
     # Session.builder.getOrCreate() sees the launch configuration.
     for k, v in conf.items():
         os.environ[CONF_ENV_PREFIX + k.replace(".", "__")] = v
+    if args.workdir:
+        # same contract the supervisor uses: the Trainer binds its telemetry
+        # stream to this dir
+        from distributeddeeplearningspark_tpu import telemetry
+
+        os.environ[telemetry.WORKDIR_ENV] = os.path.abspath(args.workdir)
 
     if not os.path.exists(args.script):
         raise SystemExit(f"dlsubmit: script not found: {args.script}")

@@ -245,11 +245,20 @@ def device_batches(
     batch_size: int,
     *,
     drop_remainder: bool = True,
+    probe=None,
 ) -> Iterator[dict[str, jax.Array]]:
-    """host_batches → sharded device arrays (no prefetch; see prefetch.py)."""
+    """host_batches → sharded device arrays (no prefetch; see prefetch.py).
+
+    ``probe`` (a :class:`~.prefetch.StarvationProbe`) times each host-batch
+    assembly — on this unbuffered path every assembly blocks the consumer,
+    so the same wait the prefetch ring would hide is measured directly.
+    """
     nshards = num_data_shards(mesh)
-    for hb in host_batches(
+    hb: Iterator[dict[str, np.ndarray]] = host_batches(
         dataset, batch_size, num_shards=nshards, drop_remainder=drop_remainder,
         shard_range=process_shard_range(nshards),
-    ):
-        yield put_global(hb, mesh)
+    )
+    if probe is not None:
+        hb = probe.timed(hb)
+    for b in hb:
+        yield put_global(b, mesh)

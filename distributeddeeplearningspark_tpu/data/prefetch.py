@@ -7,6 +7,14 @@ is asynchronous, so the recipe is a small look-ahead ring: transfer the next
 ``device_put`` for batch N+1 overlaps the device executing step N; a separate
 host thread does the (possibly expensive) host-side assembly (decode /
 augment / stack) so Python never blocks the dispatch path.
+
+**Starvation probe.** An input-bound step and a compute-bound step look
+identical in wall-clock; the difference is whether the *consumer* had to
+block waiting for the next host batch. :class:`StarvationProbe` measures
+exactly that (plus prefetch queue depth and host assembly time), the Trainer
+snapshots it per metrics lap into the telemetry stream, and the goodput
+accountant reports the total as ``input_starved_s`` — see
+docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
@@ -14,6 +22,7 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterator
 
 import jax
@@ -24,6 +33,89 @@ from distributeddeeplearningspark_tpu.data.feed import put_global
 _SENTINEL = object()
 
 
+class StarvationProbe:
+    """Thread-safe counters for "how long did training wait on input?".
+
+    Three signals, all cheap:
+
+    - ``record_wait`` — consumer-side block: the training loop asked for the
+      next batch and the prefetch ring had nothing ready. This is the
+      starvation signal proper (sums into ``input_starved_s``).
+    - ``record_depth`` — prefetch queue depth sampled at each consumer get;
+      a ring that is persistently empty (min 0, mean ≈ 0) is input-bound,
+      one that hovers full is compute-bound.
+    - ``record_assembly`` — producer-side cost of building one host batch
+      (decode/augment/stack), measured in the background thread; tells you
+      WHY the ring ran dry.
+
+    ``clock`` is injectable so tests measure deterministic fake seconds.
+    ``snapshot(reset=True)`` returns-and-clears, giving per-lap gauges.
+    """
+
+    def __init__(self, clock=time.perf_counter):
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._zero()
+
+    def _zero(self) -> None:
+        self._wait_s = 0.0
+        self._waits = 0
+        self._wait_max = 0.0
+        self._assembly_s = 0.0
+        self._assemblies = 0
+        self._depth_sum = 0
+        self._depth_n = 0
+        self._depth_min: int | None = None
+
+    def record_wait(self, dt: float) -> None:
+        with self._lock:
+            self._wait_s += dt
+            self._waits += 1
+            self._wait_max = max(self._wait_max, dt)
+
+    def record_assembly(self, dt: float) -> None:
+        with self._lock:
+            self._assembly_s += dt
+            self._assemblies += 1
+
+    def record_depth(self, depth: int) -> None:
+        with self._lock:
+            self._depth_sum += depth
+            self._depth_n += 1
+            self._depth_min = (depth if self._depth_min is None
+                               else min(self._depth_min, depth))
+
+    def timed(self, it, record=None) -> Iterator:
+        """Wrap an iterable so each blocking ``next()`` is timed into
+        ``record`` (default: :meth:`record_wait`)."""
+        record = record or self.record_wait
+        it = iter(it)  # accept plain iterables, same as a for-loop would
+        while True:
+            t0 = self.clock()
+            try:
+                x = next(it)
+            except StopIteration:
+                return
+            record(self.clock() - t0)
+            yield x
+
+    def snapshot(self, *, reset: bool = True) -> dict[str, float]:
+        """Gauges since the last snapshot, keyed for the telemetry record."""
+        with self._lock:
+            out = {
+                "input_wait_s": self._wait_s,
+                "input_waits": self._waits,
+                "input_wait_max_s": self._wait_max,
+                "input_assembly_s": self._assembly_s,
+            }
+            if self._depth_n:
+                out["prefetch_depth_mean"] = self._depth_sum / self._depth_n
+                out["prefetch_depth_min"] = self._depth_min
+            if reset:
+                self._zero()
+            return out
+
+
 def prefetch_to_device(
     host_iter: Iterator[dict[str, Any]],
     mesh: Mesh,
@@ -31,14 +123,23 @@ def prefetch_to_device(
     buffer_size: int = 2,
     put: Callable[[dict[str, Any], Mesh], Any] = put_global,
     background: bool = True,
+    probe: StarvationProbe | None = None,
 ) -> Iterator[Any]:
     """Wrap a host-batch iterator into a double-buffered device iterator.
 
     ``buffer_size=2`` (double buffering) is enough to hide transfer latency
-    when host assembly keeps up; raise it for bursty sources.
+    when host assembly keeps up; raise it for bursty sources. ``probe``
+    times the consumer-blocked fetch of each host batch and samples the
+    ring's queue depth (see :class:`StarvationProbe`).
     """
     if background:
-        host_iter = _background(host_iter, maxsize=buffer_size + 1)
+        host_iter = _background(host_iter, maxsize=buffer_size + 1,
+                                probe=probe)
+    if probe is not None:
+        # times the blocking pull of the NEXT host batch: with background=
+        # True that's the q.get() wait (assembly ran behind), without it the
+        # synchronous assembly itself — either way, time training stood still
+        host_iter = probe.timed(host_iter)
 
     buf: collections.deque = collections.deque()
     for hb in host_iter:
@@ -49,15 +150,20 @@ def prefetch_to_device(
         yield buf.popleft()
 
 
-def _background(it: Iterator, *, maxsize: int) -> Iterator:
+def _background(it: Iterator, *, maxsize: int,
+                probe: StarvationProbe | None = None) -> Iterator:
     """Run an iterator in a daemon thread through a bounded queue."""
     q: queue.Queue = queue.Queue(maxsize=maxsize)
     err: list[BaseException] = []
 
     def worker() -> None:
         try:
-            for x in it:
-                q.put(x)
+            if probe is not None:
+                for x in probe.timed(it, probe.record_assembly):
+                    q.put(x)
+            else:
+                for x in it:
+                    q.put(x)
         except BaseException as e:  # propagate into consumer
             err.append(e)
         finally:
@@ -66,6 +172,8 @@ def _background(it: Iterator, *, maxsize: int) -> Iterator:
     t = threading.Thread(target=worker, daemon=True, name="dls-prefetch")
     t.start()
     while True:
+        if probe is not None:
+            probe.record_depth(q.qsize())
         x = q.get()
         if x is _SENTINEL:
             if err:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import time
 from typing import Any
 
@@ -274,6 +275,9 @@ class Meter:
         self._laps: list[tuple[float, int]] = []
         self._last: float | None = None
         self._metrics_history: list[dict[str, float]] = []
+        #: the most recent (elapsed_s, num_steps) lap — telemetry reads it to
+        #: stamp the step_metrics record without reaching into _laps
+        self.last_lap: tuple[float, int] | None = None
 
     def set_flops(self, flops: float | None) -> None:
         self.flops_per_step = flops
@@ -291,12 +295,36 @@ class Meter:
         """
         now = time.perf_counter()
         if self._last is not None and num_steps > 0:
-            self._laps.append((now - self._last, num_steps))
+            self.last_lap = (now - self._last, num_steps)
+            self._laps.append(self.last_lap)
         self._last = now
         record: dict[str, float] = {}
         if device_metrics is not None:
-            record = {k: float(v) for k, v in device_metrics.items()}
-            self._metrics_history.append(record)
+            # 0-d device arrays / numpy scalars coerce through float(); a
+            # leaf that doesn't (a string, a vector) is dropped rather than
+            # crashing the lap — EXCEPT a numeric non-scalar carrying a
+            # non-finite entry, which must surface as NaN: the returned
+            # record feeds fit()'s divergence detection, and a NaN hidden
+            # in a vector metric must stay loud, not vanish silently
+            import numpy as np
+
+            for k, v in device_metrics.items():
+                try:
+                    record[k] = float(v)
+                except (TypeError, ValueError):
+                    try:
+                        arr = np.asarray(v, dtype=np.float64)
+                    except (TypeError, ValueError):
+                        continue  # non-numeric: reporting only, skip
+                    if arr.size and not np.all(np.isfinite(arr)):
+                        record[k] = float("nan")
+            # the RETURNED record keeps non-finite values (divergence
+            # detection in Trainer.fit reads them), but the history feeding
+            # summary()'s final-metrics merge takes only the finite subset —
+            # one NaN lap must not poison the run summary
+            finite = {k: v for k, v in record.items() if math.isfinite(v)}
+            if finite:
+                self._metrics_history.append(finite)
         return record
 
     @property
@@ -328,11 +356,33 @@ class Meter:
         return out
 
 
-class MetricLogger:
-    """Structured per-step logging on process 0; optional TensorBoard."""
+def _log_value(v):
+    """Display form of one metric value: counter-like values (step, tokens,
+    examples — integral floats) print as exact ints, because ``round(v, 6)``
+    keeps them floats and json renders large ones in scientific notation
+    (``1e+16``), mangling the very counters operators grep for. Everything
+    else keeps the historical 6-decimal rounding."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return v
+    if math.isfinite(f) and f.is_integer() and abs(f) < 2**63:
+        return int(f)
+    return round(f, 6)
 
-    def __init__(self, log_every: int = 10, tensorboard_dir: str | None = None):
+
+class MetricLogger:
+    """Structured per-step logging on process 0; optional TensorBoard.
+
+    ``telemetry`` (an :class:`~..telemetry.EventWriter`) mirrors recovery
+    events into the run's durable JSONL stream — stderr lines and TB scalars
+    die with the process/viewer, but ``dlstatus`` reads the stream after the
+    fact, including for crashed runs."""
+
+    def __init__(self, log_every: int = 10, tensorboard_dir: str | None = None,
+                 telemetry=None):
         self.log_every = log_every
+        self._telemetry = telemetry
         self._tb = None
         if tensorboard_dir and jax.process_index() == 0:
             try:
@@ -346,7 +396,8 @@ class MetricLogger:
         """Emit unconditionally — cadence is the caller's decision."""
         if jax.process_index() != 0:
             return
-        logger.info("step %d: %s", step, json.dumps({k: round(v, 6) for k, v in metrics.items()}))
+        logger.info("step %d: %s", step,
+                    json.dumps({k: _log_value(v) for k, v in metrics.items()}))
         if self._tb is not None:
             for k, v in metrics.items():
                 self._tb.add_scalar(k, v, step)
@@ -355,11 +406,14 @@ class MetricLogger:
         """Surface a recovery event (divergence skip, rollback, restore
         fallback) as its own WARNING log line + a ``recovery/<kind>`` TB
         scalar — these are the lines an operator greps for after an incident,
-        so they must not drown in the per-step metric stream."""
+        so they must not drown in the per-step metric stream — and mirror it
+        into the telemetry JSONL so the audit trail survives the process."""
         if jax.process_index() != 0:
             return
         logger.warning("recovery event at step %d: %s %s", step, kind,
                        json.dumps(fields, default=str))
+        if self._telemetry is not None:
+            self._telemetry.recovery(step, kind, **fields)
         if self._tb is not None:
             self._tb.add_scalar(f"recovery/{kind}", 1.0, step)
 

@@ -1,0 +1,205 @@
+"""``dlstatus`` — render a run report from a run directory's telemetry alone.
+
+The terminal counterpart of the Spark UI's job page, sibling of
+``dlprofile`` (which answers "where did the *device* time go" from a trace;
+this answers "where did the *wall-clock* go" from the JSONL event stream —
+see docs/OBSERVABILITY.md). It needs nothing but the files: a crashed or
+still-running run reports exactly as well as a finished one, which is the
+point — the first question after an incident is "what fraction of the run
+was productive, and what ate the rest".
+
+::
+
+    dlstatus <workdir>            # goodput table, attempts, recovery events
+    dlstatus <workdir> --json     # machine-readable report
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+
+from distributeddeeplearningspark_tpu import telemetry
+
+#: goodput components rendered in the breakdown table, in display order.
+_COMPONENTS = telemetry.GOODPUT_COMPONENTS
+
+
+def attempts_from(events: list[dict]) -> list[dict]:
+    """Fold ``attempt`` records into one row per gang launch.
+
+    Rows carry ``(session, ordinal)``: a second supervisor invocation on
+    the same workdir restarts ordinals at 0, and the earlier session's
+    history must stay in the timeline, not be overwritten — a repeated
+    ``begin`` for an ordinal already begun starts a new session. A crashed
+    supervisor can leave a begin with no end — the row then reports
+    ``end_ts: None`` and no classification, which is itself diagnostic
+    (the supervisor died mid-attempt). A row with a backoff but NO begin
+    means the supervisor was killed during the backoff sleep — that
+    attempt never launched (render says so, so nobody hunts for a gang
+    that never existed)."""
+    rows: list[dict] = []
+    current: dict[int, dict] = {}
+    session = 0
+
+    def flush() -> None:
+        rows.extend(current[k] for k in sorted(current))
+        current.clear()
+
+    for e in events:
+        if e.get("kind") != "attempt":
+            continue
+        ordinal = int(e.get("ordinal", -1))
+        edge = e.get("edge")
+        if (edge == "begin" and ordinal in current
+                and current[ordinal]["begin_ts"] is not None):
+            # the same ordinal launching again = a fresh supervisor session
+            flush()
+            session += 1
+        row = current.setdefault(ordinal, {
+            "session": session, "ordinal": ordinal, "begin_ts": None,
+            "end_ts": None, "duration_s": None, "returncodes": None,
+            "classification": None, "made_progress": None, "backoff_s": None,
+        })
+        if edge == "begin":
+            row["begin_ts"] = float(e["ts"])
+        elif edge == "end":
+            row["end_ts"] = float(e["ts"])
+            for k in ("duration_s", "returncodes", "classification",
+                      "made_progress"):
+                if k in e:
+                    row[k] = e[k]
+        elif edge == "backoff":
+            row["backoff_s"] = e.get("delay_s")
+    flush()
+    return rows
+
+
+def report(workdir: str, *, now: float | None = None) -> dict:
+    """The full run report as a plain dict (what ``--json`` prints)."""
+    events = telemetry.read_events(workdir)
+    heartbeats = [e for e in events if e.get("kind") == "heartbeat"]
+    # the MOST RECENT step-bearing event, not the max step: a divergence
+    # rollback legitimately rewinds the step counter, and the honest "where
+    # is the run now" after one is the rewound position
+    stepped = [e for e in events
+               if e.get("kind") in ("step_metrics", "heartbeat")
+               and e.get("step") is not None]
+    last_hb = float(heartbeats[-1]["ts"]) if heartbeats else None
+    return {
+        "workdir": workdir,
+        "event_files": telemetry.event_files(workdir),
+        "num_events": len(events),
+        "first_ts": float(events[0]["ts"]) if events else None,
+        "last_ts": float(events[-1]["ts"]) if events else None,
+        "last_step": int(stepped[-1]["step"]) if stepped else None,
+        "last_heartbeat_ts": last_hb,
+        "last_heartbeat_age_s": (
+            ((now if now is not None else time.time()) - last_hb)
+            if last_hb is not None else None),
+        "goodput": telemetry.goodput(events),
+        "attempts": attempts_from(events),
+        "recovery_events": [e for e in events if e.get("kind") == "recovery"],
+    }
+
+
+def _json_safe(obj):
+    """Replace non-finite floats with None so ``--json`` output is STRICT
+    JSON. Divergence incidents put real NaNs in the stream (a skip event's
+    ``nonfinite={'loss': nan}``); python's json would pass them through as
+    bare ``NaN`` literals, breaking every spec-compliant consumer (jq,
+    browsers) exactly in the incident case this tool exists for."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
+def _fmt_s(v: float | None) -> str:
+    return "-" if v is None else f"{v:.1f}s"
+
+
+def render(rep: dict) -> str:
+    """Human-readable report (the default output)."""
+    lines: list[str] = []
+    g = rep["goodput"]
+    lines.append(f"run report: {rep['workdir']}")
+    lines.append(
+        f"  {rep['num_events']} events from {len(rep['event_files'])} "
+        f"process file(s); wall-clock {_fmt_s(g['wall_s'])}"
+        + (f"; last step {rep['last_step']}"
+           if rep["last_step"] is not None else ""))
+    if rep["last_heartbeat_ts"] is not None:
+        lines.append(
+            f"  last heartbeat: {_fmt_s(rep['last_heartbeat_age_s'])} ago")
+    lines.append("")
+    lines.append("goodput breakdown")
+    wall = g["wall_s"] or float("inf")
+    for comp in _COMPONENTS:
+        lines.append(f"  {comp:<20} {g[comp]:10.2f}s  "
+                     f"{100.0 * g[comp] / wall:6.1f}%")
+    lines.append(f"  goodput_frac         {g['goodput_frac']:10.3f}")
+    if rep["attempts"]:
+        lines.append("")
+        lines.append("attempts")
+        multi_session = any(a["session"] for a in rep["attempts"])
+        for a in rep["attempts"]:
+            codes = a["returncodes"]
+            if a["begin_ts"] is None and a["end_ts"] is None:
+                # backoff recorded, launch never happened: the supervisor
+                # died during the backoff sleep
+                state = "never launched (supervisor died in backoff)"
+            else:
+                state = a["classification"] or "in-flight"
+            tag = (f"s{a['session']}#{a['ordinal']}" if multi_session
+                   else f"#{a['ordinal']}")
+            lines.append(
+                f"  {tag}: {state}"
+                f"  dur={_fmt_s(a['duration_s'])}"
+                f"  codes={codes if codes is not None else '-'}"
+                + (f"  backoff={_fmt_s(a['backoff_s'])}"
+                   if a["backoff_s"] is not None else ""))
+    if rep["recovery_events"]:
+        lines.append("")
+        lines.append("recovery events")
+        for e in rep["recovery_events"]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("ts", "kind", "process", "event", "step")}
+            lines.append(
+                f"  t+{float(e['ts']) - rep['first_ts']:.1f}s "
+                f"[{e.get('process')}] {e.get('event')} "
+                f"step={e.get('step', '-')}"
+                + (f" {json.dumps(extra, default=str)}" if extra else ""))
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dlstatus",
+        description="Inspect a run's telemetry: goodput, attempts, recovery.")
+    ap.add_argument("workdir", help="run directory (holds telemetry/) or the "
+                                    "telemetry directory itself")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    args = ap.parse_args(argv)
+    rep = report(args.workdir)
+    if not rep["num_events"]:
+        print(f"dlstatus: no telemetry events under {args.workdir} "
+              f"(looked in {telemetry.telemetry_dir(args.workdir)})",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(_json_safe(rep), default=str))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,8 @@ import subprocess
 import sys
 import time
 
+from distributeddeeplearningspark_tpu import telemetry as telemetry_lib
+
 logger = logging.getLogger("distributeddeeplearningspark_tpu.supervisor")
 
 #: Sentinel exit code workers use to say "I died restoring the checkpoint,
@@ -137,6 +139,7 @@ class Supervisor:
         ckpt_dir: str | None = None,
         fallback_on_restore_failure: bool = True,
         max_restore_fallbacks: int = 1,
+        telemetry_dir: str | None = None,
     ):
         self.argv = list(argv)
         self.num_processes = num_processes
@@ -173,6 +176,31 @@ class Supervisor:
             import tempfile
 
             self._hb_dir = tempfile.mkdtemp(prefix="dls_hb_")
+        # Telemetry workdir: the supervisor appends attempt lifecycle /
+        # classification / backoff records to the SAME per-run stream the
+        # workers write (it exports DLS_TELEMETRY_DIR to them), so dlstatus
+        # shows one merged timeline. Resolution honors the documented env
+        # contract first (an operator-exported DLS_TELEMETRY_DIR — also how
+        # `dlsubmit --workdir` hands it down — must not be silently
+        # overridden), then falls back to the checkpoint root — the
+        # directory an operator already has in hand after an incident.
+        self.telemetry_dir = (
+            telemetry_dir if telemetry_dir is not None
+            else (self.env.get(telemetry_lib.WORKDIR_ENV)
+                  or os.environ.get(telemetry_lib.WORKDIR_ENV)
+                  or self.ckpt_dir))  # ckpt_dir already fell back to progress_path
+        self._tele: telemetry_lib.EventWriter | None = None
+
+    def _telemetry(self) -> telemetry_lib.EventWriter | None:
+        if self._tele is None and self.telemetry_dir:
+            self._tele = telemetry_lib.EventWriter(
+                self.telemetry_dir, process="supervisor")
+        return self._tele
+
+    def _emit_attempt(self, edge: str, ordinal: int, **fields) -> None:
+        tele = self._telemetry()
+        if tele is not None:
+            tele.attempt(edge, ordinal, **fields)
 
     # -- one gang ------------------------------------------------------------
 
@@ -191,6 +219,12 @@ class Supervisor:
             if self._hb_dir is not None:
                 env["DLS_HEARTBEAT_FILE"] = os.path.join(
                     self._hb_dir, f"hb_{pid}")
+            # unconditional when resolved: telemetry_dir already honored an
+            # env-supplied value during resolution, and an EXPLICIT
+            # constructor argument must win over a conflicting env entry —
+            # the whole point is one merged stream, never two half-streams
+            if self.telemetry_dir:
+                env[telemetry_lib.WORKDIR_ENV] = self.telemetry_dir
             procs.append(subprocess.Popen(self.argv, env=env))
         logger.info(
             "attempt %d: launched %d worker(s) (coordinator :%d)",
@@ -258,6 +292,8 @@ class Supervisor:
 
     def _run_attempt(self, ordinal: int) -> Attempt:
         t0 = time.monotonic()
+        self._emit_attempt("begin", ordinal,
+                           num_processes=self.num_processes)
         procs = self._launch(ordinal)
         last_progress = time.monotonic()
         track_progress = self._hb_dir is not None or self.progress_path is not None
@@ -271,8 +307,12 @@ class Supervisor:
                           or self._progress_stamp() > stamp0)
             cls = self._classify(codes, ordinal=ordinal, hang=hang,
                                  made_progress=progressed)
-            return Attempt(ordinal, codes, time.monotonic() - t0,
-                           classification=cls, made_progress=progressed)
+            att = Attempt(ordinal, codes, time.monotonic() - t0,
+                          classification=cls, made_progress=progressed)
+            self._emit_attempt("end", ordinal, returncodes=att.returncodes,
+                               duration_s=att.duration_s, classification=cls,
+                               made_progress=progressed)
+            return att
 
         try:
             while True:
@@ -357,6 +397,9 @@ class Supervisor:
             "restore-failure: quarantining checkpoint step %d under %s and "
             "falling back to the previous step", step, self.ckpt_dir)
         quarantine_step_dir(self.ckpt_dir, step)
+        tele = self._telemetry()
+        if tele is not None:
+            tele.recovery(step, "restore-fallback", directory=self.ckpt_dir)
 
     def run(self) -> SupervisorResult:
         attempts: list[Attempt] = []
@@ -377,6 +420,16 @@ class Supervisor:
                         "restarting from checkpoint",
                         ordinal, attempt.returncodes, attempt.classification,
                     )
+                    tele = self._telemetry()
+                    if tele is not None:
+                        # one recovery record per restart decision: the audit
+                        # line tying the fault (classification) to the action
+                        # (no step — the supervisor doesn't know it, and a
+                        # fake one would mislead the dlstatus timeline)
+                        tele.recovery(
+                            None, "restart", ordinal=ordinal,
+                            classification=attempt.classification,
+                            returncodes=attempt.returncodes)
                     # destructive fallback only on the EXPLICIT sentinel: the
                     # circumstantial classification (no progress + checkpoint
                     # present) can also fit a deterministic training crash
@@ -396,10 +449,17 @@ class Supervisor:
                                 "against the same step (a transient storage "
                                 "error must not eat the retention window)",
                                 fallbacks)
-                    time.sleep(self._backoff_delay(ordinal))
+                    delay = self._backoff_delay(ordinal)
+                    self._emit_attempt("backoff", ordinal + 1, delay_s=delay)
+                    time.sleep(delay)
             logger.error("giving up after %d attempt(s)", len(attempts))
             return SupervisorResult(attempts)
         finally:
+            if self._tele is not None:
+                self._tele.close()
+                # a closed writer drops emits by design; a second run() on
+                # this Supervisor must get a fresh one, not a dead one
+                self._tele = None
             if self._hb_dir is not None:
                 import shutil
 
@@ -426,6 +486,10 @@ def main(argv: list[str] | None = None) -> int:
                         "(defaults to --progress-path)")
     p.add_argument("--no-restore-fallback", action="store_true",
                    help="never quarantine the latest step on restore-failure")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="run workdir for the telemetry event stream "
+                        "(defaults to --ckpt-dir/--progress-path); inspect "
+                        "with `dlstatus <dir>`")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="worker command (prefix with --)")
     args = p.parse_args(argv)
@@ -442,6 +506,7 @@ def main(argv: list[str] | None = None) -> int:
         restart_backoff_s=args.restart_backoff,
         ckpt_dir=args.ckpt_dir,
         fallback_on_restore_failure=not args.no_restore_fallback,
+        telemetry_dir=args.telemetry_dir,
     ).run()
     return 0 if result.ok else 1
 

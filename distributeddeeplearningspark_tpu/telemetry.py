@@ -1,0 +1,442 @@
+"""Run telemetry — a durable, typed JSONL event stream per run.
+
+The reference's Spark UI leaves a per-stage account of where a job's time
+went that survives the job; the rebuild's equivalents were fragmented —
+``Meter`` laps lived in process memory, recovery events went to stderr, and
+the supervisor's attempt history evaporated with the process. This module is
+the single durable artifact: every process appends typed, timestamped
+records to ``<workdir>/telemetry/events-<process>.jsonl`` and everything
+downstream (the goodput accountant here, the ``dlstatus`` inspector in
+:mod:`.status`) is a pure fold over those files — it works on a crashed
+run's partial stream exactly as on a finished one.
+
+Event kinds (one JSON object per line, ``ts``/``kind``/``process`` always
+present):
+
+- ``step_metrics`` — one metrics lap: ``step``, ``steps`` (in the lap),
+  ``lap_s``, ``metrics`` (the device metrics), plus the input-starvation
+  probe's snapshot (``input_wait_s``, ``prefetch_depth_min``, ...).
+- ``phase`` — ``name`` + ``edge`` ("begin"/"end"; end carries ``dur_s``).
+  Phase names the goodput accountant treats as overhead: ``compile``,
+  ``restore``, ``checkpoint``/``checkpoint-wait``/``checkpoint-verify``,
+  ``eval``. Other names (``run``, ``manifest``, ``profile-trace``) are
+  informational.
+- ``recovery`` — a recovery action fired: ``event`` ("skip", "rollback",
+  "restart", "restore-fallback", ...) plus free-form evidence fields.
+- ``attempt`` — supervisor gang lifecycle: ``edge`` ("begin"/"end"/
+  "backoff"), ``ordinal``, and on end ``returncodes``/``classification``/
+  ``duration_s``.
+- ``heartbeat`` — liveness stamp (``step``), the telemetry twin of the
+  supervisor's ``DLS_HEARTBEAT_FILE`` mtime.
+
+Writers are append-only and line-buffered; a SIGKILL can at worst tear the
+final line, which readers skip. No jax import here — the reader side must
+stay cheap enough for a CLI pointed at a run directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import json
+import logging
+import math
+import os
+import threading
+import time
+from typing import Any, Iterable
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.telemetry")
+
+#: Subdirectory of the workdir holding the per-process event files.
+TELEMETRY_DIRNAME = "telemetry"
+
+#: Env var carrying the run's workdir to every process (the supervisor
+#: exports it; a bare `Trainer` falls back to its checkpointer directory).
+WORKDIR_ENV = "DLS_TELEMETRY_DIR"
+
+#: phase name -> goodput component it is accounted under. Blocking spans
+#: only: async background work (orbax writes, manifest CRC threads) must
+#: NOT be listed here — it overlaps training and steals no step time.
+PHASE_CATEGORY = {
+    "compile": "compile_s",
+    "restore": "restore_s",
+    "checkpoint": "checkpoint_s",
+    "checkpoint-wait": "checkpoint_s",
+    "checkpoint-verify": "checkpoint_s",
+    "eval": "eval_s",
+}
+
+_INTERVAL_COMPONENTS = ("compile_s", "restore_s", "checkpoint_s", "eval_s",
+                        "restart_overhead_s", "idle_s")
+
+#: Every goodput component, in display order — the ONE list dlstatus renders
+#: and the acceptance tests sum ("components sum to wall-clock"). Extending
+#: PHASE_CATEGORY with a new overhead category means extending this too.
+GOODPUT_COMPONENTS = ("productive_s", "compile_s", "restore_s",
+                      "checkpoint_s", "eval_s", "input_starved_s",
+                      "restart_overhead_s", "idle_s")
+
+
+def _default_process() -> str:
+    """``p<rank>`` from the supervisor's env contract (``DLS_PROCESS_ID``);
+    a plain single-process run is p0."""
+    return f"p{os.environ.get('DLS_PROCESS_ID', '0')}"
+
+
+def telemetry_dir(workdir: str | os.PathLike) -> str:
+    """The events directory for ``workdir`` (which may BE the events dir —
+    ``dlstatus <workdir>`` and ``dlstatus <workdir>/telemetry`` both work)."""
+    workdir = os.fspath(workdir)
+    sub = os.path.join(workdir, TELEMETRY_DIRNAME)
+    if os.path.isdir(sub):
+        return sub
+    if os.path.basename(os.path.normpath(workdir)) == TELEMETRY_DIRNAME:
+        return workdir
+    if glob.glob(os.path.join(workdir, "events-*.jsonl")):
+        return workdir
+    return sub
+
+
+class EventWriter:
+    """Appends typed events to ``<workdir>/telemetry/events-<process>.jsonl``.
+
+    Best-effort by design: a full disk or read-only filesystem downgrades
+    telemetry to a one-time warning, never a training failure. ``clock`` is
+    injectable (epoch seconds) so accounting tests run on a fake clock.
+    """
+
+    def __init__(self, workdir: str | os.PathLike, *, process: str | None = None,
+                 clock=time.time):
+        self.workdir = os.path.abspath(os.fspath(workdir))
+        self.process = process or _default_process()
+        self.path = os.path.join(self.workdir, TELEMETRY_DIRNAME,
+                                 f"events-{self.process}.jsonl")
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._f = None
+        self._closed = False
+        self._warned = False
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        rec = {"ts": self._clock(), "kind": kind, "process": self.process,
+               **fields}
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            if self._closed:
+                # a stale reference held past configure()'s rebind (or any
+                # close()) must NOT silently reopen the file and fork the
+                # stream in two — late emits drop instead
+                return
+            try:
+                if self._f is None:
+                    os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                    self._f = open(self.path, "a")
+                self._f.write(line + "\n")
+                self._f.flush()
+            except OSError as e:
+                if not self._warned:
+                    logger.warning("telemetry disabled (%s): %s", self.path, e)
+                    self._warned = True
+
+    @contextlib.contextmanager
+    def phase(self, name: str, **fields: Any):
+        """Span a blocking phase: begin/end records, end carries ``dur_s``.
+        The begin record makes crashed runs honest — an unterminated begin
+        is accounted up to the stream's last event."""
+        t0 = self._clock()
+        self.emit("phase", name=name, edge="begin", **fields)
+        try:
+            yield
+        finally:
+            self.emit("phase", name=name, edge="end",
+                      dur_s=self._clock() - t0, **fields)
+
+    # typed convenience emitters ------------------------------------------
+
+    def step_metrics(self, step: int, *, steps: int, lap_s: float,
+                     metrics: dict[str, float] | None = None,
+                     **gauges: Any) -> None:
+        self.emit("step_metrics", step=int(step), steps=int(steps),
+                  lap_s=float(lap_s), metrics=dict(metrics or {}), **gauges)
+
+    def recovery(self, step: int | None, event: str, **fields: Any) -> None:
+        """``step=None`` when the emitter doesn't know the training step
+        (e.g. the supervisor, which only sees process lifecycles) — a wrong
+        guess would mislead the dlstatus timeline."""
+        if step is None:
+            self.emit("recovery", event=event, **fields)
+        else:
+            self.emit("recovery", step=int(step), event=event, **fields)
+
+    def attempt(self, edge: str, ordinal: int, **fields: Any) -> None:
+        self.emit("attempt", edge=edge, ordinal=int(ordinal), **fields)
+
+    def heartbeat(self, **fields: Any) -> None:
+        self.emit("heartbeat", **fields)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+
+# -- module singleton (for layers that can't thread a writer through) --------
+
+_writer: EventWriter | None = None
+
+
+def configure(workdir: str | os.PathLike, *, process: str | None = None,
+              clock=time.time) -> EventWriter:
+    """Bind the process-wide writer to ``workdir`` (idempotent per workdir).
+
+    The Trainer calls this with the resolved run workdir; from then on
+    layers without a writer reference (checkpoint.py, profiling.py) emit
+    through :func:`emit`/:func:`phase`."""
+    global _writer
+    wd = os.path.abspath(os.fspath(workdir))
+    if (_writer is not None and _writer.workdir == wd
+            and (process is None or _writer.process == process)):
+        return _writer
+    if _writer is not None:
+        _writer.close()
+    _writer = EventWriter(wd, process=process, clock=clock)
+    return _writer
+
+
+def get() -> EventWriter | None:
+    return _writer
+
+
+def reset() -> None:
+    """Drop the process-wide writer (tests; also ends a run's binding)."""
+    global _writer
+    if _writer is not None:
+        _writer.close()
+        _writer = None
+
+
+def emit(kind: str, **fields: Any) -> None:
+    """Emit through the process-wide writer; no-op when unconfigured."""
+    if _writer is not None:
+        _writer.emit(kind, **fields)
+
+
+def phase(name: str, **fields: Any):
+    """Span context through the process-wide writer (no-op unconfigured)."""
+    if _writer is not None:
+        return _writer.phase(name, **fields)
+    return contextlib.nullcontext()
+
+
+# -- reader ------------------------------------------------------------------
+
+
+def event_files(workdir: str | os.PathLike) -> list[str]:
+    return sorted(glob.glob(os.path.join(telemetry_dir(workdir),
+                                         "events-*.jsonl")))
+
+
+def read_events(workdir: str | os.PathLike) -> list[dict]:
+    """Merge every process's event file into one ts-ordered stream.
+
+    Torn lines (a writer SIGKILLed mid-append) and non-JSON garbage are
+    skipped — a crashed run's partial stream must parse. The sort is stable,
+    so records with equal timestamps keep their per-file order (the
+    multi-process merge contract the tests pin)."""
+    events: list[dict] = []
+    for path in event_files(workdir):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail / garbage line
+                    if isinstance(rec, dict) and "ts" in rec and "kind" in rec:
+                        events.append(rec)
+        except OSError:
+            continue
+    events.sort(key=lambda e: float(e["ts"]))
+    return events
+
+
+# -- goodput accounting ------------------------------------------------------
+
+
+def _union_seconds(intervals: list[tuple[float, float]]) -> float:
+    """Total covered length of possibly-overlapping [t0, t1] intervals."""
+    total = 0.0
+    end = -math.inf
+    for t0, t1 in sorted(intervals):
+        if t1 <= end:
+            continue
+        total += t1 - max(t0, end)
+        end = t1
+    return total
+
+
+def _subtract_intervals(
+    iv: tuple[float, float], subs: list[tuple[float, float]],
+) -> list[tuple[float, float]]:
+    """``iv`` minus every interval in ``subs`` (may split it)."""
+    out = [iv]
+    for s0, s1 in subs:
+        nxt: list[tuple[float, float]] = []
+        for t0, t1 in out:
+            if s1 <= t0 or t1 <= s0:
+                nxt.append((t0, t1))
+                continue
+            if t0 < s0:
+                nxt.append((t0, s0))
+            if s1 < t1:
+                nxt.append((s1, t1))
+        out = nxt
+    return out
+
+
+def goodput(events: Iterable[dict]) -> dict[str, float]:
+    """Fold an event stream into the run's time budget.
+
+    Returns ``{wall_s, productive_s, compile_s, restore_s, checkpoint_s,
+    eval_s, input_starved_s, restart_overhead_s, goodput_frac}``.
+
+    Accounting model: wall-clock is the stream's [first ts, last ts] span.
+    Overhead phases are intervals, merged by union — within a category so a
+    double-instrumented span counts once, and across ALL categories for the
+    productive residual, so a span nested in another is never subtracted
+    twice. ``input_starved_s`` is a counter (the per-lap probe snapshots
+    summed per process, then the MAX across processes — lockstep SPMD means
+    the slowest host's wait is the gang's wait). ``restart_overhead_s``
+    is the dead time between one attempt's end and the next one's begin
+    (supervisor backoff + teardown). ``idle_s`` is the gap between one
+    ``run`` span's end and the next one's begin — a stop-today/resume-
+    tomorrow workdir accrues a day of idle, which must be neither
+    "productive" nor a restart (gaps already covered by a supervisor
+    restart interval are not double-counted). ``productive_s`` is the
+    residual: wall − union(all overhead intervals) − input_starved. A
+    crashed stream simply ends early — an unterminated phase begin is
+    accounted up to the last event seen.
+    """
+    out = {"wall_s": 0.0, "productive_s": 0.0, "input_starved_s": 0.0,
+           "goodput_frac": 0.0}
+    for c in _INTERVAL_COMPONENTS:
+        out[c] = 0.0
+    events = [e for e in events if "ts" in e]
+    if not events:
+        return out
+    events = sorted(events, key=lambda e: float(e["ts"]))
+    t_lo, t_hi = float(events[0]["ts"]), float(events[-1]["ts"])
+    wall = t_hi - t_lo
+    out["wall_s"] = wall
+
+    intervals: dict[str, list[tuple[float, float]]] = {
+        c: [] for c in _INTERVAL_COMPONENTS}
+    open_phases: dict[tuple, list[float]] = {}
+    last_ts_by_process: dict[str | None, float] = {}
+    attempt_ends: list[float] = []
+    input_by_process: dict[str | None, float] = {}
+    last_attempt_end: float | None = None
+    last_end_ordinal = -2  # sentinel: nothing follows it
+    last_run_end: float | None = None
+    idle_candidates: list[tuple[float, float]] = []
+    for e in events:
+        kind, ts = e.get("kind"), float(e["ts"])
+        proc = e.get("process")
+        prev_proc_ts = last_ts_by_process.get(proc)
+        last_ts_by_process[proc] = ts
+        if kind == "phase":
+            name = e.get("name", "")
+            cat = PHASE_CATEGORY.get(name)
+            key = (proc, name)
+            if e.get("edge") == "begin":
+                if name == "run":
+                    starts = open_phases.get(key)
+                    if starts:
+                        # a NEW run span while this process's previous one
+                        # never closed: that session crashed — it effectively
+                        # ended at the process's last prior event, and the
+                        # gap from there to this resume is idle, not
+                        # productive residual
+                        starts.clear()
+                        if prev_proc_ts is not None and ts > prev_proc_ts:
+                            idle_candidates.append((prev_proc_ts, ts))
+                    elif last_run_end is not None and ts > last_run_end:
+                        # gap since the previous run span closed cleanly =
+                        # a stopped workdir sitting idle between sessions
+                        idle_candidates.append((last_run_end, ts))
+                    last_run_end = None
+                open_phases.setdefault(key, []).append(ts)
+            elif e.get("edge") == "end":
+                starts = open_phases.get(key)
+                t0 = starts.pop() if starts else ts - float(e.get("dur_s", 0.0))
+                if cat:
+                    intervals[cat].append((min(t0, ts), ts))
+                if name == "run":
+                    last_run_end = ts
+        elif kind == "step_metrics":
+            input_by_process[proc] = (input_by_process.get(proc, 0.0)
+                                      + float(e.get("input_wait_s", 0.0) or 0.0))
+        elif kind == "attempt":
+            if e.get("edge") == "end":
+                last_attempt_end = ts
+                last_end_ordinal = int(e.get("ordinal", -1))
+                attempt_ends.append(ts)
+            elif e.get("edge") == "begin" and last_attempt_end is not None:
+                # restart overhead only pairs WITHIN one supervisor session
+                # (ordinals increment per relaunch); an ordinal that does
+                # not follow the last end is a fresh supervisor invocation
+                # on the same workdir — that gap is idle time between
+                # sessions, not the price of a restart
+                if (int(e.get("ordinal", -1)) == last_end_ordinal + 1
+                        and ts > last_attempt_end):
+                    intervals["restart_overhead_s"].append(
+                        (last_attempt_end, ts))
+                last_attempt_end = None
+    # crash mid-phase: the begin is all we have. Do NOT extend it to the
+    # whole stream's end — a relaunched attempt appends hours of events to
+    # the same file set, and an orphaned span stretched across them would
+    # swallow the relaunch's productive time. The honest bound is the first
+    # supervisor attempt-end after the begin (when the death was reaped),
+    # falling back to the opening process's own last event (when it went
+    # silent) for unsupervised runs.
+    for (proc, name), starts in open_phases.items():
+        cat = PHASE_CATEGORY.get(name or "")
+        if cat:
+            proc_last = last_ts_by_process.get(proc, t_hi)
+            for t0 in starts:
+                reaped = [t for t in attempt_ends if t >= t0]
+                t1 = min(reaped) if reaped else proc_last
+                intervals[cat].append((t0, max(t0, t1)))
+
+    # idle-between-runs, minus the sub-spans a supervisor restart interval
+    # already accounts for (a relaunch IS a run-end→run-begin gap too).
+    # SUBTRACTED, not dropped whole: a hang's dwell (worker silent long
+    # before the watchdog reaped it) and the relaunch's startup tail extend
+    # beyond the restart interval and must not fall back into "productive"
+    restarts = intervals["restart_overhead_s"]
+    intervals["idle_s"] = [
+        piece for cand in idle_candidates
+        for piece in _subtract_intervals(cand, restarts)]
+
+    all_iv: list[tuple[float, float]] = []
+    for cat, iv in intervals.items():
+        out[cat] = _union_seconds(iv)
+        all_iv.extend(iv)
+    # gang-step SPMD runs in lockstep: the slowest host's input wait gates
+    # every step, so the gang-level starvation is the MAX over processes —
+    # summing would over-count N-fold exactly like un-unioned intervals
+    input_starved = max(input_by_process.values(), default=0.0)
+    out["input_starved_s"] = input_starved
+    overhead = _union_seconds(all_iv) + input_starved
+    out["productive_s"] = max(0.0, wall - overhead)
+    out["goodput_frac"] = out["productive_s"] / wall if wall > 0 else 0.0
+    return out

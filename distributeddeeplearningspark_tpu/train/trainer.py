@@ -11,6 +11,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import logging
+import os
 from typing import Any, Callable, Iterator, Sequence
 
 import jax
@@ -22,8 +23,12 @@ from distributeddeeplearningspark_tpu.data.feed import (
     put_global,
     stack_examples,
 )
-from distributeddeeplearningspark_tpu.data.prefetch import prefetch_to_device
+from distributeddeeplearningspark_tpu.data.prefetch import (
+    StarvationProbe,
+    prefetch_to_device,
+)
 from distributeddeeplearningspark_tpu import faults
+from distributeddeeplearningspark_tpu import telemetry as telemetry_lib
 from distributeddeeplearningspark_tpu.metrics import (
     Meter,
     MetricLogger,
@@ -45,8 +50,6 @@ def _touch_heartbeat() -> None:
     :class:`~..supervisor.Supervisor`): progress between checkpoints is then
     visible to the hang watchdog, so a long checkpoint_every doesn't read as
     a hung gang (and a spinning-but-stuck worker genuinely stops stamping)."""
-    import os
-
     path = os.environ.get("DLS_HEARTBEAT_FILE")
     if not path:
         return
@@ -290,6 +293,12 @@ class Trainer:
         dictated by this trainer's shardings. Call after ``init()``.
         """
         ckpt = checkpointer or self.checkpointer
+        # bind the run's telemetry before the restore so checkpoint.py's
+        # restore/verify phase spans land in the event stream even when
+        # restore() is called ahead of fit() (the resume path) — resolved
+        # against THIS restore's checkpointer, which may be the explicit
+        # argument rather than the constructor's
+        self._telemetry(ckpt)
         # real exceptions, not asserts: restore is the recovery path, and a
         # python -O relaunch silently skipping these guards would turn a
         # wiring mistake into an undiagnosable crash deep inside orbax
@@ -307,7 +316,28 @@ class Trainer:
         logger.info("resumed at step %d", int(jax.device_get(self.state.step)))
         return self.state, data_state
 
-    def _feed(self, dataset: PartitionedDataset, batch_size: int, *, skip_batches: int = 0):
+    def _telemetry(self, checkpointer=None) -> "telemetry_lib.EventWriter | None":
+        """The run's event writer, or None when no workdir is resolvable.
+
+        Workdir resolution: ``DLS_TELEMETRY_DIR`` (exported by the
+        supervisor so the gang and its overseer share one stream) wins;
+        otherwise the checkpointer directory (``checkpointer`` argument
+        first — restore() may be handed one explicitly — then the
+        constructor's) serves as the run's workdir — the place an operator
+        already points recovery tooling at. Binds the process-wide writer
+        so writer-less layers (checkpoint.py, profiling.py) emit into the
+        same stream.
+        """
+        workdir = os.environ.get(telemetry_lib.WORKDIR_ENV)
+        ckpt = checkpointer or self.checkpointer
+        if not workdir and ckpt is not None:
+            workdir = getattr(ckpt, "directory", None)
+        if not workdir:
+            return None
+        return telemetry_lib.configure(workdir)
+
+    def _feed(self, dataset: PartitionedDataset, batch_size: int, *,
+              skip_batches: int = 0, probe: StarvationProbe | None = None):
         nshards = num_data_shards(self.mesh)
         # Multi-process: each host stacks only its own devices' rows (its
         # "executor partitions"); put_global assembles the global batch.
@@ -321,7 +351,7 @@ class Trainer:
 
             hb = itertools.islice(hb, skip_batches, None)
         put = functools.partial(put_global, seq_sharded=self.context_parallel)
-        return prefetch_to_device(hb, self.mesh, put=put)
+        return prefetch_to_device(hb, self.mesh, put=put, probe=probe)
 
     # -- training -----------------------------------------------------------
 
@@ -420,8 +450,22 @@ class Trainer:
             tokens_per_step=batch_size * tokens_per_example,
             num_chips=self.mesh.devices.size,
         )
-        mlog = MetricLogger(log_every=log_every, tensorboard_dir=tensorboard_dir)
+        # run telemetry: per-lap step_metrics + phase spans + heartbeats into
+        # the workdir's JSONL stream (docs/OBSERVABILITY.md). None when no
+        # workdir is resolvable — then fit costs nothing extra.
+        tele = self._telemetry()
+        probe = StarvationProbe() if tele is not None else None
+
+        def tele_phase(name: str):
+            return (tele.phase(name) if tele is not None
+                    else contextlib.nullcontext())
+
+        mlog = MetricLogger(log_every=log_every, tensorboard_dir=tensorboard_dir,
+                            telemetry=tele)
         step_i = int(jax.device_get(self.state.step))
+        if tele is not None:
+            tele.emit("phase", name="run", edge="begin", step=step_i,
+                      attempt=int(os.environ.get("DLS_RESTART", "0") or 0))
         # trace window is relative to THIS loop's first step, and stop must
         # sync on the live state or async dispatch truncates the capture
         profiler = profiling.StepProfiler(
@@ -454,13 +498,18 @@ class Trainer:
         # run inherits the previous run's offset (skip beyond state.step IS
         # that drift) so re-checkpointing doesn't quietly drop it.
         rolled_back_batches = max(0, skip - step_i)
+        first_dispatch = True
         try:
-            for batch in self._feed(dataset, batch_size, skip_batches=skip):
+            for batch in self._feed(dataset, batch_size, skip_batches=skip,
+                                    probe=probe):
                 got_batch = True
                 if steps is not None and step_i >= steps:
                     break
                 if flops_pending:
-                    meter.set_flops(self.compiled_cost(batch))
+                    # lower+compile for cost analysis blocks like the first
+                    # step's compile does — same goodput category
+                    with tele_phase("compile"):
+                        meter.set_flops(self.compiled_cost(batch))
                     flops_pending = False
                 if fault is not None and step_i + 1 == fault.step \
                         and fault.kind in ("nan", "crash", "hang"):
@@ -478,7 +527,13 @@ class Trainer:
                 profiler.observe(step_i)
                 with profiling.step_annotation(step_i) if profile is not None \
                         else contextlib.nullcontext():
-                    self.state, metrics = self._train_step(self.state, batch)
+                    # the first call traces + XLA-compiles before dispatch
+                    # returns, so timing it IS the compile span (the step's
+                    # own device time is a rounding error next to it)
+                    with (tele_phase("compile") if first_dispatch
+                          else contextlib.nullcontext()):
+                        self.state, metrics = self._train_step(self.state, batch)
+                    first_dispatch = False
                 metrics = dict(metrics)
                 metrics.pop("weight", None)  # eval-aggregation detail, not a log line
                 step_i += 1
@@ -494,6 +549,13 @@ class Trainer:
                     lap_start = step_i
                     mlog.log(step_i, {**last_metrics, **meter.summary()})
                     _touch_heartbeat()
+                    if tele is not None:
+                        lap_s, lap_n = meter.last_lap or (0.0, 0)
+                        tele.step_metrics(
+                            step_i, steps=lap_n, lap_s=lap_s,
+                            metrics=last_metrics,
+                            **(probe.snapshot() if probe is not None else {}))
+                        tele.heartbeat(step=step_i)
                     if on_nonfinite == "raise":
                         sanitize.assert_all_finite(last_metrics, step=step_i)
                     elif on_nonfinite == "skip":
@@ -587,12 +649,18 @@ class Trainer:
                             self.checkpointer.directory)
                         faults.crash()
                 if eval_every and eval_dataset is not None and step_i % eval_every == 0:
-                    emetrics = self.evaluate(eval_dataset, batch_size=batch_size)
+                    with tele_phase("eval"):
+                        emetrics = self.evaluate(eval_dataset, batch_size=batch_size)
                     mlog.log(step_i, {f"eval_{k}": v for k, v in emetrics.items()})
         finally:
             # flush the trace and tensorboard even when a step/sanitizer blows
             # up mid-window — a crashed run's trace is the one you want most
             profiler.stop()
+            if tele is not None:
+                # close the run span on every exit the interpreter survives;
+                # a SIGKILL'd run leaves the stream open-ended, which is the
+                # signal dlstatus reads as "died mid-run"
+                tele.emit("phase", name="run", edge="end", step=step_i)
             mlog.close()
 
         if skip and not got_batch:

@@ -24,6 +24,8 @@ import os
 
 import jax
 
+from distributeddeeplearningspark_tpu import telemetry
+
 logger = logging.getLogger("distributeddeeplearningspark_tpu.profiling")
 
 
@@ -73,6 +75,11 @@ class StepProfiler:
             jax.profiler.start_trace(self.spec.dir)
             self._active = True
             self._stop_at = step + self.spec.num_steps
+            # mark the window in the run's event stream (informational —
+            # "profile-trace" is not a goodput overhead category) so a
+            # dlstatus reader knows which steps carry tracing overhead
+            telemetry.emit("phase", name="profile-trace", edge="begin",
+                           step=step, dir=self.spec.dir)
             logger.info("profiler: tracing steps %d..%d → %s",
                         step, self._stop_at, self.spec.dir)
         elif self._active and step >= self._stop_at:
@@ -86,6 +93,8 @@ class StepProfiler:
                 self._sync()
             jax.profiler.stop_trace()
             self._active = False
+            telemetry.emit("phase", name="profile-trace", edge="end",
+                           dir=self.spec.dir)
             logger.info("profiler: trace written to %s", self.spec.dir)
             # Spark-UI moment: surface where the captured steps' device time
             # went without requiring TensorBoard (whose profile converter is
@@ -212,7 +221,9 @@ def profile_cli(argv=None) -> int:
 
     The terminal counterpart of the Spark UI stage table: point it at any
     ``--profile-dir`` capture (or a bare ``.xplane.pb``) and read where the
-    step went, without TensorBoard.
+    step went, without TensorBoard. Its sibling ``dlstatus`` answers the
+    wall-clock question (goodput, attempts, recovery) from the run's
+    telemetry stream — see docs/OBSERVABILITY.md.
     """
     import argparse
     import json

@@ -131,10 +131,15 @@ def pytest_collection_modifyitems(config, items):
 
 @pytest.fixture(autouse=True)
 def _reset_session():
-    """Each test gets a clean Session slate (module-level singleton)."""
+    """Each test gets a clean Session slate (module-level singleton) and a
+    clean telemetry binding — a writer configured against one test's tmp
+    dir must not leak events into the next test's run."""
     yield
     if Session._active is not None:
         Session._active.stop()
+    from distributeddeeplearningspark_tpu import telemetry
+
+    telemetry.reset()
 
 
 @pytest.fixture

@@ -25,7 +25,7 @@ import sys
 import numpy as np
 import pytest
 
-from distributeddeeplearningspark_tpu import faults
+from distributeddeeplearningspark_tpu import faults, status, telemetry
 from distributeddeeplearningspark_tpu.supervisor import (
     RESTORE_FAILED_EXIT,
     Supervisor,
@@ -40,6 +40,19 @@ _CLEAN_ENV = {"XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"}
 
 def _corrupt_dirs(path):
     return [d for d in os.listdir(path) if re.match(r"\d+\.corrupt-\d+$", d)]
+
+
+def _attempt_ends(workdir):
+    """{ordinal: classification} from the run's attempt telemetry — every
+    drill asserts its fault left the matching audit record behind."""
+    return {e["ordinal"]: e["classification"]
+            for e in telemetry.read_events(workdir)
+            if e["kind"] == "attempt" and e.get("edge") == "end"}
+
+
+def _recovery_events(workdir):
+    return [e for e in telemetry.read_events(workdir)
+            if e["kind"] == "recovery"]
 
 
 # -- fault spec parsing (fast tier: no gangs) --------------------------------
@@ -94,6 +107,15 @@ def test_kill_mid_finalize_recovers_from_verified_step(tmp_path):
         quarantined, sorted(os.listdir(tmp_path)))
     # training continued past the tear on the relaunch: step 30 committed
     assert os.path.isdir(tmp_path / "30")
+    # the audit trail survived the SIGKILL: attempt lifecycle + the restart
+    # decision + the relaunch's quarantine of the torn step are all on disk
+    ends = _attempt_ends(tmp_path)
+    assert ends[0] == "training-crash" and ends[1] == "clean", ends
+    recov = _recovery_events(tmp_path)
+    assert any(e["event"] == "restart"
+               and e["classification"] == "training-crash" for e in recov)
+    assert any(e["event"] == "quarantine" and e["step"] == 20
+               for e in recov), recov
 
 
 # -- drill 2: verified-but-poisoned restore → supervisor fallback ------------
@@ -127,6 +149,12 @@ def test_restore_failure_falls_back_to_previous_step(tmp_path):
     assert result.attempts[1].classification == "clean"
     assert _corrupt_dirs(tmp_path) == ["20.corrupt-0"]
     assert open(tmp_path / "DONE").read() == "10"
+    # supervisor telemetry: the classification and the destructive fallback
+    # are auditable from the run dir alone
+    ends = _attempt_ends(tmp_path)
+    assert ends == {0: "restore-failure", 1: "clean"}, ends
+    assert any(e["event"] == "restore-fallback" and e["step"] == 20
+               for e in _recovery_events(tmp_path))
 
 
 def test_restore_failure_without_fallback_burns_restarts(tmp_path):
@@ -144,6 +172,7 @@ def test_restore_failure_without_fallback_burns_restarts(tmp_path):
     assert not result.ok
     assert [a.classification for a in result.attempts] == ["restore-failure"] * 3
     assert _corrupt_dirs(tmp_path) == []
+    assert _attempt_ends(tmp_path) == {i: "restore-failure" for i in range(3)}
 
 
 # -- drill 3: hang -----------------------------------------------------------
@@ -168,6 +197,55 @@ def test_hang_is_killed_classified_and_relaunched(tmp_path):
     assert result.attempts[0].classification == "hang"
     step, attempt = open(tmp_path / "DONE").read().split()
     assert int(step) == 15 and int(attempt) == 1
+    # the hang classification is in the durable attempt timeline
+    ends = _attempt_ends(tmp_path)
+    assert ends[0] == "hang" and ends[1] == "clean", ends
+
+
+# -- drill 3b: crash + dlstatus — the run is explainable from its dir alone --
+
+
+@pytest.mark.slow
+def test_crash_drill_dlstatus_reports_attempts_and_goodput(tmp_path):
+    """ISSUE 2 acceptance: after a supervised DLS_FAULT=crash run,
+    ``dlstatus <workdir>`` reports the attempt timeline, the recovery
+    event, and a goodput breakdown whose components sum to wall-clock
+    within 5% — and exits 0."""
+    sup = Supervisor(
+        [sys.executable, WORKER, "train", "--ckpt-dir", str(tmp_path),
+         "--steps", "20", "--checkpoint-every", "5"],
+        num_processes=1, max_restarts=2, restart_backoff_s=0.05,
+        env={**_CLEAN_ENV, "DLS_FAULT": "crash@12"},
+        progress_path=str(tmp_path),
+    )
+    result = sup.run()
+    assert result.ok, f"attempts: {[(a.ordinal, a.returncodes, a.classification) for a in result.attempts]}"
+    assert result.restarts == 1
+
+    rep = status.report(str(tmp_path))
+    # attempt timeline: the crash and the clean relaunch, with durations
+    assert [a["ordinal"] for a in rep["attempts"]] == [0, 1]
+    assert rep["attempts"][0]["classification"] == "training-crash"
+    assert -9 in rep["attempts"][0]["returncodes"]
+    assert rep["attempts"][1]["classification"] == "clean"
+    assert all(a["duration_s"] > 0 for a in rep["attempts"])
+    # the recovery event tying the fault to the restart decision
+    assert any(e["event"] == "restart"
+               and e["classification"] == "training-crash"
+               for e in rep["recovery_events"]), rep["recovery_events"]
+    # both attempts' trainer streams merged: laps from before AND after
+    steps_seen = [e["step"] for e in telemetry.read_events(str(tmp_path))
+                  if e["kind"] == "step_metrics"]
+    assert any(s <= 10 for s in steps_seen) and 20 in steps_seen, steps_seen
+    # goodput breakdown: components sum to wall-clock within 5%
+    g = rep["goodput"]
+    assert g["wall_s"] > 0 and g["goodput_frac"] > 0
+    assert g["compile_s"] > 0          # both attempts jit-compiled
+    assert g["restart_overhead_s"] > 0  # the backoff + teardown gap
+    total = sum(g[k] for k in telemetry.GOODPUT_COMPONENTS)
+    assert total == pytest.approx(g["wall_s"], rel=0.05), (total, g)
+    # the CLI renders the same report and exits 0
+    assert status.main([str(tmp_path)]) == 0
 
 
 # -- drill 4: NaN spike vs the divergence policies ---------------------------
@@ -199,13 +277,14 @@ def _mnist_trainer(checkpointer=None, seed=1):
 
 
 @pytest.mark.slow
-def test_nan_spike_skip_policy_finishes_finite(monkeypatch):
+def test_nan_spike_skip_policy_finishes_finite(tmp_path, monkeypatch):
     """Acceptance: fit(on_nonfinite='skip') + DLS_FAULT=nan@N finishes with
     finite final metrics and reports the skipped-step count in its summary;
     params never absorb the poisoned update."""
     import jax
 
     monkeypatch.setenv("DLS_FAULT", "nan@5")
+    monkeypatch.setenv(telemetry.WORKDIR_ENV, str(tmp_path))
     monkeypatch.delenv("DLS_RESTART", raising=False)
     t, ds = _mnist_trainer()
     state, summary = t.fit(ds, batch_size=16, steps=10, log_every=2,
@@ -215,6 +294,10 @@ def test_nan_spike_skip_policy_finishes_finite(monkeypatch):
     assert int(jax.device_get(state.step)) == 10
     for leaf in jax.tree.leaves(state.params):
         assert np.all(np.isfinite(np.asarray(jax.device_get(leaf))))
+    # the divergence skip left its durable audit record
+    assert any(e["event"] == "skip" and e.get("skipped_steps") == 1
+               for e in _recovery_events(tmp_path)), \
+        _recovery_events(tmp_path)
 
 
 @pytest.mark.slow
@@ -269,6 +352,11 @@ def test_nan_spike_rollback_policy(tmp_path, monkeypatch):
         # feed consumed (model rewound 6→4, stream did not)
         _, data_state = ck.restore(state)
         assert data_state["examples_seen"] == (12 + 2) * 16, data_state
+    # telemetry (bound to the checkpointer dir): the rollback recovery
+    # record names the step the model rewound to
+    recov = _recovery_events(tmp_path / "ck")
+    assert any(e["event"] == "rollback" and e.get("to_step") == 4
+               for e in recov), recov
 
 
 @pytest.mark.slow
