@@ -41,6 +41,28 @@ PROBE_SNIPPET = (
 )
 
 
+def telemetry_recovery(event: str, **fields) -> None:
+    """Mirror a chip-availability incident into the run telemetry stream
+    when a workdir is configured (``DLS_TELEMETRY_DIR``), so probe hangs
+    and bench timeouts show up in ``dlstatus`` next to the run they cost —
+    BENCH_r05's "hung past 150s (killed)" probes left no audit trail
+    outside the BENCH json tail. Best-effort and env-gated: the default
+    bench path pays nothing and can never fail on telemetry."""
+    import os
+
+    workdir = os.environ.get("DLS_TELEMETRY_DIR")
+    if not workdir:
+        return
+    try:
+        from distributeddeeplearningspark_tpu import telemetry
+
+        w = telemetry.EventWriter(workdir, process="bench", host=None)
+        w.recovery(None, event, **fields)
+        w.close()
+    except Exception:  # noqa: BLE001 — an audit trail must not fail a bench
+        pass
+
+
 def probe_backend(*, attempts: int = 3, timeout_s: float = 150.0,
                   backoff_s: float = 20.0) -> tuple[bool, list[str]]:
     """Subprocess-probe TPU init; returns (ok, error log). Never hangs."""
@@ -58,11 +80,22 @@ def probe_backend(*, attempts: int = 3, timeout_s: float = 150.0,
             errors.append(
                 f"probe {i + 1}/{attempts}: rc={out.returncode} "
                 f"after {time.time() - t0:.0f}s: {' '.join(tail)[:300]}")
+            telemetry_recovery("probe-error", attempt=i + 1,
+                               returncode=out.returncode, detail=errors[-1])
         except subprocess.TimeoutExpired:
             errors.append(
                 f"probe {i + 1}/{attempts}: hung past {timeout_s:.0f}s (killed)")
+            telemetry_recovery("probe-timeout", attempt=i + 1,
+                               timeout_s=timeout_s)
         if i + 1 < attempts:
             time.sleep(backoff_s)
+    if attempts > 1:
+        # terminal verdict of a RETRIED probe only: single-attempt pollers
+        # (tpu_watch every interval) already emitted the per-attempt event,
+        # and a duplicate per poll would flood a 12h outage with ~150
+        # identical recovery lines
+        telemetry_recovery("backend-unavailable", attempts=attempts,
+                           errors=errors)
     return False, errors
 
 
@@ -1352,6 +1385,8 @@ def run_chip_queue(out_path: str, *, items: list[str] | None = None) -> int:
             append({"item": name, "rc": -1, "timeout_s": timeout_s,
                     "elapsed_s": round(time.time() - t0, 1),
                     "record": {"error": f"timed out after {timeout_s}s"}})
+            telemetry_recovery("bench-timeout", item=name,
+                               timeout_s=timeout_s)
         (ran if item_ok else failed).append(name)
         # re-probe only when there ARE remaining items to protect — after
         # the last one, a 120 s recheck guards nothing and a failing probe

@@ -24,15 +24,116 @@ the cross-replica desync sanitizer from SURVEY.md §5.
 from __future__ import annotations
 
 import functools
+import os
+import time
 from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distributeddeeplearningspark_tpu import telemetry
 from distributeddeeplearningspark_tpu.parallel.mesh import BATCH_AXES
 
 AxisNames = str | Sequence[str]
+
+
+# --- opt-in comms probes -----------------------------------------------------
+#
+# A hung collective is the canonical silent SPMD failure: every host blocks,
+# nobody crashes, the step log just stops. These probes put the waiting on
+# the record: `collective` telemetry events carrying per-call host-side wait
+# time, which telemetry.fleet folds into the per-host comms-wait column. Off
+# by default (zero cost); enabled via enable_collective_probes() or
+# DLS_COMMS_PROBE=1 (how a supervisor-launched gang opts its workers in).
+
+#: Env toggle for the comms probes (any value but ""/"0" enables).
+COMMS_PROBE_ENV = "DLS_COMMS_PROBE"
+
+_probe_override: bool | None = None
+
+
+def enable_collective_probes(enabled: bool = True) -> None:
+    """Force the probes on/off for this process (wins over the env var)."""
+    global _probe_override
+    _probe_override = enabled
+
+
+def collective_probes_enabled() -> bool:
+    if _probe_override is not None:
+        return _probe_override
+    return os.environ.get(COMMS_PROBE_ENV, "") not in ("", "0")
+
+
+def _is_tracing() -> bool:
+    """True whenever ANY trace is being built — checked globally, not by
+    sniffing the operands: a concrete constant captured inside a jit trace
+    would pass a per-leaf Tracer check and emit one bogus trace-time event
+    that looks like an execution-time wait."""
+    clean = getattr(jax.core, "trace_state_clean", None)
+    if clean is not None:
+        return not clean()
+    return False  # no API to ask — treat as eager (old jax)
+
+
+def _probed(op: str, fn: Callable) -> Callable:
+    """Wrap an explicit-mode collective with the opt-in wait-time probe.
+
+    Only concrete EAGER calls are timed — dispatch through completion
+    (``block_until_ready``), emitted as a ``collective`` event. Under any
+    active trace the wrapper is a transparent no-op: XLA schedules the op
+    at compile time and there is no per-call host wait to measure. Since
+    the named-axis verbs are today only legal inside shard_map/pmap bodies
+    (always traced), the live comms-wait signal is :func:`barrier_probe`;
+    these wrappers exist so any future eager-collective call site is
+    covered without another instrumentation pass.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(tree: Any, axis: AxisNames = BATCH_AXES, **kw: Any) -> Any:
+        if not collective_probes_enabled() or _is_tracing():
+            return fn(tree, axis, **kw)
+        t0 = time.perf_counter()
+        out = fn(tree, axis, **kw)
+        jax.block_until_ready(out)
+        axis_label = axis if isinstance(axis, str) else ",".join(axis)
+        telemetry.emit("collective", op=op, axis=axis_label,
+                       wait_s=time.perf_counter() - t0)
+        return out
+
+    return wrapper
+
+
+_barrier_fns: dict = {}
+
+
+def barrier_probe(mesh, *, tag: str = "barrier") -> float:
+    """Time one full-mesh scalar psum from dispatch to completion.
+
+    The cheapest honest measure of "how long does this host wait for the
+    gang": a replicated scalar psum cannot return before every device has
+    joined, so its host-side latency IS the barrier wait — in a straggling
+    gang the fast hosts' samples grow by exactly the straggler's lag. The
+    first call per mesh compiles (untimed — warm-up, not wait); each later
+    call emits a ``collective`` event (``op=tag``) through the process-wide
+    telemetry writer and returns the wait in seconds. Costs one tiny
+    dispatch, so calling it once per metrics lap is noise.
+    """
+    fn = _barrier_fns.get(mesh)
+    names = tuple(mesh.axis_names)
+    if fn is None:
+        from jax.sharding import PartitionSpec as P
+
+        body = shard_map(lambda x: lax.psum(x, names), mesh=mesh,
+                         in_specs=P(), out_specs=P())
+        fn = jax.jit(body)
+        jax.block_until_ready(fn(jnp.zeros((), jnp.float32)))  # compile
+        _barrier_fns[mesh] = fn
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(jnp.ones((), jnp.float32)))
+    wait = time.perf_counter() - t0
+    telemetry.emit("collective", op=tag, axis=",".join(names), wait_s=wait)
+    return wait
 
 
 def axis_size(axis_name: AxisNames) -> int:
@@ -77,6 +178,14 @@ def reduce_scatter(tree: Any, axis: AxisNames = BATCH_AXES, *, scatter_dim: int 
         lambda x: lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True),
         tree,
     )
+
+
+# opt-in wait-time probes around the Horovod verb set (no-ops unless
+# enabled, transparent under tracing — see _probed)
+all_reduce_sum = _probed("all_reduce_sum", all_reduce_sum)
+all_reduce_mean = _probed("all_reduce_mean", all_reduce_mean)
+all_gather = _probed("all_gather", all_gather)
+reduce_scatter = _probed("reduce_scatter", reduce_scatter)
 
 
 def all_to_all(x: jax.Array, axis: str, *, split_dim: int, concat_dim: int) -> jax.Array:

@@ -12,6 +12,14 @@ was productive, and what ate the rest".
 
     dlstatus <workdir>            # goodput table, attempts, recovery events
     dlstatus <workdir> --json     # machine-readable report
+    dlstatus <workdir> --hosts    # + per-host fleet table, skew, verdicts
+
+``--hosts`` adds the pod-level view (:mod:`..telemetry.fleet`): one row per
+host with last step / heartbeat age / current phase / comms wait / goodput,
+the step-skew timeline, and — when the evidence supports one — a straggler
+or hang verdict naming the culprit host. Like the rest of the report it is
+a pure fold over the JSONL streams, so it works on crashed and partial
+streams (a silent host is exactly what it localizes).
 """
 
 from __future__ import annotations
@@ -23,6 +31,7 @@ import sys
 import time
 
 from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.telemetry import fleet as fleet_lib
 
 #: goodput components rendered in the breakdown table, in display order.
 _COMPONENTS = telemetry.GOODPUT_COMPONENTS
@@ -78,8 +87,10 @@ def attempts_from(events: list[dict]) -> list[dict]:
     return rows
 
 
-def report(workdir: str, *, now: float | None = None) -> dict:
-    """The full run report as a plain dict (what ``--json`` prints)."""
+def report(workdir: str, *, now: float | None = None,
+           hosts: bool = False) -> dict:
+    """The full run report as a plain dict (what ``--json`` prints).
+    ``hosts=True`` adds the ``fleet`` key (per-host table, skew, verdicts)."""
     events = telemetry.read_events(workdir)
     heartbeats = [e for e in events if e.get("kind") == "heartbeat"]
     # the MOST RECENT step-bearing event, not the max step: a divergence
@@ -89,7 +100,12 @@ def report(workdir: str, *, now: float | None = None) -> dict:
                if e.get("kind") in ("step_metrics", "heartbeat")
                and e.get("step") is not None]
     last_hb = float(heartbeats[-1]["ts"]) if heartbeats else None
+    # fleet ages anchor on the STREAM's end (now=None), not wall-clock: the
+    # table must read the same on a live run and a week-old post-mortem
+    # copy — who fell silent first, and by how much, is stream-relative
+    rep_fleet = fleet_lib.fleet_report(events, now=now) if hosts else None
     return {
+        **({"fleet": rep_fleet} if hosts else {}),
         "workdir": workdir,
         "event_files": telemetry.event_files(workdir),
         "num_events": len(events),
@@ -125,6 +141,45 @@ def _fmt_s(v: float | None) -> str:
     return "-" if v is None else f"{v:.1f}s"
 
 
+def render_fleet(fl: dict) -> list[str]:
+    """The ``--hosts`` section: host table, skew, verdict lines."""
+    lines: list[str] = []
+    lines.append(
+        f"fleet: {fl['num_hosts']}/{fl['expected_hosts'] or fl['num_hosts']} "
+        f"host(s) reporting"
+        + (f"; MISSING hosts {fl['missing_hosts']}"
+           if fl["missing_hosts"] else ""))
+    header = (f"  {'host':>4}  {'last step':>9}  {'hb age':>8}  "
+              f"{'phase':<18} {'comms':>8}  {'goodput':>7}")
+    lines.append(header)
+    for r in fl["hosts"]:
+        hb = (f"{r['heartbeat_age_s']:.1f}s"
+              if r["heartbeat_age_s"] is not None else "-")
+        step = r["last_step"] if r["last_step"] is not None else "-"
+        phase = r["phase"] or "-"
+        lines.append(
+            f"  {r['host']:>4}  {step:>9}  {hb:>8}  {phase:<18} "
+            f"{r['comms_wait_s']:>7.2f}s  {r['goodput']['goodput_frac']:>7.3f}")
+    sk = fl["skew"]
+    if sk["per_step"]:
+        lines.append(
+            f"  step skew: max {sk['max_skew_s']:.2f}s / median "
+            f"{sk['median_skew_s']:.2f}s over {len(sk['per_step'])} common "
+            f"step window(s), last common step {sk['last_common_step']}, "
+            f"step lag {sk['step_lag']}")
+        tail = sk["per_step"][-8:]
+        lines.append("  skew timeline (last windows): " + "  ".join(
+            f"s{w['step']}:{w['skew_s']:.2f}s(h{w['slowest_host']})"
+            for w in tail))
+    elif sk["step_lag"]:
+        lines.append(f"  step lag: {sk['step_lag']} (no common step windows)")
+    if fl["straggler"]:
+        lines.append(f"  straggler: {fl['straggler']['verdict']}")
+    if fl["hang"]:
+        lines.append(f"  hang: {fl['hang']['verdict']}")
+    return lines
+
+
 def render(rep: dict) -> str:
     """Human-readable report (the default output)."""
     lines: list[str] = []
@@ -138,6 +193,9 @@ def render(rep: dict) -> str:
     if rep["last_heartbeat_ts"] is not None:
         lines.append(
             f"  last heartbeat: {_fmt_s(rep['last_heartbeat_age_s'])} ago")
+    if rep.get("fleet"):
+        lines.append("")
+        lines.extend(render_fleet(rep["fleet"]))
     lines.append("")
     lines.append("goodput breakdown")
     wall = g["wall_s"] or float("inf")
@@ -187,8 +245,11 @@ def main(argv: list[str] | None = None) -> int:
                                     "telemetry directory itself")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report")
+    ap.add_argument("--hosts", action="store_true",
+                    help="per-host fleet table, step skew, and straggler/"
+                         "hang verdicts (multi-host runs)")
     args = ap.parse_args(argv)
-    rep = report(args.workdir)
+    rep = report(args.workdir, hosts=args.hosts)
     if not rep["num_events"]:
         print(f"dlstatus: no telemetry events under {args.workdir} "
               f"(looked in {telemetry.telemetry_dir(args.workdir)})",

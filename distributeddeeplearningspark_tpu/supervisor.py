@@ -67,6 +67,10 @@ class Attempt:
     #: during the attempt — the signal separating "crashed at restore" from
     #: "crashed mid-training" when no sentinel exit code arrives.
     made_progress: bool = False
+    #: On a "hang": the fleet localization (host/phase/stalled_for_s/...)
+    #: from the gang's telemetry streams, when they carry enough evidence
+    #: to name a single stalled host (telemetry.fleet.localize_hang).
+    culprit: dict | None = None
 
     @property
     def ok(self) -> bool:
@@ -193,14 +197,50 @@ class Supervisor:
 
     def _telemetry(self) -> telemetry_lib.EventWriter | None:
         if self._tele is None and self.telemetry_dir:
+            # host=None: the supervisor describes the gang, it is not a
+            # member — its events must stay out of the fleet table (they
+            # would otherwise pollute host 0's liveness)
             self._tele = telemetry_lib.EventWriter(
-                self.telemetry_dir, process="supervisor")
+                self.telemetry_dir, process="supervisor", host=None)
         return self._tele
 
     def _emit_attempt(self, edge: str, ordinal: int, **fields) -> None:
         tele = self._telemetry()
         if tele is not None:
             tele.attempt(edge, ordinal, **fields)
+
+    def _localize_hang(self) -> dict | None:
+        """Name the stalled host from the gang's own telemetry streams.
+
+        The watchdog only knows "no progress anywhere"; the per-host
+        streams know who went silent FIRST and in what phase — the
+        difference between "restart the gang" and "drain host 3". Purely
+        best-effort: no telemetry dir, no worker streams, or no clear
+        single culprit all degrade to the bare classification.
+        """
+        if not self.telemetry_dir:
+            return None
+        try:
+            from distributeddeeplearningspark_tpu.telemetry import fleet
+
+            return fleet.localize_hang(
+                telemetry_lib.read_events(self.telemetry_dir),
+                now=time.time())
+        except Exception:  # noqa: BLE001 — diagnosis must not mask recovery
+            logger.debug("hang localization failed", exc_info=True)
+            return None
+
+    @staticmethod
+    def _culprit_fields(attempt: "Attempt") -> dict:
+        """The hang culprit flattened into recovery/attempt event fields."""
+        c = attempt.culprit
+        if not c:
+            return {}
+        return {"culprit_host": c.get("host"),
+                "culprit_phase": c.get("phase"),
+                "stalled_for_s": round(float(c.get("stalled_for_s", 0.0)), 1),
+                "others_at_step": c.get("others_at_step"),
+                "hang_verdict": c.get("verdict")}
 
     # -- one gang ------------------------------------------------------------
 
@@ -308,10 +348,15 @@ class Supervisor:
             cls = self._classify(codes, ordinal=ordinal, hang=hang,
                                  made_progress=progressed)
             att = Attempt(ordinal, codes, time.monotonic() - t0,
-                          classification=cls, made_progress=progressed)
+                          classification=cls, made_progress=progressed,
+                          culprit=self._localize_hang() if hang else None)
+            if att.culprit:
+                logger.warning("attempt %d hang localized: %s", ordinal,
+                               att.culprit.get("verdict"))
             self._emit_attempt("end", ordinal, returncodes=att.returncodes,
                                duration_s=att.duration_s, classification=cls,
-                               made_progress=progressed)
+                               made_progress=progressed,
+                               **self._culprit_fields(att))
             return att
 
         try:
@@ -426,10 +471,14 @@ class Supervisor:
                         # line tying the fault (classification) to the action
                         # (no step — the supervisor doesn't know it, and a
                         # fake one would mislead the dlstatus timeline)
+                        # a hang restart names the culprit host the fleet
+                        # data localized — "restart (hang)" alone sends the
+                        # operator grepping four hosts' logs
                         tele.recovery(
                             None, "restart", ordinal=ordinal,
                             classification=attempt.classification,
-                            returncodes=attempt.returncodes)
+                            returncodes=attempt.returncodes,
+                            **self._culprit_fields(attempt))
                     # destructive fallback only on the EXPLICIT sentinel: the
                     # circumstantial classification (no progress + checkpoint
                     # present) can also fit a deterministic training crash

@@ -27,7 +27,18 @@ present):
   "backoff"), ``ordinal``, and on end ``returncodes``/``classification``/
   ``duration_s``.
 - ``heartbeat`` — liveness stamp (``step``), the telemetry twin of the
-  supervisor's ``DLS_HEARTBEAT_FILE`` mtime.
+  supervisor's ``DLS_HEARTBEAT_FILE`` mtime. The writer auto-enriches it
+  with the innermost open ``phase`` so a stalled host is localizable from
+  its last event alone (:mod:`.fleet`).
+- ``collective`` — an opt-in comms probe sample (``op``, ``wait_s``) from
+  :mod:`..parallel.collectives`; feeds the fleet table's comms-wait column.
+
+Worker-side events additionally carry ``host`` (the process index from the
+``DLS_*`` env contract via :func:`~..utils.env.process_identity`, plus
+``hosts`` when the gang has more than one) so the cross-host aggregator in
+:mod:`.fleet` can attribute a multi-host run's streams without parsing file
+names. Non-host processes (the supervisor, ``tpu_watch``) write with
+``host=None`` and stay out of the fleet table.
 
 Writers are append-only and line-buffered; a SIGKILL can at worst tear the
 final line, which readers skip. No jax import here — the reader side must
@@ -106,28 +117,70 @@ class EventWriter:
     injectable (epoch seconds) so accounting tests run on a fake clock.
     """
 
+    _HOST_FROM_ENV = object()  # sentinel: resolve host identity from DLS_*
+
     def __init__(self, workdir: str | os.PathLike, *, process: str | None = None,
-                 clock=time.time):
+                 clock=time.time, host: int | None | object = _HOST_FROM_ENV,
+                 hosts: int | None = None):
         self.workdir = os.path.abspath(os.fspath(workdir))
         self.process = process or _default_process()
         self.path = os.path.join(self.workdir, TELEMETRY_DIRNAME,
                                  f"events-{self.process}.jsonl")
+        # host identity stamped on every event (fleet aggregation key).
+        # Default: the DLS_* env contract. host=None opts a non-host process
+        # (supervisor, tpu_watch, bench) out of the fleet table; an explicit
+        # host should come with the gang size (``hosts``), which otherwise
+        # falls back to the env contract's count.
+        from distributeddeeplearningspark_tpu.utils.env import (
+            process_identity,
+        )
+
+        env_host, env_hosts = process_identity()
+        self.host = env_host if host is EventWriter._HOST_FROM_ENV else host
+        self.hosts = hosts if hosts is not None else env_hosts
+        if self.host is not None:
+            self.hosts = max(self.hosts, self.host + 1)
         self._clock = clock
         self._lock = threading.Lock()
         self._f = None
         self._closed = False
         self._warned = False
+        # innermost-open-phase tracking for heartbeat enrichment: a list,
+        # not a set — nested identical names (restore inside restore) must
+        # pop correctly
+        self._open_phases: list[str] = []
 
     def emit(self, kind: str, **fields: Any) -> None:
         rec = {"ts": self._clock(), "kind": kind, "process": self.process,
                **fields}
-        line = json.dumps(rec, default=str)
+        if self.host is not None:
+            rec.setdefault("host", self.host)
+            if self.hosts > 1:
+                rec.setdefault("hosts", self.hosts)
         with self._lock:
             if self._closed:
                 # a stale reference held past configure()'s rebind (or any
                 # close()) must NOT silently reopen the file and fork the
                 # stream in two — late emits drop instead
                 return
+            if kind == "phase":
+                name = fields.get("name")
+                if name:
+                    if fields.get("edge") == "begin":
+                        self._open_phases.append(name)
+                    elif fields.get("edge") == "end" and name in self._open_phases:
+                        # remove the LAST occurrence (innermost of nested spans)
+                        for i in range(len(self._open_phases) - 1, -1, -1):
+                            if self._open_phases[i] == name:
+                                del self._open_phases[i]
+                                break
+            elif (kind == "heartbeat" and "phase" not in rec
+                  and self._open_phases):
+                # a heartbeat names where the process IS, not just that it
+                # lives — the field hang localization reads when a host's
+                # last event is a heartbeat
+                rec["phase"] = self._open_phases[-1]
+            line = json.dumps(rec, default=str)
             try:
                 if self._f is None:
                     os.makedirs(os.path.dirname(self.path), exist_ok=True)

@@ -1,0 +1,373 @@
+"""Cross-host telemetry aggregation — the pod-level view of one run.
+
+The event bus gives each process a durable stream; in multi-host SPMD the
+unit of failure is the *gang*: every host runs the same program, and one
+straggler stalls every collective, so the question after an incident is
+never "did the run hang" but "WHICH host stalled, in WHAT phase, while the
+others waited WHERE". This module folds the merged per-host streams of a
+shared workdir into:
+
+- a **host table** (:func:`host_table`) — per host: last step, heartbeat
+  age, current phase, comms wait, per-component goodput;
+- **step skew** (:func:`step_skew`) — for every step window all hosts
+  reported, the spread between the first and last host to reach it, plus a
+  **straggler verdict** when one host is persistently the slowest;
+- **hang localization** (:func:`localize_hang`) — the host whose stream
+  went silent first (the one actually stuck; the others' silence is just
+  the collective blocking on it), with the phase it was in and how long.
+
+Like the rest of the reader side this is a pure fold over event dicts: it
+works identically on a crashed run's partial streams, needs no jax, and a
+host whose file is torn mid-line simply contributes fewer events.
+
+Host identity: the ``host`` field stamped by the writer (the DLS_* process
+index); streams from before that field exist fall back to the ``p<k>``
+process-name convention. Non-host processes (``supervisor``, ``tpu_watch``,
+``bench``) are excluded from the table — their events describe the gang,
+they are not members of it.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable
+
+from distributeddeeplearningspark_tpu import telemetry
+
+_PROC_HOST_RE = re.compile(r"^p(\d+)$")
+
+#: the culprit host must have gone silent this many× the gang's observed
+#: per-step skew (its clock-jitter + normal-straggle baseline) before every
+#: other host did (see :func:`localize_hang`).
+DEFAULT_STALL_FACTOR = 3.0
+
+#: floor for the silence-lead margin (seconds): below this, clock jitter
+#: between hosts could explain the spread and no single host is named.
+MIN_STALL_MARGIN_S = 1.0
+
+
+def host_of(event: dict) -> int | None:
+    """The host index an event belongs to, or None for non-host processes."""
+    h = event.get("host")
+    if isinstance(h, int) and not isinstance(h, bool):
+        return h
+    m = _PROC_HOST_RE.match(str(event.get("process") or ""))
+    return int(m.group(1)) if m else None
+
+
+def split_hosts(events: Iterable[dict]) -> dict[int, list[dict]]:
+    """Group worker events by host index (ts order preserved)."""
+    by_host: dict[int, list[dict]] = {}
+    for e in events:
+        h = host_of(e)
+        if h is not None:
+            by_host.setdefault(h, []).append(e)
+    return by_host
+
+
+def _fold_host(host: int, events: list[dict]) -> dict[str, Any]:
+    """One host's row: liveness, position, phase, comms wait, goodput."""
+    last_step = None
+    last_step_ts = None
+    last_hb_ts = None
+    comms_wait = 0.0
+    collectives = 0
+    open_phases: list[tuple[str, float]] = []
+    hb_phase = None
+    process = None
+    for e in events:
+        ts = float(e["ts"])
+        kind = e.get("kind")
+        process = e.get("process", process)
+        if kind in ("step_metrics", "heartbeat") and e.get("step") is not None:
+            last_step = int(e["step"])
+            last_step_ts = ts
+        if kind == "heartbeat":
+            last_hb_ts = ts
+            if e.get("phase") is not None:
+                hb_phase = e["phase"]
+        elif kind == "phase":
+            name = e.get("name")
+            if not name:
+                continue
+            if e.get("edge") == "begin":
+                if name == "run":
+                    # a new run span = a relaunched attempt appending to
+                    # the same file: spans (and heartbeat phases) left open
+                    # by the crashed previous session are stale and must
+                    # not leak into this attempt's "current phase"
+                    open_phases.clear()
+                    hb_phase = None
+                open_phases.append((name, ts))
+            elif e.get("edge") == "end":
+                for i in range(len(open_phases) - 1, -1, -1):
+                    if open_phases[i][0] == name:
+                        del open_phases[i]
+                        break
+                if hb_phase == name:
+                    # the phase a heartbeat last reported has ENDED — a
+                    # clean exit must not read as "still in restore"
+                    hb_phase = None
+        elif kind == "collective":
+            comms_wait += float(e.get("wait_s", 0.0) or 0.0)
+            collectives += 1
+    # current phase = innermost still-open span (excluding the outer "run"
+    # umbrella when something more specific is open), else the last
+    # heartbeat's self-reported phase. phase_since_ts only for a specific
+    # inner span: "in run since the attempt began" is the whole attempt's
+    # age, not a stall dwell — age questions then fall back to last_ts
+    phase, phase_since = None, None
+    for name, ts in reversed(open_phases):
+        phase = name
+        phase_since = ts if name != "run" else None
+        if name != "run":
+            break
+    if phase is None:
+        phase = hb_phase
+    g = telemetry.goodput(events)
+    first_ts, last_ts = float(events[0]["ts"]), float(events[-1]["ts"])
+    return {
+        "host": host,
+        "process": process,
+        "num_events": len(events),
+        "first_ts": first_ts,
+        "last_ts": last_ts,
+        "last_step": last_step,
+        "last_step_ts": last_step_ts,
+        "last_heartbeat_ts": last_hb_ts,
+        "phase": phase,
+        "phase_since_ts": phase_since,
+        "comms_wait_s": comms_wait,
+        "collectives": collectives,
+        "goodput": g,
+    }
+
+
+def host_table(events: Iterable[dict], *, now: float | None = None
+               ) -> list[dict[str, Any]]:
+    """Per-host rows, host-index order. ``now`` (default: the HOST
+    streams' last timestamp, so a crashed workdir analyzed post-hoc doesn't
+    read as "everything stalled for a week") anchors the age fields:
+    ``heartbeat_age_s``, ``silence_s``, ``phase_age_s``. Non-host events
+    (the supervisor's reap records trail the workers' by seconds) never
+    move the anchor — ages compare hosts to each other."""
+    events = [e for e in events if "ts" in e]
+    by_host = split_hosts(events)
+    if not by_host:
+        return []
+    anchor = (max(float(e["ts"]) for evs in by_host.values() for e in evs)
+              if now is None else float(now))
+    rows = []
+    for h in sorted(by_host):
+        row = _fold_host(h, by_host[h])
+        row["silence_s"] = max(0.0, anchor - row["last_ts"])
+        row["heartbeat_age_s"] = (
+            max(0.0, anchor - row["last_heartbeat_ts"])
+            if row["last_heartbeat_ts"] is not None else None)
+        row["phase_age_s"] = (
+            max(0.0, anchor - row["phase_since_ts"])
+            if row["phase_since_ts"] is not None else None)
+        rows.append(row)
+    return rows
+
+
+def _median(xs: list[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if not n:
+        return 0.0
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+
+
+def step_skew(events: Iterable[dict]) -> dict[str, Any]:
+    """Per-step arrival spread across hosts.
+
+    For each step that EVERY host reported (a ``step_metrics`` or
+    ``heartbeat`` carrying ``step``), the skew is the gap between the first
+    host to reach it and the last — in lockstep SPMD that gap is pure
+    straggling (the fast hosts sat in the collective). Clock jitter between
+    hosts rides inside the number, which is why verdicts key on a host
+    being *persistently* slowest, not on any single window.
+
+    Returns ``{num_hosts, per_step: [{step, skew_s, fastest_host,
+    slowest_host}], max_skew_s, median_skew_s, last_common_step,
+    step_lag}`` (``step_lag`` = furthest minus most-behind host's last
+    step — nonzero the moment one host stops advancing).
+    """
+    by_host = split_hosts(e for e in events if "ts" in e)
+    arrivals: dict[int, dict[int, float]] = {}  # host -> step -> first ts
+    last_steps: dict[int, int] = {}
+    for h, evs in by_host.items():
+        at: dict[int, float] = {}
+        for e in evs:
+            if e.get("kind") in ("step_metrics", "heartbeat") \
+                    and e.get("step") is not None:
+                s = int(e["step"])
+                at.setdefault(s, float(e["ts"]))
+                last_steps[h] = s
+        arrivals[h] = at
+    out: dict[str, Any] = {"num_hosts": len(by_host), "per_step": [],
+                           "max_skew_s": 0.0, "median_skew_s": 0.0,
+                           "last_common_step": None, "step_lag": 0}
+    if len(by_host) < 2:
+        return out
+    common = sorted(set.intersection(*(set(a) for a in arrivals.values())))
+    skews: list[float] = []
+    for s in common:
+        at = {h: arrivals[h][s] for h in arrivals}
+        fastest = min(at, key=at.get)
+        slowest = max(at, key=at.get)
+        skew = at[slowest] - at[fastest]
+        skews.append(skew)
+        out["per_step"].append({"step": s, "skew_s": skew,
+                                "fastest_host": fastest,
+                                "slowest_host": slowest})
+    if common:
+        out["last_common_step"] = common[-1]
+        out["max_skew_s"] = max(skews)
+        out["median_skew_s"] = _median(skews)
+    if last_steps:
+        out["step_lag"] = max(last_steps.values()) - min(last_steps.values())
+    return out
+
+
+def straggler_verdict(skew: dict[str, Any], *,
+                      min_skew_s: float = 1.0,
+                      min_windows: int = 2,
+                      persistence: float = 0.5) -> dict[str, Any] | None:
+    """A straggler call from a :func:`step_skew` result, or None.
+
+    One host must be the slowest in more than ``persistence`` of the common
+    step windows (at least ``min_windows`` of them) with a median skew above
+    ``min_skew_s`` — a single slow window is noise (GC pause, checkpoint
+    write), a *persistent* slowest host is a sick machine.
+    """
+    per_step = skew.get("per_step") or []
+    if len(per_step) < min_windows:
+        return None
+    counts: dict[int, int] = {}
+    for w in per_step:
+        counts[w["slowest_host"]] = counts.get(w["slowest_host"], 0) + 1
+    host = max(counts, key=counts.get)
+    host_windows = [w for w in per_step if w["slowest_host"] == host]
+    frac = counts[host] / len(per_step)
+    median_skew = _median([w["skew_s"] for w in host_windows])
+    if frac <= persistence or len(host_windows) < min_windows \
+            or median_skew < min_skew_s:
+        return None
+    return {
+        "host": host,
+        "slow_windows": counts[host],
+        "windows": len(per_step),
+        "median_skew_s": median_skew,
+        "verdict": (f"host {host} slowest in {counts[host]}/{len(per_step)} "
+                    f"step windows (median skew {median_skew:.1f}s)"),
+    }
+
+
+def localize_hang(events: Iterable[dict], *, now: float | None = None,
+                  stall_factor: float = DEFAULT_STALL_FACTOR,
+                  margin_s: float | None = None,
+                  rows: list[dict] | None = None,
+                  skew: dict[str, Any] | None = None
+                  ) -> dict[str, Any] | None:
+    """Name the host a hang is stuck IN, or None when no single culprit.
+
+    In a hung gang every stream eventually goes silent — the stuck host
+    first (it stopped making progress), the rest when their next collective
+    blocked on it. So the culprit is the host whose LAST event is oldest,
+    provided it leads every other host's silence by a clear margin: by
+    default ``stall_factor`` × the gang's median per-step skew (the
+    observed clock-jitter + normal-straggle baseline), floored at
+    ``MIN_STALL_MARGIN_S``; override with ``margin_s``. A gang that went
+    silent together within that margin (network partition, coordinator
+    death) returns None — naming an arbitrary host would send the operator
+    to drain a healthy machine.
+
+    A single-host "gang" has no one else to compare against: it is named
+    only when its own silence exceeds the margin relative to ``now`` — so
+    a healthy or finished run inspected with the default stream-anchored
+    ``now`` (silence 0) is never flagged, while the supervisor, calling at
+    reap time with wall-clock ``now``, sees the hang dwell and names it.
+
+    Returns ``{host, process, phase, stalled_for_s, since_ts,
+    others_at_step, verdict}``; ``stalled_for_s`` is measured from the
+    culprit's open INNER phase begin when one exists (restore stuck for
+    312s), else from its last event (the outer ``run`` umbrella's begin is
+    the attempt's age, not a stall dwell). ``rows``/``skew`` accept a
+    precomputed :func:`host_table` / :func:`step_skew` (same events, same
+    ``now``) so :func:`fleet_report` folds the stream once, not three
+    times.
+    """
+    events = [e for e in events if "ts" in e]
+    if rows is None:
+        rows = host_table(events, now=now)
+    if not rows:
+        return None
+    # host-stream anchor, like host_table: the supervisor's trailing reap
+    # records must not open a fake silence window on a finished run
+    anchor = (float(now) if now is not None
+              else max(r["last_ts"] for r in rows))
+    if margin_s is None:
+        if skew is None:
+            skew = step_skew(events)
+        margin_s = max(MIN_STALL_MARGIN_S,
+                       stall_factor * skew["median_skew_s"])
+    if len(rows) == 1:
+        culprit, others = rows[0], []
+        if anchor - culprit["last_ts"] < margin_s:
+            return None  # still streaming (or stream-anchored): no stall
+    else:
+        by_silence = sorted(rows, key=lambda r: r["last_ts"])
+        culprit, others = by_silence[0], by_silence[1:]
+        if others[0]["last_ts"] - culprit["last_ts"] < margin_s:
+            return None  # everyone went quiet together: no single culprit
+    since = culprit["phase_since_ts"] if culprit["phase_since_ts"] is not None \
+        else culprit["last_ts"]
+    stalled_for = max(0.0, anchor - since)
+    others_step = max((r["last_step"] for r in others
+                       if r["last_step"] is not None), default=None)
+    phase = culprit["phase"]
+    verdict = (f"host {culprit['host']} stuck in "
+               f"phase={phase or 'unknown'} for {stalled_for:.0f}s")
+    if others_step is not None:
+        verdict += f", all others waiting at step {others_step}"
+    return {
+        "host": culprit["host"],
+        "process": culprit["process"],
+        "phase": phase,
+        "stalled_for_s": stalled_for,
+        "since_ts": since,
+        "others_at_step": others_step,
+        "verdict": verdict,
+    }
+
+
+def fleet_report(events: Iterable[dict], *, now: float | None = None
+                 ) -> dict[str, Any]:
+    """The full pod-level report (what ``dlstatus --hosts`` renders).
+
+    ``now`` anchors the age fields AND the hang margin — pass wall-clock
+    for a live run, leave None for a post-mortem on a copied-out workdir.
+    Expected host count comes from the writers' own ``hosts`` stamp, so a
+    host that never wrote a single event still shows up as missing.
+    """
+    events = [e for e in events if "ts" in e]
+    rows = host_table(events, now=now)
+    expected = max((int(e.get("hosts", 0)) for e in events
+                    if isinstance(e.get("hosts"), int)), default=0)
+    expected = max(expected, len(rows))
+    missing = sorted(set(range(expected)) - {r["host"] for r in rows}) \
+        if expected else []
+    skew = step_skew(events)
+    return {
+        "num_hosts": len(rows),
+        "expected_hosts": expected,
+        "missing_hosts": missing,
+        "hosts": rows,
+        "skew": skew,
+        "straggler": straggler_verdict(skew),
+        "hang": localize_hang(events, now=now, rows=rows, skew=skew),
+    }

@@ -34,6 +34,7 @@ from distributeddeeplearningspark_tpu.metrics import (
     MetricLogger,
     compiled_flops_per_step,
 )
+from distributeddeeplearningspark_tpu.parallel import collectives
 from distributeddeeplearningspark_tpu.parallel.mesh import num_data_shards
 from distributeddeeplearningspark_tpu.parallel.sharding import REPLICATED, ShardingRules
 from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
@@ -466,6 +467,16 @@ class Trainer:
         if tele is not None:
             tele.emit("phase", name="run", edge="begin", step=step_i,
                       attempt=int(os.environ.get("DLS_RESTART", "0") or 0))
+            # baseline heartbeat BEFORE the first (long) compile: a host
+            # that stalls during startup is then localizable by heartbeat
+            # age, not only by its phase-begin record
+            tele.heartbeat(step=step_i)
+        # opt-in gang-barrier latency sample per metrics lap (a replicated
+        # scalar psum timed host-side): in a straggling gang every healthy
+        # host's sample grows by the straggler's lag, which is the fleet
+        # table's comms-wait column (DLS_COMMS_PROBE=1, docs/OBSERVABILITY)
+        comms_probe = (tele is not None
+                       and collectives.collective_probes_enabled())
         # trace window is relative to THIS loop's first step, and stop must
         # sync on the live state or async dispatch truncates the capture
         profiler = profiling.StepProfiler(
@@ -556,6 +567,8 @@ class Trainer:
                             metrics=last_metrics,
                             **(probe.snapshot() if probe is not None else {}))
                         tele.heartbeat(step=step_i)
+                        if comms_probe:
+                            collectives.barrier_probe(self.mesh)
                     if on_nonfinite == "raise":
                         sanitize.assert_all_finite(last_metrics, step=step_i)
                     elif on_nonfinite == "skip":

@@ -15,6 +15,30 @@ import os
 import re
 
 
+def process_identity() -> tuple[int, int]:
+    """This host's (process index, process count) from the ``DLS_*`` env
+    contract — the same variables the supervisor exports and ``Session``
+    consumes (``DLS_PROCESS_ID`` / ``DLS_NUM_PROCESSES``).
+
+    Deliberately env-only, never ``jax.process_index()``: the telemetry
+    writer stamps every event with this identity and must work in processes
+    that never initialize jax (the supervisor, ``tpu_watch``, a crashed
+    worker's last gasp) and on boxes without jax at all (``dlstatus`` on a
+    copied-out run directory). A malformed value degrades to the
+    single-process identity rather than poisoning the event stream.
+    """
+    try:
+        index = int(os.environ.get("DLS_PROCESS_ID", "0"))
+    except ValueError:
+        index = 0
+    try:
+        count = int(os.environ.get("DLS_NUM_PROCESSES", "1"))
+    except ValueError:
+        count = 1
+    # a contract violation (id >= count) still yields a usable identity
+    return max(0, index), max(1, count, index + 1)
+
+
 def apply_env_platform_config(min_cpu_devices: int | None = None) -> None:
     """Honor JAX_PLATFORMS / XLA_FLAGS env intent via jax.config (best effort).
 

@@ -5,13 +5,19 @@ localhost) — the rebuild's analogue of the reference's `local[2]` two-executor
 Spark testbed, including the kill-one-process recovery drill.
 """
 
+import functools
 import os
+import subprocess
 import sys
 
 import numpy as np
 import pytest
 
-from distributeddeeplearningspark_tpu.supervisor import Supervisor, SupervisorResult
+from distributeddeeplearningspark_tpu.supervisor import (
+    Supervisor,
+    SupervisorResult,
+    free_port,
+)
 
 WORKER = os.path.join(os.path.dirname(__file__), "workers", "worker.py")
 
@@ -19,9 +25,66 @@ WORKER = os.path.join(os.path.dirname(__file__), "workers", "worker.py")
 # uses — each gang member is one "executor" with its own single CPU device.
 _CLEAN_ENV = {"XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"}
 
+# Minimal 2-process rendezvous + one cross-process collective — exactly the
+# machinery every gang drill below depends on, nothing else.
+_GANG_PROBE = """\
+import os
+import jax
+jax.distributed.initialize(coordinator_address=os.environ["DLS_COORDINATOR"],
+                           num_processes=2,
+                           process_id=int(os.environ["DLS_PROCESS_ID"]))
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("gang-probe")
+"""
+
+
+@functools.lru_cache(maxsize=1)
+def _gang_skip_reason() -> str | None:
+    """Capability probe, run once per session: can this jax build actually
+    execute CPU multiprocess collectives? Some builds rendezvous fine and
+    then die at the first cross-process psum with "Multiprocess
+    computations aren't implemented on the CPU backend" — an environmental
+    limit, not a supervisor bug, so the real-gang drills SKIP with the
+    probe's evidence instead of failing every full-suite run on such
+    builds."""
+    port = free_port()
+    base_env = {**os.environ, **_CLEAN_ENV,
+                "DLS_COORDINATOR": f"localhost:{port}"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _GANG_PROBE],
+            env={**base_env, "DLS_PROCESS_ID": str(pid)},
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(2)
+    ]
+    tails = []
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+                q.wait()
+            return "2-process CPU gang probe hung at rendezvous/collective"
+        if p.returncode != 0 and err.strip():
+            tails.append(err.strip().splitlines()[-1])
+    if all(p.returncode == 0 for p in procs):
+        return None
+    return ("this jax build cannot run CPU multiprocess collectives: "
+            + (tails[0][:160] if tails else "probe worker died"))
+
+
+@pytest.fixture()
+def gang():
+    """Skip (with the probe's evidence) when real multi-process gangs
+    cannot run here; the probe result is cached for the session."""
+    reason = _gang_skip_reason()
+    if reason:
+        pytest.skip(reason)
+
 
 @pytest.mark.slow
-def test_gang_completes_without_faults(tmp_path):
+def test_gang_completes_without_faults(tmp_path, gang):
     sup = Supervisor(
         [sys.executable, WORKER, "train", "--ckpt-dir", str(tmp_path),
          "--steps", "10", "--checkpoint-every", "5"],
@@ -34,7 +97,7 @@ def test_gang_completes_without_faults(tmp_path):
 
 
 @pytest.mark.slow
-def test_kill_one_worker_recovers_from_checkpoint(tmp_path):
+def test_kill_one_worker_recovers_from_checkpoint(tmp_path, gang):
     """Process 1 SIGKILLs itself at step 15 of 30 on attempt 0; the supervisor
     tears down the gang and relaunches; workers resume from the step-10
     checkpoint and finish."""
@@ -54,7 +117,7 @@ def test_kill_one_worker_recovers_from_checkpoint(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_gang_matches_single_process_numerics(tmp_path, eight_devices):
+def test_two_process_gang_matches_single_process_numerics(tmp_path, eight_devices, gang):
     """VERDICT r4 next-#8: the DCN control-plane analog of the dryrun's
     single-process fingerprint. A 2-process × 4-device jax.distributed
     gang runs 5 deterministic DP steps; post-step params must equal a
@@ -90,7 +153,7 @@ def test_two_process_gang_matches_single_process_numerics(tmp_path, eight_device
 
 
 @pytest.mark.slow
-def test_desync_sanitizer_catches_split_brain(tmp_path):
+def test_desync_sanitizer_catches_split_brain(tmp_path, gang):
     sup = Supervisor(
         [sys.executable, WORKER, "desync"],
         num_processes=2, max_restarts=0, env=_CLEAN_ENV,

@@ -77,6 +77,30 @@ run_dlstatus_smoke() {
   return $rc
 }
 
+# fleet/hosts smoke (ISSUE 3 satellite): replay the bundled 3-host hang
+# fixture through `dlstatus --hosts` — the stalled host must be NAMED (host
+# 2, phase restore) with a nonzero heartbeat age, from the files alone.
+run_hosts_smoke() {
+  local t0 rc out
+  t0=$(date +%s)
+  rc=0
+  out=$(python -m distributeddeeplearningspark_tpu.status \
+          tests/fixtures/fleet_3host --hosts --json \
+        | python -c '
+import json, sys
+fl = json.load(sys.stdin)["fleet"]
+hang = fl["hang"] or {}
+assert hang.get("host") == 2 and hang.get("phase") == "restore", hang
+row = next(h for h in fl["hosts"] if h["host"] == 2)
+assert row["heartbeat_age_s"] and row["heartbeat_age_s"] > 0, row
+print("culprit=host%s phase=%s hb_age=%.1fs"
+      % (hang["host"], hang["phase"], row["heartbeat_age_s"]))
+') || rc=$?
+  log hosts "${out:-fleet assertion failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[hosts] ${out:-FAILED} (rc=${rc})"
+  return $rc
+}
+
 overall=0
 case "${1:-both}" in
   fast) run_tier fast "not slow" || overall=$? ;;
@@ -89,10 +113,13 @@ case "${1:-both}" in
   # real-driver telemetry smoke: train a few steps, dlstatus must parse the
   # stream and report goodput_frac > 0 (docs/OBSERVABILITY.md)
   dlstatus) run_dlstatus_smoke || overall=$? ;;
+  # pod-level fleet view: bundled 3-host hang fixture through
+  # `dlstatus --hosts` (stalled host named, nonzero heartbeat age)
+  hosts) run_hosts_smoke || overall=$? ;;
   # the executable pod-day scripts, logged with the same audit trail
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall

@@ -11,11 +11,16 @@ file — so a window that dies mid-queue resumes where it left off on the
 next window instead of re-burning completed items.
 
 Usage: python tools/tpu_watch.py [--out CHIP_QUEUE_r05.jsonl]
-         [--interval 300] [--max-hours 12]
+         [--interval 300] [--max-hours 12] [--telemetry-dir DIR]
 
 Exits 0 when every CHIP_QUEUE item has a successful record, 1 on the
 time budget running out. Every probe attempt is logged with a timestamp
-(the outage evidence BASELINE.md's availability records are built from).
+(the outage evidence BASELINE.md's availability records are built from)
+AND mirrored into the watch workdir's telemetry stream
+(``<dir>/telemetry/events-tpu-watch.jsonl``, default next to ``--out``):
+a heartbeat per probe plus ``recovery`` events on up/down transitions, so
+chip-availability windows are auditable with ``dlstatus`` like any other
+run incident instead of living only in an ad-hoc ``tpu_watch_*.log``.
 """
 
 from __future__ import annotations
@@ -33,6 +38,45 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def _log(msg: str) -> None:
     print(f"[{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}] {msg}",
           flush=True)
+
+
+class WatchTelemetry:
+    """Mirror the watcher's device-availability observations into a
+    telemetry stream (best-effort — a failed import or unwritable dir
+    degrades to the plain log, never kills the watch).
+
+    One heartbeat per probe; ``recovery`` events only on up/down
+    TRANSITIONS (plus the first observation), so a 12-hour outage is two
+    audit lines with the error evidence, not 144 repeats.
+    """
+
+    def __init__(self, workdir: str | None):
+        self._w = None
+        self._last_up: bool | None = None
+        if not workdir:
+            return
+        try:
+            from distributeddeeplearningspark_tpu import telemetry
+
+            self._w = telemetry.EventWriter(
+                workdir, process="tpu-watch", host=None)
+        except Exception as e:  # noqa: BLE001
+            _log(f"telemetry mirror disabled: {e}")
+
+    def observe(self, probe: int, up: bool, *, pending: int,
+                errors: list[str] | None = None) -> None:
+        if self._w is None:
+            return
+        self._w.heartbeat(probe=probe, tpu_up=up, pending_items=pending)
+        if up != self._last_up:
+            self._w.recovery(None, "tpu-up" if up else "tpu-down",
+                             probe=probe, pending_items=pending,
+                             **({"errors": errors} if errors else {}))
+            self._last_up = up
+
+    def close(self) -> None:
+        if self._w is not None:
+            self._w.close()
 
 
 def scan_records(out_path: str) -> tuple[set[str], dict[str, int]]:
@@ -81,53 +125,64 @@ def main(argv=None) -> int:
                     help="give up on an item after this many failed runs "
                          "(a persistently wedged compile must not starve "
                          "the items behind it for the whole watch)")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="workdir for the availability telemetry stream "
+                         "(default: the --out file's directory; inspect "
+                         "with `dlstatus <dir>`)")
     args = ap.parse_args(argv)
 
+    tele = WatchTelemetry(
+        args.telemetry_dir
+        or os.path.dirname(os.path.abspath(args.out)))
     all_items = [n for n, _, _ in bench.CHIP_QUEUE]
     deadline = time.time() + args.max_hours * 3600
     probes = 0
-    while time.time() < deadline:
-        done, failed = scan_records(args.out)
-        given_up = sorted(n for n, k in failed.items()
-                          if n not in done and k >= args.max_attempts)
-        remaining = [n for n in all_items
-                     if n not in done and n not in given_up]
-        if not remaining:
-            _log(f"{len(done)}/{len(all_items)} queue items have good "
-                 f"records in {args.out}"
-                 + (f"; GAVE UP on {given_up} after {args.max_attempts} "
-                    f"failed attempts each" if given_up else "")
-                 + "; watcher done")
-            return 0 if not given_up else 1
-        probes += 1
-        ok, errs = bench.probe_backend(attempts=1, timeout_s=120)
-        if not ok:
-            _log(f"probe #{probes}: TPU down ({'; '.join(errs)[:160]}); "
-                 f"{len(remaining)}/{len(all_items)} items pending; "
-                 f"sleeping {args.interval:.0f}s")
-            time.sleep(args.interval)
-            continue
-        _log(f"probe #{probes}: TPU UP — draining {len(remaining)} items: "
-             f"{','.join(remaining)}"
-             + (f" (given up: {given_up})" if given_up else ""))
-        # the queue re-probes internally and aborts on a dead tunnel, so a
-        # window that closes mid-drain just returns us to the poll loop
-        subprocess.run(
-            [sys.executable, "bench.py", "--chip-queue",
-             "--queue-out", args.out,
-             "--queue-items", ",".join(remaining)],
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        done2, _ = scan_records(args.out)
-        if not (done2 - done):
-            # a drain that produced nothing new means the window closed or
-            # every remaining item is failing — don't spin back-to-back
-            _log(f"drain made no progress ({len(done2)} done); cooling "
-                 f"down {args.interval:.0f}s before re-probing")
-            time.sleep(args.interval)
-    pend = [n for n in all_items if n not in scan_records(args.out)[0]]
-    _log(f"time budget exhausted after {probes} probes; "
-         f"{len(pend)} items still pending: {','.join(pend)}")
-    return 1
+    try:
+        while time.time() < deadline:
+            done, failed = scan_records(args.out)
+            given_up = sorted(n for n, k in failed.items()
+                              if n not in done and k >= args.max_attempts)
+            remaining = [n for n in all_items
+                         if n not in done and n not in given_up]
+            if not remaining:
+                _log(f"{len(done)}/{len(all_items)} queue items have good "
+                     f"records in {args.out}"
+                     + (f"; GAVE UP on {given_up} after {args.max_attempts} "
+                        f"failed attempts each" if given_up else "")
+                     + "; watcher done")
+                return 0 if not given_up else 1
+            probes += 1
+            ok, errs = bench.probe_backend(attempts=1, timeout_s=120)
+            tele.observe(probes, ok, pending=len(remaining), errors=errs)
+            if not ok:
+                _log(f"probe #{probes}: TPU down ({'; '.join(errs)[:160]}); "
+                     f"{len(remaining)}/{len(all_items)} items pending; "
+                     f"sleeping {args.interval:.0f}s")
+                time.sleep(args.interval)
+                continue
+            _log(f"probe #{probes}: TPU UP — draining {len(remaining)} items: "
+                 f"{','.join(remaining)}"
+                 + (f" (given up: {given_up})" if given_up else ""))
+            # the queue re-probes internally and aborts on a dead tunnel, so a
+            # window that closes mid-drain just returns us to the poll loop
+            subprocess.run(
+                [sys.executable, "bench.py", "--chip-queue",
+                 "--queue-out", args.out,
+                 "--queue-items", ",".join(remaining)],
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+            done2, _ = scan_records(args.out)
+            if not (done2 - done):
+                # a drain that produced nothing new means the window closed or
+                # every remaining item is failing — don't spin back-to-back
+                _log(f"drain made no progress ({len(done2)} done); cooling "
+                     f"down {args.interval:.0f}s before re-probing")
+                time.sleep(args.interval)
+        pend = [n for n in all_items if n not in scan_records(args.out)[0]]
+        _log(f"time budget exhausted after {probes} probes; "
+             f"{len(pend)} items still pending: {','.join(pend)}")
+        return 1
+    finally:
+        tele.close()
 
 
 if __name__ == "__main__":
