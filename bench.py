@@ -65,7 +65,13 @@ def telemetry_recovery(event: str, **fields) -> None:
 
 def probe_backend(*, attempts: int = 3, timeout_s: float = 150.0,
                   backoff_s: float = 20.0) -> tuple[bool, list[str]]:
-    """Subprocess-probe TPU init; returns (ok, error log). Never hangs."""
+    """Subprocess-probe TPU init; returns (ok, error log). Never hangs.
+
+    A probe that HANGS to its full deadline caches the unavailable verdict
+    for the remaining attempts: a hang means the tunnel is down hard (a
+    flaky init fails fast with a returncode — that shape still retries),
+    and BENCH_r05 shows retrying it just burns the whole 3×150 s budget to
+    learn the same thing three times."""
     errors: list[str] = []
     for i in range(attempts):
         t0 = time.time()
@@ -87,6 +93,12 @@ def probe_backend(*, attempts: int = 3, timeout_s: float = 150.0,
                 f"probe {i + 1}/{attempts}: hung past {timeout_s:.0f}s (killed)")
             telemetry_recovery("probe-timeout", attempt=i + 1,
                                timeout_s=timeout_s)
+            if i + 1 < attempts:
+                errors.append(
+                    f"hang verdict cached: skipping the remaining "
+                    f"{attempts - i - 1} probe(s) — a hung tunnel does not "
+                    f"recover within one bench run")
+            break
         if i + 1 < attempts:
             time.sleep(backoff_s)
     if attempts > 1:

@@ -452,6 +452,67 @@ class Checkpointer:
         logger.info("restored checkpoint step %d from %s", step, self.directory)
         return restored[_STATE], data_state
 
+    def restore_params(self, *, step: int | None = None,
+                       sharding=None) -> tuple[Any, int]:
+        """Restore ONLY the params subtree — no caller-side state template.
+
+        The serving path (:mod:`.serve.reload`) runs in a process that has
+        no ``TrainState``: it doesn't know (and must not need to know)
+        which optimizer the training run used, so it cannot build the
+        template :meth:`restore` wants. Instead the checkpoint's own orbax
+        metadata supplies structure/shape/dtype for every saved leaf, the
+        full state restores against that self-described template, and the
+        ``params`` subtree is returned. Returns ``(params, step)``.
+
+        ``sharding``: one sharding applied to every leaf (e.g.
+        ``NamedSharding(mesh, P())`` to replicate onto a serving mesh);
+        ``None`` restores to the default device layout.
+
+        Step selection: the default walks back to the newest step that
+        passes verification, but — unlike :meth:`restore` — WITHOUT
+        quarantining the corrupt steps it passes over: the serving process
+        reads a checkpoint directory the training run owns, and renaming
+        steps out from under the owner's restore/retention logic is the
+        owner's recovery action, not a reader's. An explicit ``step`` is
+        verified but never walked back from.
+        """
+        import orbax.checkpoint as ocp
+
+        self.wait()
+        if step is None:
+            step = (self.latest_verified_step() if self.verify_on_restore
+                    else self.latest_step())
+            if step is None:
+                raise RestoreError(
+                    f"no intact checkpoint under {self.directory}")
+        elif self.verify_on_restore and os.path.isdir(self._step_dir(step)):
+            ok, reason = verify_step_dir(self._step_dir(step))
+            if not ok:
+                raise RestoreError(
+                    f"requested checkpoint step {step} failed integrity "
+                    f"verification: {reason}")
+        meta = self._mgr.item_metadata(int(step))[_STATE]
+        abstract = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(
+                m.shape, m.dtype,
+                **({"sharding": sharding} if sharding is not None else {})),
+            meta)
+        items = {_STATE: ocp.args.StandardRestore(abstract)}
+        step_dir = self._step_dir(step)
+        if os.path.isdir(step_dir) and _DATA in set(os.listdir(step_dir)):
+            # restore (and discard) the data_state item too: leaving it
+            # unclaimed makes orbax warn "Item could not be restored" on
+            # every poll of a serving-side reload watcher
+            items[_DATA] = ocp.args.JsonRestore()
+        with telemetry.phase("restore", step=int(step)):
+            restored = self._mgr.restore(int(step),
+                                         args=ocp.args.Composite(**items))
+        state = restored[_STATE]
+        params = state["params"] if isinstance(state, dict) else state.params
+        logger.info("restored params-only checkpoint step %d from %s",
+                    step, self.directory)
+        return params, int(step)
+
     # -- lifecycle -----------------------------------------------------------
 
     def wait(self) -> None:

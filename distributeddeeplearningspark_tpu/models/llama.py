@@ -354,7 +354,18 @@ class LlamaAttention(nn.Module):
         attend q over the cached prefix. One code path serves prefill (T =
         prompt length at index 0) and decode (T = 1). Static shapes: the
         cache is [B, max_cache_len, nkv, hd]; masking, not slicing, bounds
-        the attended positions (XLA-friendly — no dynamic shapes)."""
+        the attended positions (XLA-friendly — no dynamic shapes).
+
+        The write index is PER ROW (``index`` is [B], not a scalar): plain
+        ``generate`` advances every row in lockstep so the values stay
+        equal, but the continuous-batching server (serve/generate.py) keys
+        each KV slot at its own sequence position — a request admitted
+        mid-flight decodes from its prompt length while its neighbors are
+        hundreds of tokens in. Rows never see each other's stale cache:
+        ``kpos <= qpos`` bounds attention at each row's own position, and
+        every decode step writes its token before attending, so any
+        garbage beyond a row's index is both masked and overwritten before
+        it could ever be read."""
         cfg = self.cfg
         b, t = q.shape[0], q.shape[1]
         max_len = cfg.max_cache_len or cfg.max_position
@@ -363,15 +374,18 @@ class LlamaAttention(nn.Module):
         cv = self.variable("cache", "v", jnp.zeros,
                            (b, max_len, cfg.num_kv_heads, cfg.head_dim), cfg.dtype)
         cidx = self.variable("cache", "index",
-                             lambda: jnp.zeros((), jnp.int32))
-        idx = cidx.value
-        positions = idx + jnp.arange(t, dtype=jnp.int32)[None, :]
+                             lambda: jnp.zeros((b,), jnp.int32))
+        idx = cidx.value                                       # [B]
+        positions = idx[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+
+        def write_row(cache_row, new_row, start):
+            return jax.lax.dynamic_update_slice(
+                cache_row, new_row, (start, 0, 0))
+
+        ck.value = jax.vmap(write_row)(ck.value, k.astype(cfg.dtype), idx)
+        cv.value = jax.vmap(write_row)(cv.value, v.astype(cfg.dtype), idx)
         cidx.value = idx + t
         kpos = jnp.arange(max_len, dtype=jnp.int32)[None, None, None, :]
         qpos = positions[:, None, :, None]

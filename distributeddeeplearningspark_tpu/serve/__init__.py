@@ -1,0 +1,52 @@
+"""``dlserve`` — the inference-serving subsystem.
+
+The Spark-shaped lifecycle this repo preserves (``dlsubmit`` trains,
+``dlsupervise`` keeps the gang alive, ``dlstatus`` reads the telemetry)
+had no serving analogue: after three PRs the framework could train,
+recover, and observe a run but not answer a single request. This package
+closes the loop, reusing the layers the previous PRs hardened instead of
+growing a parallel stack:
+
+- :mod:`.engine` — a thread-safe request queue feeding a dynamic
+  micro-batcher: waiting requests coalesce up to ``max_batch`` /
+  ``max_wait_ms``, pad to a fixed set of jit-compiled batch buckets (no
+  recompile per request), and run one jitted forward on the existing
+  mesh/sharding layer. Admission control is a bounded queue with a typed
+  load-shed rejection (:class:`~.engine.OverloadedError`).
+- :mod:`.generate` — continuous batched decode for
+  :mod:`..models.llama_gen`: a fixed set of KV-cache slots, bucketed
+  prefill, join-mid-flight admission the moment a sequence completes, and
+  per-token streaming callbacks.
+- :mod:`.reload` — checkpoint hot-reload: watch the training run's
+  checkpoint directory, verify each new step against its PR 1 integrity
+  manifest, swap params between batches without dropping an in-flight
+  request, and keep the previous params serving when a candidate fails
+  verification.
+- :mod:`.cli` — the ``dlserve`` console entry point (synthetic-load
+  harness + latency report; see docs/SERVING.md).
+
+Every request leaves a ``request`` telemetry event (queue wait, batch
+size, inference time) in the same JSONL stream the training side writes,
+and ``dlstatus`` folds them into p50/p99 latency rollups
+(docs/OBSERVABILITY.md).
+"""
+
+from distributeddeeplearningspark_tpu.serve.engine import (  # noqa: F401
+    EngineStoppedError,
+    InferenceEngine,
+    OverloadedError,
+)
+from distributeddeeplearningspark_tpu.serve.generate import (  # noqa: F401
+    ContinuousGenerator,
+)
+from distributeddeeplearningspark_tpu.serve.reload import (  # noqa: F401
+    HotReloader,
+)
+
+__all__ = [
+    "InferenceEngine",
+    "ContinuousGenerator",
+    "HotReloader",
+    "OverloadedError",
+    "EngineStoppedError",
+]

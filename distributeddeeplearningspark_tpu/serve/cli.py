@@ -1,0 +1,251 @@
+"""``dlserve`` — stand up the serving engine and measure it under load.
+
+The serving sibling of ``dlsubmit``/``dlstatus``: builds an
+:class:`~.engine.InferenceEngine` over a model (params from a checkpoint
+directory when given, verified via the integrity manifests; fresh init
+otherwise), drives it with N closed-loop synthetic clients, and prints
+ONE JSON line with the latency/throughput evidence (the bench.py house
+convention). With ``--compare-sequential`` the same request count runs
+single-request-at-a-time through the identical jitted forward, so the
+line carries the dynamic-batching speedup measured, not assumed. With
+``--watch`` a :class:`~.reload.HotReloader` polls the checkpoint
+directory for newer verified steps for the whole run — a training job
+committing checkpoints mid-load exercises hot reload under traffic.
+
+::
+
+    dlserve --model lenet --clients 64 --requests-per-client 4 \
+            --compare-sequential
+    dlserve --model lenet --checkpoint-dir /ckpt/run17 --watch \
+            --workdir /ckpt/run17
+
+Per-request ``request`` telemetry events land in ``--workdir`` (or the
+checkpoint dir); ``dlstatus <workdir>`` renders the p50/p99 rollup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+# the ONE percentile definition (status.py's nearest-rank, jax-free) — the
+# CLI's printed p50/p99 must never drift from the dlstatus rollup of the
+# same run
+from distributeddeeplearningspark_tpu.status import _percentile as _pct
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dlserve",
+        description="Serve a model with dynamic batching; measure it under "
+                    "synthetic concurrent load.")
+    p.add_argument("--model", default="lenet", choices=["lenet"],
+                   help="served model (synthetic request generator included)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="load params from this checkpoint root (newest "
+                        "verified step); fresh-init when unset")
+    p.add_argument("--workdir", default=None,
+                   help="telemetry dir for request events (default: the "
+                        "checkpoint dir, when given)")
+    p.add_argument("--watch", action="store_true",
+                   help="hot-reload newer verified checkpoints during the "
+                        "run (requires --checkpoint-dir)")
+    p.add_argument("--watch-interval-s", type=float, default=2.0)
+    p.add_argument("--clients", type=int, default=16,
+                   help="concurrent closed-loop synthetic clients")
+    p.add_argument("--requests-per-client", type=int, default=8)
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--max-queue", type=int, default=1024)
+    p.add_argument("--compare-sequential", action="store_true",
+                   help="also run the same request count one-by-one through "
+                        "the identical forward and report the speedup")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def _lenet_setup(args):
+    """(variables, example_fn) for the LeNet workload."""
+    import jax
+    import numpy as np
+
+    from distributeddeeplearningspark_tpu.models import LeNet5
+
+    model = LeNet5()
+    rng = np.random.default_rng(args.seed)
+
+    def example(i: int):
+        return {"image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32)}
+
+    if args.checkpoint_dir:
+        from distributeddeeplearningspark_tpu import Checkpointer
+
+        with Checkpointer(args.checkpoint_dir, async_save=False) as ck:
+            params, step = ck.restore_params()
+        print(f"dlserve: serving checkpoint step {step} from "
+              f"{args.checkpoint_dir}", file=sys.stderr)
+    else:
+        params = model.init(
+            jax.random.PRNGKey(args.seed),
+            {"image": np.zeros((1, 28, 28, 1), np.float32)},
+            train=False)["params"]
+        step = None
+        print("dlserve: no --checkpoint-dir, serving fresh-init params",
+              file=sys.stderr)
+    return model, {"params": params}, example, step
+
+
+def run_load(engine, example_fn, *, clients: int, requests_per_client: int):
+    """Pipelined concurrent load: every client submits its whole request
+    stream, then collects the results (HTTP/2-style pipelining — the
+    client-side Python cost of a resubmit never serializes the server,
+    so the measurement sees the engine's throughput, not the GIL's).
+
+    Returns (latencies_sorted, shed_count, wall_s). A shed request counts
+    in ``shed`` and contributes no latency sample."""
+    from distributeddeeplearningspark_tpu.serve.engine import OverloadedError
+
+    lat: list[float] = []
+    shed = [0]
+    lock = threading.Lock()
+    # payloads are built BEFORE the clock starts: generating request bodies
+    # is client work, not serving work, and doing it inside the timed loop
+    # would serialize every arm on the GIL identically — measuring python,
+    # not the engine
+    payloads = [[example_fn(c * requests_per_client + j)
+                 for j in range(requests_per_client)]
+                for c in range(clients)]
+    barrier = threading.Barrier(clients + 1)
+
+    def client(cid: int):
+        barrier.wait()
+        pending = []
+        for ex in payloads[cid]:
+            t0 = time.monotonic()
+            try:
+                pending.append((t0, engine.submit(ex)))
+            except OverloadedError:
+                with lock:
+                    shed[0] += 1
+        for t0, fut in pending:
+            fut.result(timeout=120.0)
+            with lock:
+                lat.append(time.monotonic() - t0)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.monotonic()
+    for t in threads:
+        t.join()
+    return sorted(lat), shed[0], time.monotonic() - t0
+
+
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.watch and not args.checkpoint_dir:
+        build_parser().error("--watch requires --checkpoint-dir")
+
+    workdir = args.workdir or args.checkpoint_dir
+    import jax  # heavy import AFTER argparse (bench.py house rule)
+
+    from distributeddeeplearningspark_tpu.serve import (
+        HotReloader,
+        InferenceEngine,
+    )
+
+    model, variables, example_fn, ckpt_step = _lenet_setup(args)
+    engine = InferenceEngine.for_model(
+        model, variables, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        workdir=workdir, name=args.model)
+    reloader = None
+    if args.watch:
+        from distributeddeeplearningspark_tpu.serve.reload import (
+            checkpoint_params_loader,
+        )
+
+        reloader = HotReloader(
+            engine, args.checkpoint_dir, current_step=ckpt_step,
+            interval_s=args.watch_interval_s,
+            load_params=checkpoint_params_loader(
+                args.checkpoint_dir, wrap_in_variables=True))
+
+    with engine:
+        # compile the whole bucket ladder before timing: XLA compiles are a
+        # deploy cost, not a per-request latency fact
+        n_warm = engine.warmup(example_fn(0))
+        print(f"dlserve: warmed {n_warm} batch bucket(s) "
+              f"{engine.batch_sizes}", file=sys.stderr)
+        if reloader is not None:
+            reloader.start()
+        lat, shed, wall = run_load(
+            engine, example_fn, clients=args.clients,
+            requests_per_client=args.requests_per_client)
+        stats = engine.stats()
+        if reloader is not None:
+            reloader.stop()
+
+    rec = {
+        "metric": "dlserve_requests_per_sec",
+        "value": round(len(lat) / wall, 1) if wall > 0 else 0.0,
+        "unit": "req/s",
+        "extra": {
+            "model": args.model,
+            "clients": args.clients,
+            "requests_ok": len(lat),
+            "requests_shed": shed,
+            "latency_p50_ms": (round(_pct(lat, 0.5) * 1e3, 2)
+                               if lat else None),
+            "latency_p99_ms": (round(_pct(lat, 0.99) * 1e3, 2)
+                               if lat else None),
+            "wall_s": round(wall, 3),
+            "max_batch": args.max_batch,
+            "max_wait_ms": args.max_wait_ms,
+            "bucket_counts": stats["bucket_counts"],
+            "compiled_batch_shapes": stats["compiled_batch_shapes"],
+            "params_version": stats["params_version"],
+            "reloads": stats["reloads"],
+            "checkpoint_step": ckpt_step,
+            "workdir": workdir,
+        },
+    }
+
+    if args.compare_sequential:
+        # the same closed-loop load through an engine that answers ONE
+        # request per forward (max_batch=1, no coalescing window): both
+        # arms pay identical queue/future/telemetry costs, so the ratio
+        # isolates exactly what dynamic batching buys
+        # NO workdir: the comparison arm is local evidence for this JSON
+        # line — its request events in the run's stream would blend two
+        # engines' latencies into one dlstatus rollup and deflate the
+        # span-based throughput with the idle gap between the phases
+        seq = InferenceEngine.for_model(
+            model, variables, max_batch=1, max_wait_ms=0.0,
+            max_queue=args.max_queue, batch_sizes=(1,),
+            name=f"{args.model}-seq")
+        with seq:
+            seq.warmup(example_fn(0))
+            seq_lat, _, seq_wall = run_load(
+                seq, example_fn, clients=args.clients,
+                requests_per_client=args.requests_per_client)
+        seq_rps = len(seq_lat) / seq_wall if seq_wall > 0 else 0.0
+        rec["extra"]["sequential_requests_per_sec"] = round(seq_rps, 1)
+        rec["extra"]["sequential_latency_p50_ms"] = (
+            round(_pct(seq_lat, 0.5) * 1e3, 2) if seq_lat else None)
+        rec["extra"]["batching_speedup"] = (
+            round(rec["value"] / seq_rps, 2) if seq_rps > 0 else None)
+
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,428 @@
+"""Dynamic micro-batching request engine — the serving hot loop.
+
+The training side's throughput lever is one jitted SPMD step over a large
+batch; serving gets the same lever by *coalescing*: concurrent requests
+wait in a queue for at most ``max_wait_ms`` (or until ``max_batch`` are
+waiting), are stacked into one host batch, padded up to a fixed **bucket**
+size, and run through a single jit-compiled forward. Two properties carry
+the whole design:
+
+- **Bounded compile set.** XLA compiles one program per input shape, and a
+  recompile mid-traffic is a multi-second stall. Batches therefore never
+  run at their natural size: they pad to the smallest member of a fixed
+  ladder of bucket sizes (powers of two up to ``max_batch`` by default),
+  so steady-state traffic reuses a handful of compiled programs no matter
+  how request counts fluctuate. ``stats()["compiled_batch_shapes"]``
+  exposes the jit cache size so tests (and operators) can pin this.
+- **Params are an argument, not a constant.** The forward is jitted as
+  ``f(params, batch)``; hot-reload (:mod:`.reload`) swaps the param tree
+  between batches without touching the compiled program, and the batch
+  already in flight keeps the params it was dispatched with (jax arrays
+  are immutable) — zero dropped requests across a swap.
+
+Admission control is a bounded queue: when ``max_queue`` requests are
+already waiting, :meth:`InferenceEngine.submit` fails fast with a typed
+:class:`OverloadedError` (the backpressure contract — docs/SERVING.md)
+instead of letting latency grow without bound. Every request leaves a
+``request`` telemetry event; ``dlstatus`` rolls them into p50/p99.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from distributeddeeplearningspark_tpu import telemetry
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.serve")
+
+
+class OverloadedError(RuntimeError):
+    """Load-shed rejection: the admission queue is full.
+
+    Typed (not a bare RuntimeError) so callers can branch on it — retry
+    with backoff, spill to another replica, or return HTTP 429 — and
+    carries the queue evidence for the decision."""
+
+    def __init__(self, queue_depth: int, max_queue: int):
+        super().__init__(
+            f"engine overloaded: {queue_depth} requests already queued "
+            f"(max_queue={max_queue}) — shed, retry with backoff")
+        self.queue_depth = queue_depth
+        self.max_queue = max_queue
+
+
+class EngineStoppedError(RuntimeError):
+    """The engine is not accepting requests (stopped or never started)."""
+
+
+@dataclass
+class _Request:
+    rid: int
+    example: dict[str, np.ndarray]
+    future: Future = field(default_factory=Future)
+    t_submit: float = 0.0
+
+
+def default_buckets(max_batch: int, *, multiple_of: int = 1) -> tuple[int, ...]:
+    """The bucket ladder: powers of two up to ``max_batch``, each rounded up
+    to ``multiple_of`` (the mesh's data-shard count — GSPMD needs the batch
+    to divide evenly), deduplicated and capped at ``max_batch``."""
+    sizes: set[int] = set()
+    b = 1
+    while b < max_batch:
+        sizes.add(min(max_batch, -(-b // multiple_of) * multiple_of))
+        b *= 2
+    sizes.add(max_batch)
+    return tuple(sorted(sizes))
+
+
+class InferenceEngine:
+    """Coalesce concurrent single-example requests into jitted batches.
+
+    Parameters
+    ----------
+    forward:
+        ``(params, batch) -> outputs`` — the raw forward (this class jits
+        it). ``batch`` is a dict of stacked arrays; outputs may be any
+        pytree whose leaves have a leading batch axis (rows are split back
+        per request). Use :meth:`for_model` for the flax-module common case.
+    params:
+        The parameter pytree passed as the forward's first argument. Kept
+        swappable (:meth:`swap_params`) for checkpoint hot-reload.
+    mesh:
+        Optional :class:`jax.sharding.Mesh`: batches are placed with the
+        training feed's batch sharding (``put_global``) so the same GSPMD
+        layout that trains the model serves it. ``None`` = default device.
+    max_batch / max_wait_ms:
+        Coalescing knobs: a batch dispatches when ``max_batch`` requests
+        are waiting or the oldest has waited ``max_wait_ms``, whichever
+        comes first (a lone request never waits longer than the deadline).
+    max_queue:
+        Admission bound: requests beyond this many waiting are shed with
+        :class:`OverloadedError`.
+    batch_sizes:
+        Explicit bucket ladder; defaults to :func:`default_buckets`.
+    workdir:
+        When set, binds the process-wide telemetry stream here and emits
+        one ``request`` event per request into it. When unset the engine
+        is telemetry-silent — deliberate, so a side-by-side comparison
+        engine (dlserve --compare-sequential) can share a process without
+        blending its events into the run's serving rollup.
+    """
+
+    def __init__(
+        self,
+        forward: Callable[[Any, dict[str, Any]], Any],
+        params: Any,
+        *,
+        mesh=None,
+        max_batch: int = 32,
+        max_wait_ms: float = 5.0,
+        max_queue: int = 256,
+        batch_sizes: Sequence[int] | None = None,
+        workdir: str | None = None,
+        name: str = "engine",
+    ):
+        import jax
+
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._jax = jax
+        self.mesh = mesh
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.max_queue = int(max_queue)
+        shards = 1
+        if mesh is not None:
+            from distributeddeeplearningspark_tpu.parallel.mesh import (
+                num_data_shards,
+            )
+
+            shards = num_data_shards(mesh)
+            if self.max_batch % shards:
+                raise ValueError(
+                    f"max_batch {max_batch} must divide by the mesh's "
+                    f"{shards} data shards")
+        self.batch_sizes = tuple(sorted(
+            batch_sizes if batch_sizes is not None
+            else default_buckets(self.max_batch, multiple_of=shards)))
+        if self.batch_sizes[-1] < self.max_batch:
+            raise ValueError(
+                f"largest bucket {self.batch_sizes[-1]} is smaller than "
+                f"max_batch {self.max_batch} — a full batch would have no "
+                f"shape to run at")
+        self._tele = telemetry.configure(workdir) if workdir else None
+
+        def _engine_forward(params, batch):
+            # a fresh closure per engine: jax shares the jit cache between
+            # wrappers of the SAME function object, so two engines over one
+            # forward would otherwise count (and share) each other's
+            # compiles — stats()["compiled_batch_shapes"] must be this
+            # engine's own compile set
+            return forward(params, batch)
+
+        self._forward = jax.jit(_engine_forward)
+        self._params = params
+        self.params_version: int | str = 0
+        self._queue: list[_Request] = []
+        self._cond = threading.Condition()
+        # accepting from construction (requests queue up; nothing runs until
+        # start() spawns the worker — lets callers pre-fill deterministically)
+        self._stopped = False
+        self._thread: threading.Thread | None = None
+        self._rid = itertools.count()
+        self._stats = {"requests": 0, "shed": 0, "errors": 0, "batches": 0,
+                       "rows": 0, "reloads": 0}
+        self._bucket_counts: dict[int, int] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "InferenceEngine":
+        with self._cond:
+            if self._thread is not None:
+                return self
+            self._stopped = False
+            self._thread = threading.Thread(
+                target=self._loop, name=f"dlserve-{self.name}", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, *, drain: bool = True) -> None:
+        """Stop accepting requests; by default finish everything queued.
+
+        ``drain=False`` fails queued (not yet dispatched) requests with
+        :class:`EngineStoppedError` instead of running them. A never-
+        started engine with queued requests starts its worker just to
+        drain them — drain=True must never strand a future unresolved."""
+        if drain and self._thread is None and self._queue:
+            self.start()
+        with self._cond:
+            if self._stopped and self._thread is None:
+                return
+            self._stopped = True
+            if not drain:
+                for req in self._queue:
+                    req.future.set_exception(
+                        EngineStoppedError("engine stopped before dispatch"))
+                self._queue.clear()
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join()
+        self._thread = None
+
+    def __enter__(self) -> "InferenceEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, example: dict[str, Any]) -> Future:
+        """Enqueue one example; returns a Future resolving to its output row.
+
+        Raises :class:`OverloadedError` immediately when the queue is full
+        (load shed — the caller owns the retry policy) and
+        :class:`EngineStoppedError` when the engine isn't running."""
+        req = _Request(rid=next(self._rid),
+                       example={k: np.asarray(v) for k, v in example.items()})
+        req.t_submit = time.monotonic()
+        with self._cond:
+            if self._stopped:
+                raise EngineStoppedError("engine is stopped")
+            if len(self._queue) >= self.max_queue:
+                self._stats["shed"] += 1
+                if self._tele is not None:
+                    self._tele.emit("request", engine=self.name, id=req.rid,
+                                    outcome="shed",
+                                    queue_depth=len(self._queue))
+                raise OverloadedError(len(self._queue), self.max_queue)
+            self._queue.append(req)
+            self._stats["requests"] += 1
+            self._cond.notify_all()
+        return req.future
+
+    def infer(self, example: dict[str, Any], *, timeout: float | None = 30.0):
+        """Blocking convenience: ``submit`` + ``result``."""
+        return self.submit(example).result(timeout=timeout)
+
+    def warmup(self, example: dict[str, Any]) -> int:
+        """Compile every batch bucket up front (returns bucket count).
+
+        XLA compiles lazily per shape; without this the first request to
+        hit each bucket pays a multi-second stall *inside its latency*.
+        Serving processes should warm at startup — bucket ladder compiles
+        are a deploy cost, not a request cost. ``example`` is one request
+        payload (row 0 is broadcast to every bucket size)."""
+        row = {k: np.asarray(v)[None] for k, v in example.items()}
+        for b in self.batch_sizes:
+            batch = {k: np.repeat(v, b, axis=0) for k, v in row.items()}
+            self._jax.block_until_ready(
+                self._forward(self._params, self._place(batch)))
+        return len(self.batch_sizes)
+
+    def stats(self) -> dict[str, Any]:
+        with self._cond:
+            out = dict(self._stats)
+            out["queue_depth"] = len(self._queue)
+            out["bucket_counts"] = dict(self._bucket_counts)
+        out["params_version"] = self.params_version
+        try:
+            out["compiled_batch_shapes"] = self._forward._cache_size()
+        except Exception:  # jit cache introspection is best-effort
+            out["compiled_batch_shapes"] = None
+        return out
+
+    # -- hot reload ----------------------------------------------------------
+
+    def swap_params(self, params: Any, *, version: int | str | None = None) -> None:
+        """Replace the serving params between batches (checkpoint hot-reload).
+
+        The swap is a reference assignment under the queue lock: the worker
+        reads ``self._params`` once per batch, so a batch already dispatched
+        finishes on the params it started with and the next batch picks up
+        the new tree — no request is ever dropped or torn across trees.
+        When the current params are sharded jax arrays, the new tree is
+        placed with the same shardings (serving topology preserved)."""
+        jax = self._jax
+        old = self._params
+        try:
+            shardings = jax.tree.map(lambda a: a.sharding, old)
+            params = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), params, shardings)
+        except (AttributeError, ValueError, TypeError):
+            # host-side / mismatched trees: let the jit placement handle it
+            pass
+        with self._cond:
+            self._params = params
+            self._stats["reloads"] += 1
+            if version is not None:
+                self.params_version = version
+            elif isinstance(self.params_version, int):
+                self.params_version += 1
+
+    # -- worker --------------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.batch_sizes:
+            if b >= n:
+                return b
+        return self.batch_sizes[-1]
+
+    def _collect(self) -> tuple[list[_Request], Any] | None:
+        """Block until a batch is ready (coalescing window) or engine stops.
+
+        Returns (requests, params) — params snapshotted under the same lock
+        acquisition that claims the requests, so one batch = one tree."""
+        with self._cond:
+            while not self._queue:
+                if self._stopped:
+                    return None
+                self._cond.wait(0.1)
+            deadline = self._queue[0].t_submit + self.max_wait_s
+            while (len(self._queue) < self.max_batch
+                   and not self._stopped):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch = self._queue[:self.max_batch]
+            del self._queue[:self.max_batch]
+            return batch, self._params
+
+    def _place(self, batch: dict[str, np.ndarray]):
+        if self.mesh is not None:
+            from distributeddeeplearningspark_tpu.data.feed import put_global
+
+            return put_global(batch, self.mesh)
+        return batch  # jit's default placement
+
+    def _loop(self) -> None:
+        jax = self._jax
+        while True:
+            got = self._collect()
+            if got is None:
+                return
+            reqs, params = got
+            n = len(reqs)
+            bucket = self._bucket(n)
+            t0 = time.monotonic()
+            try:
+                stacked = {
+                    k: np.stack([r.example[k] for r in reqs])
+                    for k in reqs[0].example
+                }
+                if bucket > n:
+                    # pad rows are copies of row 0: shape-stable, numerics
+                    # can't overflow, and the rows are sliced off below
+                    stacked = {
+                        k: np.concatenate(
+                            [v, np.repeat(v[:1], bucket - n, axis=0)])
+                        for k, v in stacked.items()
+                    }
+                out = self._forward(params, self._place(stacked))
+                host = jax.device_get(out)
+                infer_s = time.monotonic() - t0
+            except Exception as e:  # noqa: BLE001 — one bad batch must not
+                # kill the serving loop; every member learns the real error
+                logger.exception("serve batch failed (%d requests)", n)
+                for r in reqs:
+                    if not r.future.set_running_or_notify_cancel():
+                        continue
+                    r.future.set_exception(e)
+                with self._cond:
+                    self._stats["errors"] += n
+                # one event PER request (the schema dlstatus counts by),
+                # not one per batch — an error's blast radius is its batch
+                if self._tele is not None:
+                    self._tele.emit_many("request", [
+                        dict(engine=self.name, id=r.rid, outcome="error",
+                             batch_size=n, error=f"{type(e).__name__}: {e}")
+                        for r in reqs])
+                continue
+            done_ts = time.monotonic()
+            with self._cond:
+                self._stats["batches"] += 1
+                self._stats["rows"] += n
+                self._bucket_counts[bucket] = (
+                    self._bucket_counts.get(bucket, 0) + 1)
+            # results first (clients unblock and overlap the reporting),
+            # then ONE batched telemetry append for the whole batch
+            for i, r in enumerate(reqs):
+                if not r.future.set_running_or_notify_cancel():
+                    continue  # caller cancelled while queued
+                r.future.set_result(jax.tree.map(lambda a: a[i], host))
+            if self._tele is not None:
+                self._tele.emit_many("request", [
+                    dict(engine=self.name, id=r.rid, outcome="ok",
+                         queue_wait_s=round(t0 - r.t_submit, 6),
+                         infer_s=round(infer_s, 6),
+                         latency_s=round(done_ts - r.t_submit, 6),
+                         batch_size=n, bucket=bucket)
+                    for r in reqs])
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def for_model(cls, model, variables: dict[str, Any], **kw) -> "InferenceEngine":
+        """Engine over a flax module's inference forward.
+
+        ``variables`` is the full variable dict (``{"params": ...}`` plus
+        any mutable collections like ``batch_stats``) — the whole tree is
+        the swappable unit, so a hot-reload can refresh running statistics
+        along with the weights."""
+
+        def forward(variables, batch):
+            return model.apply(variables, batch, train=False)
+
+        return cls(forward, variables, **kw)

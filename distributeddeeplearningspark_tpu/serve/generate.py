@@ -1,0 +1,447 @@
+"""Continuous batched decode for the Llama family — KV-cache slot serving.
+
+Plain :func:`~..models.llama_gen.generate` is a batch call: every row
+starts together and the call returns when the slowest row finishes, so a
+server built on it would stall short requests behind long ones and leave
+the chip idle while the batch drains. Continuous batching fixes both with
+a fixed pool of **KV-cache slots** stepped together forever:
+
+- the decode loop is ONE jitted single-token step over all ``slots`` rows
+  (per-row cache indices — ``LlamaAttention._decode_attend`` keys each
+  row at its own sequence position);
+- when a sequence completes, its slot frees and the next queued request
+  **joins mid-flight**: its prompt is prefilled in a separate bucketed
+  ``[1, bucket]`` call (a bounded compile set, like the engine's batch
+  buckets) and its cache row is inserted into the pool while the
+  neighboring slots are hundreds of tokens into their own sequences;
+- each sampled token is pushed through the request's optional streaming
+  callback the step it is produced — time-to-first-token is one prefill,
+  not one full batch.
+
+Params are read once per step, so :meth:`ContinuousGenerator.swap_params`
+(checkpoint hot-reload) takes effect at the next token without dropping
+or restarting in-flight sequences. Admission is the same bounded-queue /
+typed-shed contract as :mod:`.engine`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.serve.engine import (
+    EngineStoppedError,
+    OverloadedError,
+)
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.serve")
+
+
+def default_prompt_buckets(max_cache_len: int) -> tuple[int, ...]:
+    """Powers of two up to ``max_cache_len`` (8 at minimum): each distinct
+    bucket is one prefill compile, so the ladder is short by construction."""
+    sizes = []
+    b = 8
+    while b < max_cache_len:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_cache_len)
+    return tuple(sizes)
+
+
+@dataclass
+class _GenRequest:
+    rid: int
+    prompt: np.ndarray                      # [T] int32
+    max_new_tokens: int
+    stream: Callable[[int], None] | None
+    future: Future = field(default_factory=Future)
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    tokens: list[int] = field(default_factory=list)
+
+
+class ContinuousGenerator:
+    """A slot-pool decode server over one Llama param tree.
+
+    Parameters
+    ----------
+    cfg:
+        The model's :class:`~..models.llama.LlamaConfig` (training config;
+        the decode twin is derived here via
+        :func:`~..models.llama_gen.decode_model`).
+    params:
+        Param tree (same tree the training step holds).
+    slots:
+        KV-cache pool size — the decode step's fixed batch. Memory scales
+        linearly (``slots × max_cache_len`` K/V per layer).
+    max_cache_len:
+        Cache length per slot; every request needs
+        ``len(prompt) + max_new_tokens <= max_cache_len``.
+    temperature / top_k / top_p / eos_id / pad_id / seed:
+        Sampling configuration (engine-wide), semantics of
+        :func:`~..models.llama_gen.generate`.
+    prompt_buckets:
+        Prefill pad ladder; right-padded with ``pad_id`` (pads sit at
+        positions AFTER the real tokens, so causal attention never lets a
+        real token see one, and decode overwrites each pad's K/V before
+        that position is ever attended).
+    max_queue:
+        Admission bound; beyond it :meth:`submit` sheds with
+        :class:`~.engine.OverloadedError`.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params: Any,
+        *,
+        slots: int = 4,
+        max_cache_len: int | None = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_id: int | None = None,
+        pad_id: int = 0,
+        seed: int = 0,
+        prompt_buckets: Sequence[int] | None = None,
+        max_queue: int = 256,
+        workdir: str | None = None,
+        name: str = "generate",
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from distributeddeeplearningspark_tpu.models.llama_gen import (
+            _sample,
+            decode_model,
+        )
+
+        self._jax, self._jnp = jax, jnp
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.max_cache_len = int(max_cache_len or cfg.max_position)
+        if self.max_cache_len > cfg.max_position:
+            raise ValueError(
+                f"max_cache_len {self.max_cache_len} exceeds max_position "
+                f"{cfg.max_position}")
+        self.name = name
+        self.eos_id = eos_id
+        self.pad_id = int(pad_id)
+        self.max_queue = int(max_queue)
+        self.prompt_buckets = tuple(sorted(
+            prompt_buckets if prompt_buckets is not None
+            else default_prompt_buckets(self.max_cache_len)))
+        if self.prompt_buckets[-1] > self.max_cache_len:
+            raise ValueError(
+                f"largest prompt bucket {self.prompt_buckets[-1]} exceeds "
+                f"max_cache_len {self.max_cache_len}")
+        # same contract as InferenceEngine: request events only when a
+        # workdir is given (telemetry-silent otherwise)
+        self._tele = telemetry.configure(workdir) if workdir else None
+
+        self._model = decode_model(cfg, self.max_cache_len)
+        self._params = params
+        self.params_version: int | str = 0
+        self._key = jax.random.PRNGKey(seed)
+
+        sample = lambda logits, key: _sample(  # noqa: E731 — one-liner bind
+            logits.astype(jnp.float32), key,
+            temperature=temperature, top_k=top_k, top_p=top_p)
+
+        def prefill(params, ids, true_len, key):
+            """[1, bucket] prompt → (cache row at index true_len, first tok)."""
+            logits, mut = self._model.apply(
+                {"params": params}, {"input_ids": ids},
+                train=False, mutable=["cache"])
+            # pads were written into the cache beyond true_len; reset every
+            # index leaf (the only int32 cache leaves) so decode resumes at
+            # the REAL end of prompt — stale pad K/V beyond it is masked
+            # until overwritten (llama.py _decode_attend docstring)
+            cache = jax.tree.map(
+                lambda x: jnp.full_like(x, true_len)
+                if x.dtype == jnp.int32 else x,
+                mut["cache"])
+            tok = sample(logits[jnp.arange(1), true_len - 1], key)
+            return cache, tok
+
+        def step(params, cache, tok, key):
+            """One decode token for every slot at once."""
+            logits, mut = self._model.apply(
+                {"params": params, "cache": cache},
+                {"input_ids": tok[:, None]}, train=False, mutable=["cache"])
+            return mut["cache"], sample(logits[:, -1], key)
+
+        def insert(cache, row, slot):
+            """Write a prefilled [1, ...] cache row into pool slot ``slot``.
+
+            The slot axis is identified per leaf as the one where the pool
+            and row shapes differ (pool ``slots`` vs row 1) — robust to the
+            scanned-layer stacking that prepends a layer axis."""
+
+            def ins(c, r):
+                if c.shape == r.shape:
+                    return r
+                starts = tuple(
+                    slot if cs != rs else 0
+                    for cs, rs in zip(c.shape, r.shape))
+                return jax.lax.dynamic_update_slice(c, r, starts)
+
+            return jax.tree.map(ins, cache, row)
+
+        self._prefill = jax.jit(prefill, static_argnames=())
+        self._step = jax.jit(step)
+        self._insert = jax.jit(insert)
+
+        # empty slot pool: cache structure from an abstract eval (free), zeros
+        abstract = jax.eval_shape(
+            lambda p: self._model.apply(
+                {"params": p},
+                {"input_ids": jnp.zeros((self.slots, 1), jnp.int32)},
+                train=False, mutable=["cache"])[1]["cache"],
+            params)
+        self._cache = jax.tree.map(
+            lambda a: jnp.zeros(a.shape, a.dtype), abstract)
+        self._cur_tok = np.zeros((self.slots,), np.int32)
+
+        self._queue: list[_GenRequest] = []
+        self._active: list[_GenRequest | None] = [None] * self.slots
+        self._cond = threading.Condition()
+        # accepting from construction, like InferenceEngine: requests queue
+        # up; decoding begins when start() spawns the serving thread
+        self._stopped = False
+        self._thread: threading.Thread | None = None
+        self._rid = itertools.count()
+        self._stats = {"requests": 0, "shed": 0, "completed": 0, "steps": 0,
+                       "admitted": 0, "reloads": 0, "max_active": 0,
+                       "tokens": 0}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ContinuousGenerator":
+        with self._cond:
+            if self._thread is not None:
+                return self
+            self._stopped = False
+            self._thread = threading.Thread(
+                target=self._loop, name=f"dlserve-{self.name}", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, *, drain: bool = True) -> None:
+        """Stop admission; by default finish queued + in-flight sequences.
+
+        A never-started generator with queued requests starts the serving
+        thread just to drain them — drain=True must never strand a future."""
+        if drain and self._thread is None and self._queue:
+            self.start()
+        with self._cond:
+            if self._stopped and self._thread is None:
+                return
+            self._stopped = True
+            if not drain:
+                for req in self._queue:
+                    req.future.set_exception(
+                        EngineStoppedError("generator stopped before admission"))
+                self._queue.clear()
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join()
+        self._thread = None
+
+    def __enter__(self) -> "ContinuousGenerator":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               stream: Callable[[int], None] | None = None) -> Future:
+        """Enqueue a prompt; Future resolves to the np.int32 token array.
+
+        ``stream`` is called with each token id the step it is sampled
+        (from the serving thread — keep it cheap/non-blocking)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt.size + max_new_tokens > self.max_cache_len:
+            raise ValueError(
+                f"prompt {prompt.size} + new {max_new_tokens} exceeds "
+                f"max_cache_len {self.max_cache_len}")
+        if prompt.size > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt {prompt.size} exceeds largest prompt bucket "
+                f"{self.prompt_buckets[-1]}")
+        req = _GenRequest(rid=next(self._rid), prompt=prompt,
+                          max_new_tokens=int(max_new_tokens), stream=stream)
+        req.t_submit = time.monotonic()
+        with self._cond:
+            if self._stopped:
+                raise EngineStoppedError("generator is stopped")
+            if len(self._queue) >= self.max_queue:
+                self._stats["shed"] += 1
+                if self._tele is not None:
+                    self._tele.emit("request", engine=self.name, id=req.rid,
+                                    outcome="shed",
+                                    queue_depth=len(self._queue))
+                raise OverloadedError(len(self._queue), self.max_queue)
+            self._queue.append(req)
+            self._stats["requests"] += 1
+            self._cond.notify_all()
+        return req.future
+
+    def generate(self, prompt, max_new_tokens: int, *,
+                 timeout: float | None = 120.0,
+                 stream: Callable[[int], None] | None = None) -> np.ndarray:
+        """Blocking convenience: ``submit`` + ``result``."""
+        return self.submit(prompt, max_new_tokens, stream=stream).result(
+            timeout=timeout)
+
+    def stats(self) -> dict[str, Any]:
+        with self._cond:
+            out = dict(self._stats)
+            out["queue_depth"] = len(self._queue)
+            out["active"] = sum(r is not None for r in self._active)
+        out["params_version"] = self.params_version
+        return out
+
+    # -- hot reload ----------------------------------------------------------
+
+    def swap_params(self, params: Any, *, version: int | str | None = None) -> None:
+        """Swap the param tree between decode steps: in-flight sequences
+        keep their KV cache and continue on the new params at the next
+        token — nothing is dropped or restarted."""
+        jax = self._jax
+        old = self._params
+        try:
+            shardings = jax.tree.map(lambda a: a.sharding, old)
+            params = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), params, shardings)
+        except (AttributeError, ValueError, TypeError):
+            pass
+        with self._cond:
+            self._params = params
+            self._stats["reloads"] += 1
+            if version is not None:
+                self.params_version = version
+            elif isinstance(self.params_version, int):
+                self.params_version += 1
+
+    # -- serving loop --------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        return self.prompt_buckets[-1]
+
+    def _split_key(self):
+        self._key, sub = self._jax.random.split(self._key)
+        return sub
+
+    def _finish(self, req: _GenRequest, *, n_active: int) -> None:
+        done = time.monotonic()
+        req.future.set_result(np.asarray(req.tokens, np.int32))
+        with self._cond:
+            self._stats["completed"] += 1
+            self._stats["tokens"] += len(req.tokens)
+        if self._tele is not None:
+            self._tele.emit(
+                "request", engine=self.name, id=req.rid, outcome="ok",
+                tokens=len(req.tokens),
+                queue_wait_s=round(req.t_admit - req.t_submit, 6),
+                latency_s=round(done - req.t_submit, 6),
+                batch_size=n_active)
+
+    def _emit_token(self, req: _GenRequest, tok: int) -> bool:
+        """Record one sampled token; True when the sequence is complete."""
+        req.tokens.append(tok)
+        if req.stream is not None:
+            try:
+                req.stream(tok)
+            except Exception:  # noqa: BLE001 — a client callback must not
+                logger.exception("stream callback failed (request %d)", req.rid)
+        return (len(req.tokens) >= req.max_new_tokens
+                or (self.eos_id is not None and tok == self.eos_id))
+
+    def _admit(self, req: _GenRequest, slot: int, params) -> None:
+        """Prefill ``req`` and insert its cache row into ``slot``."""
+        jax = self._jax
+        req.t_admit = time.monotonic()
+        bucket = self._bucket(req.prompt.size)
+        ids = np.full((1, bucket), self.pad_id, np.int32)
+        ids[0, :req.prompt.size] = req.prompt
+        row, tok = self._prefill(params, ids,
+                                 np.int32(req.prompt.size), self._split_key())
+        tok = int(jax.device_get(tok)[0])
+        with self._cond:
+            self._stats["admitted"] += 1
+        n_active = sum(r is not None for r in self._active) + 1
+        if self._emit_token(req, tok):
+            # one-token request (or instant eos): never occupies the slot
+            self._finish(req, n_active=n_active)
+            return
+        self._cache = self._insert(self._cache, row, np.int32(slot))
+        self._cur_tok[slot] = tok
+        self._active[slot] = req
+        with self._cond:
+            self._stats["max_active"] = max(self._stats["max_active"],
+                                            n_active)
+
+    def _loop(self) -> None:
+        jax = self._jax
+        while True:
+            with self._cond:
+                idle = (not self._queue
+                        and all(r is None for r in self._active))
+                if idle:
+                    if self._stopped:
+                        return
+                    self._cond.wait(0.05)
+                    continue
+                params = self._params
+                admissions: list[tuple[_GenRequest, int]] = []
+                for slot in range(self.slots):
+                    if self._active[slot] is None and self._queue:
+                        admissions.append((self._queue.pop(0), slot))
+            for req, slot in admissions:
+                try:
+                    self._admit(req, slot, params)
+                except Exception as e:  # noqa: BLE001 — a poisoned prompt
+                    # fails ITS future; the pool keeps serving the rest
+                    logger.exception("prefill failed (request %d)", req.rid)
+                    req.future.set_exception(e)
+                    if self._tele is not None:
+                        self._tele.emit("request", engine=self.name,
+                                        id=req.rid, outcome="error",
+                                        error=f"{type(e).__name__}: {e}")
+            if all(r is None for r in self._active):
+                continue
+            self._cache, nxt = self._step(
+                params, self._cache, self._cur_tok, self._split_key())
+            nxt = np.asarray(jax.device_get(nxt))
+            with self._cond:
+                self._stats["steps"] += 1
+            n_active = sum(r is not None for r in self._active)
+            for slot, req in enumerate(self._active):
+                if req is None:
+                    continue
+                tok = int(nxt[slot])
+                if self._emit_token(req, tok):
+                    self._active[slot] = None       # frees the slot: the
+                    self._finish(req, n_active=n_active)  # next queued request
+                    continue                        # joins mid-flight
+                self._cur_tok[slot] = tok

@@ -14,6 +14,11 @@ was productive, and what ate the rest".
     dlstatus <workdir> --json     # machine-readable report
     dlstatus <workdir> --hosts    # + per-host fleet table, skew, verdicts
 
+A workdir that served traffic (:mod:`..serve` — ``request`` events in the
+stream) additionally gets the serving rollup: request counts by outcome
+(ok/shed/error), p50/p99/max latency, queue-wait percentiles, mean batch
+size, and throughput.
+
 ``--hosts`` adds the pod-level view (:mod:`..telemetry.fleet`): one row per
 host with last step / heartbeat age / current phase / comms wait / goodput,
 the step-skew timeline, and — when the evidence supports one — a straggler
@@ -87,6 +92,49 @@ def attempts_from(events: list[dict]) -> list[dict]:
     return rows
 
 
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    """Nearest-rank percentile over an already-sorted list (no numpy — the
+    reader side must stay importable without the training stack)."""
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def serving_from(events: list[dict]) -> dict | None:
+    """Fold ``request`` events (:mod:`..serve`) into the latency rollup.
+
+    None when the run served nothing. Latency percentiles cover completed
+    requests only; shed/error counts ride alongside so a load-shedding
+    incident can't hide inside a pretty p50 (the shed requests never got a
+    latency to report)."""
+    reqs = [e for e in events if e.get("kind") == "request"]
+    if not reqs:
+        return None
+    ok = [e for e in reqs if e.get("outcome") == "ok"]
+    lat = sorted(float(e["latency_s"]) for e in ok
+                 if e.get("latency_s") is not None)
+    queue = sorted(float(e["queue_wait_s"]) for e in ok
+                   if e.get("queue_wait_s") is not None)
+    sizes = [float(e["batch_size"]) for e in ok if e.get("batch_size")]
+    span = float(reqs[-1]["ts"]) - float(reqs[0]["ts"])
+    return {
+        "requests": len(reqs),
+        "ok": len(ok),
+        "shed": sum(e.get("outcome") == "shed" for e in reqs),
+        "errors": sum(e.get("outcome") == "error" for e in reqs),
+        "engines": sorted({str(e["engine"]) for e in reqs
+                           if e.get("engine") is not None}),
+        "latency_p50_s": _percentile(lat, 0.50),
+        "latency_p99_s": _percentile(lat, 0.99),
+        "latency_max_s": lat[-1] if lat else None,
+        "queue_wait_p50_s": _percentile(queue, 0.50),
+        "queue_wait_p99_s": _percentile(queue, 0.99),
+        "mean_batch_size": (sum(sizes) / len(sizes)) if sizes else None,
+        "requests_per_s": (len(ok) / span) if span > 0 else None,
+    }
+
+
 def report(workdir: str, *, now: float | None = None,
            hosts: bool = False) -> dict:
     """The full run report as a plain dict (what ``--json`` prints).
@@ -117,6 +165,7 @@ def report(workdir: str, *, now: float | None = None,
             ((now if now is not None else time.time()) - last_hb)
             if last_hb is not None else None),
         "goodput": telemetry.goodput(events),
+        "serving": serving_from(events),
         "attempts": attempts_from(events),
         "recovery_events": [e for e in events if e.get("kind") == "recovery"],
     }
@@ -203,6 +252,27 @@ def render(rep: dict) -> str:
         lines.append(f"  {comp:<20} {g[comp]:10.2f}s  "
                      f"{100.0 * g[comp] / wall:6.1f}%")
     lines.append(f"  goodput_frac         {g['goodput_frac']:10.3f}")
+    sv = rep.get("serving")
+    if sv:
+        lines.append("")
+        lines.append("serving"
+                     + (f" ({', '.join(sv['engines'])})"
+                        if sv["engines"] else ""))
+        lines.append(
+            f"  {sv['ok']}/{sv['requests']} requests ok"
+            f"  shed={sv['shed']}  errors={sv['errors']}"
+            + (f"  throughput={sv['requests_per_s']:.1f} req/s"
+               if sv["requests_per_s"] is not None else ""))
+        if sv["latency_p50_s"] is not None:
+            lines.append(
+                f"  latency p50={sv['latency_p50_s'] * 1e3:.1f}ms "
+                f"p99={sv['latency_p99_s'] * 1e3:.1f}ms "
+                f"max={sv['latency_max_s'] * 1e3:.1f}ms"
+                + (f"  queue p50={sv['queue_wait_p50_s'] * 1e3:.1f}ms "
+                   f"p99={sv['queue_wait_p99_s'] * 1e3:.1f}ms"
+                   if sv["queue_wait_p50_s"] is not None else ""))
+        if sv["mean_batch_size"] is not None:
+            lines.append(f"  mean batch size {sv['mean_batch_size']:.1f}")
     if rep["attempts"]:
         lines.append("")
         lines.append("attempts")

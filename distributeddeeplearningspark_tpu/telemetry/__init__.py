@@ -32,6 +32,11 @@ present):
   its last event alone (:mod:`.fleet`).
 - ``collective`` — an opt-in comms probe sample (``op``, ``wait_s``) from
   :mod:`..parallel.collectives`; feeds the fleet table's comms-wait column.
+- ``request`` — one served inference request (:mod:`..serve`): ``engine``,
+  ``outcome`` ("ok"/"shed"/"error"), and for ok ``queue_wait_s``,
+  ``infer_s``, ``latency_s``, ``batch_size``. ``dlstatus`` folds these
+  into the p50/p99 serving rollup; they never enter goodput accounting
+  (serving wall-clock is not training overhead).
 
 Worker-side events additionally carry ``host`` (the process index from the
 ``DLS_*`` env contract via :func:`~..utils.env.process_identity`, plus
@@ -150,13 +155,31 @@ class EventWriter:
         # pop correctly
         self._open_phases: list[str] = []
 
-    def emit(self, kind: str, **fields: Any) -> None:
+    def _record(self, kind: str, fields: dict[str, Any]) -> dict[str, Any]:
         rec = {"ts": self._clock(), "kind": kind, "process": self.process,
                **fields}
         if self.host is not None:
             rec.setdefault("host", self.host)
             if self.hosts > 1:
                 rec.setdefault("hosts", self.hosts)
+        return rec
+
+    def _write_lines(self, lines: list[str]) -> None:
+        """Append + flush under the already-held lock (ONE flush per call
+        — the single write path emit and emit_many share)."""
+        try:
+            if self._f is None:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                self._f = open(self.path, "a")
+            self._f.write("\n".join(lines) + "\n")
+            self._f.flush()
+        except OSError as e:
+            if not self._warned:
+                logger.warning("telemetry disabled (%s): %s", self.path, e)
+                self._warned = True
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        rec = self._record(kind, fields)
         with self._lock:
             if self._closed:
                 # a stale reference held past configure()'s rebind (or any
@@ -180,17 +203,32 @@ class EventWriter:
                 # lives — the field hang localization reads when a host's
                 # last event is a heartbeat
                 rec["phase"] = self._open_phases[-1]
-            line = json.dumps(rec, default=str)
-            try:
-                if self._f is None:
-                    os.makedirs(os.path.dirname(self.path), exist_ok=True)
-                    self._f = open(self.path, "a")
-                self._f.write(line + "\n")
-                self._f.flush()
-            except OSError as e:
-                if not self._warned:
-                    logger.warning("telemetry disabled (%s): %s", self.path, e)
-                    self._warned = True
+            self._write_lines([json.dumps(rec, default=str)])
+
+    def emit_many(self, kind: str, records: "list[dict[str, Any]]") -> None:
+        """Append N same-kind events under ONE lock/flush.
+
+        The serving engine emits one ``request`` event per request in a
+        coalesced batch; flushing per event made telemetry ~45% of the
+        serving hot loop's host time. One flush per *batch* keeps the
+        durability granularity the engine actually has (a crash loses at
+        most the batch that was being reported) at 1/N the cost.
+
+        ``phase``/``heartbeat`` are rejected: those kinds carry the
+        open-phase tracking/enrichment that only :meth:`emit` maintains,
+        and silently skipping it would starve hang localization."""
+        if kind in ("phase", "heartbeat"):
+            raise ValueError(
+                f"emit_many({kind!r}): phase/heartbeat events need emit()'s "
+                f"open-phase tracking — batch-append would skip it")
+        if not records:
+            return
+        with self._lock:
+            if self._closed:
+                return
+            self._write_lines([json.dumps(self._record(kind, fields),
+                                          default=str)
+                               for fields in records])
 
     @contextlib.contextmanager
     def phase(self, name: str, **fields: Any):
@@ -278,6 +316,12 @@ def emit(kind: str, **fields: Any) -> None:
     """Emit through the process-wide writer; no-op when unconfigured."""
     if _writer is not None:
         _writer.emit(kind, **fields)
+
+
+def emit_many(kind: str, records: "list[dict[str, Any]]") -> None:
+    """Batched :func:`emit` through the process-wide writer (one flush)."""
+    if _writer is not None:
+        _writer.emit_many(kind, records)
 
 
 def phase(name: str, **fields: Any):

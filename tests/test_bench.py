@@ -12,7 +12,9 @@ import bench
 
 
 def test_probe_timeout_and_failure_are_contained(monkeypatch):
-    """A hanging probe subprocess is killed at the timeout and logged."""
+    """A hanging probe subprocess is killed at the timeout, logged, and the
+    unavailable verdict is CACHED for the remaining attempts — BENCH_r05
+    burned 3×150 s learning the same hang three times."""
     calls = {"n": 0}
 
     def fake_run(*a, **kw):
@@ -22,8 +24,27 @@ def test_probe_timeout_and_failure_are_contained(monkeypatch):
     monkeypatch.setattr(subprocess, "run", fake_run)
     ok, errors = bench.probe_backend(attempts=3, timeout_s=0.01, backoff_s=0.0)
     assert not ok
-    assert calls["n"] == 3
-    assert len(errors) == 3 and all("hung" in e for e in errors)
+    assert calls["n"] == 1, "a hang must not be retried"
+    assert "hung" in errors[0]
+    assert any("cached" in e and "skipping" in e for e in errors)
+
+
+def test_probe_hang_on_last_attempt_adds_no_cache_note(monkeypatch):
+    """rc-failures retry (they may be flaky inits); a hang on the FINAL
+    attempt has nothing left to skip and says nothing about caching."""
+    calls = {"n": 0}
+
+    def fake_run(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            return subprocess.CompletedProcess(a[0], 1, stdout="",
+                                               stderr="setup error\n")
+        raise subprocess.TimeoutExpired(cmd=a[0], timeout=kw["timeout"])
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    ok, errors = bench.probe_backend(attempts=3, timeout_s=0.01, backoff_s=0.0)
+    assert not ok and calls["n"] == 3
+    assert not any("cached" in e for e in errors)
 
 
 def test_probe_rc_failure_recorded(monkeypatch):

@@ -169,6 +169,80 @@ def test_resume_exhausted_feed_raises(tmp_path):
               data_state={"examples_seen": 64, "batch_size": 16})
 
 
+def test_data_state_roundtrip_preserves_fields(tmp_path):
+    """data_state is a JSON rider on the state step: every field written
+    (examples_seen, batch_size, arbitrary extras) must come back exactly —
+    the fast-forward math below consumes these verbatim."""
+    import optax as _optax
+
+    from distributeddeeplearningspark_tpu.train.state import TrainState
+
+    params = {"w": jnp.float32(1.0)}
+    state = TrainState.create(
+        params=params, opt_state=_optax.sgd(0.1).init(params), mutable={},
+        rng=jax.random.PRNGKey(0))
+    ds = {"examples_seen": 48, "batch_size": 16, "epoch": 2,
+          "source": "synthetic"}
+    with Checkpointer(tmp_path / "ck", async_save=False) as ck:
+        ck.save(3, state, data_state=ds)
+        ck.wait()
+        _, restored = ck.restore(state)
+    assert restored == ds
+
+
+def test_fast_forward_resume_consumes_same_batch_sequence(tmp_path):
+    """Determinism contract of the examples_seen fast-forward: a resumed
+    run's feed must yield exactly the batches the uninterrupted run would
+    have consumed at the same step — Trainer._feed(skip_batches=k) equals
+    the uninterrupted feed with its first k batches dropped, element for
+    element, through the REAL checkpointed data_state round trip."""
+    rng = np.random.default_rng(7)
+    examples = [
+        {"image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+         "label": np.int32(i % 10)}
+        for i in range(96)
+    ]
+    batch_size = 16
+    sess = Session.builder.master("local[2]").getOrCreate()
+    ds = PartitionedDataset.parallelize(examples, 2).repeat()
+    t = Trainer(sess, LeNet5(), losses.softmax_xent, optax.sgd(0.1))
+
+    import itertools
+
+    def take(feed, n):
+        return [
+            {k: np.asarray(jax.device_get(v)) for k, v in b.items()}
+            for b in itertools.islice(feed, n)
+        ]
+
+    uninterrupted = take(t._feed(ds, batch_size), 6)
+
+    # the resume path's own arithmetic: data_state rides a checkpoint,
+    # comes back verbatim, and skip = examples_seen // batch_size (fit())
+    import optax as _optax
+
+    from distributeddeeplearningspark_tpu.train.state import TrainState
+
+    params = {"w": jnp.float32(1.0)}
+    state = TrainState.create(
+        params=params, opt_state=_optax.sgd(0.1).init(params), mutable={},
+        rng=jax.random.PRNGKey(0))
+    with Checkpointer(tmp_path / "ck", async_save=False) as ck:
+        ck.save(3, state, data_state={"examples_seen": 3 * batch_size,
+                                      "batch_size": batch_size})
+        ck.wait()
+        _, data_state = ck.restore(state)
+    skip = int(data_state["examples_seen"]) // int(data_state["batch_size"])
+    assert skip == 3
+
+    resumed = take(t._feed(ds, batch_size, skip_batches=skip), 3)
+    assert len(resumed) == 3
+    for got, want in zip(resumed, uninterrupted[skip:]):
+        assert got.keys() == want.keys()
+        for k in got:
+            np.testing.assert_array_equal(got[k], want[k])
+
+
 def test_manifest_written_and_verified(tmp_path, eight_devices):
     """Every committed step gets an integrity manifest at the next finalize
     point; verify() passes on intact bytes and latest_verified_step tracks."""

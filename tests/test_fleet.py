@@ -465,11 +465,13 @@ def test_bench_probe_timeout_emits_recovery_event(tmp_path, monkeypatch):
 
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
     ok, errors = bench.probe_backend(attempts=2, timeout_s=0.1, backoff_s=0.0)
-    assert not ok and len(errors) == 2
+    # ONE probe-timeout, not two: a full-deadline hang caches the
+    # unavailable verdict for the remaining attempts (ISSUE 4 satellite —
+    # BENCH_r05 burned 3×150 s re-learning the same hang)
+    assert not ok and "hung" in errors[0] and "cached" in errors[1]
     events = telemetry.read_events(str(tmp_path))
     kinds = [(e["kind"], e.get("event")) for e in events]
     assert kinds == [("recovery", "probe-timeout"),
-                     ("recovery", "probe-timeout"),
                      ("recovery", "backend-unavailable")]
     assert all(e["process"] == "bench" and "host" not in e for e in events)
     assert events[-1]["errors"]

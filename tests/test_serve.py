@@ -1,0 +1,491 @@
+"""Serving subsystem (ISSUE 4): dynamic batcher coalescing + bucket reuse,
+load-shed under a full queue, hot-reload mid-traffic with zero dropped
+requests, corrupt-checkpoint reload rejected via manifest verification,
+continuous batched decode, and the dlstatus serving rollup."""
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributeddeeplearningspark_tpu import Checkpointer, faults
+from distributeddeeplearningspark_tpu.serve import (
+    ContinuousGenerator,
+    EngineStoppedError,
+    HotReloader,
+    InferenceEngine,
+    OverloadedError,
+)
+from distributeddeeplearningspark_tpu.serve.engine import default_buckets
+
+
+def _mul_forward(params, batch):
+    return {"y": batch["x"] * params["w"]}
+
+
+def _mk_engine(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 5.0)
+    kw.setdefault("max_queue", 64)
+    return InferenceEngine(_mul_forward, {"w": jnp.float32(1.0)}, **kw)
+
+
+# -- bucket ladder ------------------------------------------------------------
+
+
+def test_default_buckets():
+    assert default_buckets(32) == (1, 2, 4, 8, 16, 32)
+    assert default_buckets(24) == (1, 2, 4, 8, 16, 24)
+    # mesh-shard multiple: every bucket divides evenly over the data shards
+    assert default_buckets(16, multiple_of=4) == (4, 8, 16)
+    assert default_buckets(1) == (1,)
+
+
+# -- coalescing + bucket reuse ------------------------------------------------
+
+
+def test_coalesces_waiting_requests_into_one_batch():
+    """Requests queued before the worker starts dispatch as ONE batch,
+    padded to the covering bucket (not one forward per request)."""
+    eng = _mk_engine(max_batch=16)
+    futs = [eng.submit({"x": np.float32(i)}) for i in range(10)]
+    with eng:
+        res = [f.result(30) for f in futs]
+    for i, r in enumerate(res):
+        assert float(r["y"]) == float(i)
+    st = eng.stats()
+    assert st["batches"] == 1, st
+    assert st["bucket_counts"] == {16: 1}, st  # 10 requests → bucket 16
+
+
+def test_bucket_reuse_no_recompile_per_request():
+    """Steady traffic reuses the compiled bucket set: the jit cache stops
+    growing after each bucket's first hit (the no-recompile contract)."""
+    eng = _mk_engine(max_batch=8, max_wait_ms=1.0)
+    with eng:
+        eng.warmup({"x": np.float32(0)})
+        compiled_after_warmup = eng.stats()["compiled_batch_shapes"]
+        assert compiled_after_warmup == len(eng.batch_sizes)
+        for wave in range(4):  # varying arrival counts — same buckets
+            futs = [eng.submit({"x": np.float32(i)})
+                    for i in range(1 + 2 * wave)]
+            for f in futs:
+                f.result(30)
+        st = eng.stats()
+    assert st["compiled_batch_shapes"] == compiled_after_warmup, st
+    assert st["requests"] == 1 + 3 + 5 + 7
+    assert set(st["bucket_counts"]) <= set(eng.batch_sizes)
+
+
+def test_results_map_back_to_their_requests_across_buckets():
+    rng = np.random.default_rng(0)
+    eng = _mk_engine(max_batch=4, max_wait_ms=2.0, max_queue=512)
+    xs = rng.normal(0, 1, (100,)).astype(np.float32)
+    with eng:
+        futs = [eng.submit({"x": x}) for x in xs]
+        res = [float(f.result(30)["y"]) for f in futs]
+    np.testing.assert_allclose(res, xs, rtol=1e-6)
+
+
+def test_engine_serves_on_a_mesh(eight_devices):
+    """The mesh path: batches are placed with the training feed's batch
+    sharding (put_global) and the bucket ladder rounds to the data-shard
+    count so every bucket divides evenly."""
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+
+    mesh = MeshSpec(data=8).build()
+    eng = InferenceEngine(_mul_forward, {"w": jnp.float32(3.0)}, mesh=mesh,
+                          max_batch=16, max_wait_ms=2.0)
+    assert all(b % 8 == 0 for b in eng.batch_sizes), eng.batch_sizes
+    futs = [eng.submit({"x": np.float32(i)}) for i in range(5)]
+    with eng:
+        res = [float(f.result(30)["y"]) for f in futs]
+    np.testing.assert_allclose(res, [3.0 * i for i in range(5)])
+    with pytest.raises(ValueError, match="data shards"):
+        InferenceEngine(_mul_forward, {"w": jnp.float32(1.0)}, mesh=mesh,
+                        max_batch=12)
+
+
+# -- admission control / load shed --------------------------------------------
+
+
+def test_load_shed_under_full_queue():
+    """The queue bound sheds with the typed rejection, carrying evidence."""
+    eng = _mk_engine(max_queue=4)  # not started: nothing drains
+    for i in range(4):
+        eng.submit({"x": np.float32(i)})
+    with pytest.raises(OverloadedError) as ei:
+        eng.submit({"x": np.float32(99)})
+    assert ei.value.queue_depth == 4 and ei.value.max_queue == 4
+    st = eng.stats()
+    assert st["shed"] == 1 and st["queue_depth"] == 4
+    # the queued 4 still complete once the worker runs
+    with eng:
+        pass  # stop() drains
+    assert st["requests"] == 4
+
+
+def test_stop_without_drain_fails_queued_requests():
+    eng = _mk_engine()
+    fut = eng.submit({"x": np.float32(1)})
+    eng.stop(drain=False)
+    with pytest.raises(EngineStoppedError):
+        fut.result(5)
+    with pytest.raises(EngineStoppedError):
+        eng.submit({"x": np.float32(2)})
+
+
+# -- hot reload ---------------------------------------------------------------
+
+
+def test_swap_params_mid_traffic_zero_dropped():
+    """Params swap between batches: every request completes, and every
+    result is consistent with exactly one of the param versions (no torn
+    batch, no dropped future)."""
+    eng = _mk_engine(max_batch=4, max_wait_ms=1.0, max_queue=4096)
+    n = 200
+    futs = []
+    with eng:
+        for i in range(n):
+            futs.append(eng.submit({"x": np.float32(1.0)}))
+            if i % 20 == 10:
+                eng.swap_params({"w": jnp.float32(float(i))})
+            if i % 7 == 0:
+                time.sleep(0.001)  # let batches interleave with swaps
+        res = [float(f.result(30)["y"]) for f in futs]
+    assert len(res) == n
+    valid = {1.0} | {float(i) for i in range(n) if i % 20 == 10}
+    assert set(res) <= valid, sorted(set(res) - valid)
+    assert eng.stats()["reloads"] == len(valid) - 1
+
+
+class _EngineDouble:
+    def __init__(self):
+        self.swaps = []
+
+    def swap_params(self, params, *, version=None):
+        self.swaps.append((params, version))
+
+
+def _tiny_state(w: float):
+    from distributeddeeplearningspark_tpu.train.state import TrainState
+
+    params = {"w": jnp.float32(w)}
+    return TrainState.create(
+        params=params, opt_state=optax.sgd(0.1).init(params), mutable={},
+        rng=jax.random.PRNGKey(0))
+
+
+def test_hot_reload_corrupt_candidate_rejected_then_recovers(tmp_path):
+    """A torn newest step is rejected via its integrity manifest — the old
+    params keep serving (rollback), the rejection is remembered (no retry
+    loop), and a later intact step reloads normally."""
+    from distributeddeeplearningspark_tpu import telemetry
+
+    wd = tmp_path / "ckpt"
+    telemetry.configure(wd)
+    with Checkpointer(wd, async_save=False) as ck:
+        ck.save(1, _tiny_state(1.0))
+        ck.save(2, _tiny_state(2.0))
+        ck.wait()
+    assert faults.truncate_latest_checkpoint(str(wd))
+
+    eng = _EngineDouble()
+    rel = HotReloader(eng, wd, current_step=1)
+    try:
+        act = rel.poll()
+        assert act == {"step": 2, "action": "rejected",
+                       "reason": act["reason"]}
+        assert "checksum" in act["reason"] or "size" in act["reason"]
+        assert eng.swaps == []           # old params keep serving
+        assert rel.current_step == 1
+        assert rel.poll() is None        # rejection remembered, not retried
+
+        with Checkpointer(wd, async_save=False) as ck:
+            ck.save(3, _tiny_state(3.0))
+            ck.wait()
+        act = rel.poll()
+        assert act["action"] == "reloaded" and act["step"] == 3
+        assert len(eng.swaps) == 1
+        params, version = eng.swaps[0]
+        assert version == 3
+        assert float(np.asarray(params["w"])) == 3.0
+    finally:
+        rel.stop()
+    events = telemetry.read_events(wd)
+    kinds = [(e.get("event"), e.get("step")) for e in events
+             if e.get("kind") == "recovery"]
+    assert ("reload-rejected", 2) in kinds and ("reload", 3) in kinds
+
+
+def test_hot_reload_corrupt_latest_falls_back_to_older_verified(tmp_path):
+    """When the newest unseen step is torn but an OLDER unseen step
+    verifies, the reloader serves the older one instead of nothing."""
+    wd = tmp_path / "ckpt"
+    with Checkpointer(wd, async_save=False) as ck:
+        ck.save(5, _tiny_state(5.0))
+        ck.save(6, _tiny_state(6.0))
+        ck.wait()
+    assert faults.truncate_latest_checkpoint(str(wd))
+    eng = _EngineDouble()
+    rel = HotReloader(eng, wd)  # fresh server: no current step
+    try:
+        act = rel.poll()
+        assert act["action"] == "reloaded" and act["step"] == 5
+        assert act["fell_back_past"] == 6
+        assert [v for _, v in eng.swaps] == [5]
+    finally:
+        rel.stop()
+
+
+def test_hot_reload_watcher_swaps_live_engine(tmp_path):
+    """The background watcher + a real engine: a new verified checkpoint
+    changes served results mid-traffic with zero dropped requests."""
+    wd = tmp_path / "ckpt"
+    with Checkpointer(wd, async_save=False) as ck:
+        ck.save(1, _tiny_state(1.0))
+        ck.wait()
+
+    eng = InferenceEngine(_mul_forward, {"w": jnp.float32(0.0)},
+                          max_batch=4, max_wait_ms=1.0, max_queue=4096)
+    rel = HotReloader(eng, wd, interval_s=0.02)
+    futs = []
+    with eng, rel:
+        deadline = time.monotonic() + 10
+        while eng.params_version != 1 and time.monotonic() < deadline:
+            futs.append(eng.submit({"x": np.float32(1.0)}))
+            time.sleep(0.002)
+        assert eng.params_version == 1, "watcher never reloaded step 1"
+        futs.append(eng.submit({"x": np.float32(1.0)}))
+        res = [float(f.result(30)["y"]) for f in futs]
+    assert set(res) <= {0.0, 1.0}
+    assert res[-1] == 1.0                 # post-reload batch on new params
+    assert len(res) == len(futs)          # zero dropped across the swap
+
+
+def test_restore_params_roundtrip_and_verification(tmp_path):
+    state = _tiny_state(7.0)
+    with Checkpointer(tmp_path / "ck", async_save=False) as ck:
+        ck.save(4, state, data_state={"examples_seen": 8})
+        ck.wait()
+        params, step = ck.restore_params()
+        assert step == 4
+        np.testing.assert_array_equal(np.asarray(params["w"]),
+                                      np.asarray(state.params["w"]))
+        faults.truncate_latest_checkpoint(str(tmp_path / "ck"))
+        from distributeddeeplearningspark_tpu.checkpoint import RestoreError
+
+        with pytest.raises(RestoreError):
+            ck.restore_params(step=4)
+
+
+# -- continuous batched decode ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def nano_llama():
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=128,
+                      max_position=64, dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 128, (n,)).astype(np.int32)
+               for n in (5, 7, 6, 4)]
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": prompts[0][None]},
+                        train=False)["params"]
+
+    def ref_rollout(prompt, n):
+        """Greedy full-recompute reference (no KV cache at all)."""
+        ids = prompt[None, :]
+        out = []
+        for _ in range(n):
+            lg = model.apply({"params": params}, {"input_ids": ids},
+                             train=False)
+            nxt = np.argmax(np.asarray(lg[0, -1])).astype(np.int32)
+            out.append(int(nxt))
+            ids = np.concatenate([ids, [[nxt]]], axis=1)
+        return np.asarray(out, np.int32)
+
+    return cfg, params, prompts, ref_rollout
+
+
+def test_continuous_decode_matches_reference_and_joins_midflight(nano_llama):
+    """4 requests over 2 KV slots: every output matches the full-recompute
+    rollout (so slot admission at differing positions is numerically
+    clean), admissions exceed the pool (join-mid-flight), and tokens
+    stream in order as they are sampled."""
+    cfg, params, prompts, ref = nano_llama
+    streamed: list[int] = []
+    gen = ContinuousGenerator(cfg, params, slots=2, max_cache_len=32,
+                              prompt_buckets=(8, 16))
+    with gen:
+        futs = [gen.submit(p, 6,
+                           stream=(streamed.append if i == 0 else None))
+                for i, p in enumerate(prompts)]
+        res = [f.result(300) for f in futs]
+    for p, r in zip(prompts, res):
+        np.testing.assert_array_equal(r, ref(p, 6))
+    assert streamed == list(res[0])
+    st = gen.stats()
+    assert st["completed"] == 4 and st["admitted"] == 4
+    assert st["max_active"] == 2          # the pool really ran full
+    assert st["queue_depth"] == 0 and st["active"] == 0
+
+
+def test_continuous_decode_prompt_buckets_bound_prefill_compiles(nano_llama):
+    """Prompts of different lengths share prefill programs per bucket."""
+    cfg, params, prompts, ref = nano_llama
+    gen = ContinuousGenerator(cfg, params, slots=2, max_cache_len=32,
+                              prompt_buckets=(8,))
+    with gen:
+        for p in prompts:                 # lengths 4..7 → all bucket 8
+            np.testing.assert_array_equal(gen.generate(p, 3), ref(p, 3))
+    assert gen._prefill._cache_size() == 1
+
+
+def test_continuous_decode_swap_params_midflight(nano_llama):
+    """A params swap mid-sequence completes every request (tokens after
+    the swap come from the new tree — nothing drops or restarts)."""
+    cfg, params, prompts, _ = nano_llama
+    gen = ContinuousGenerator(cfg, params, slots=2, max_cache_len=64)
+    seen = threading.Event()
+
+    def on_tok(_):
+        seen.set()
+
+    with gen:
+        fut = gen.submit(prompts[0], 24, stream=on_tok)
+        assert seen.wait(120), "no token streamed"
+        gen.swap_params(jax.tree.map(lambda x: x * 1.01, params))
+        out = fut.result(300)
+    assert out.shape == (24,)
+    assert gen.stats()["reloads"] == 1 and gen.params_version == 1
+
+
+def test_generator_rejects_oversized_and_sheds(nano_llama):
+    cfg, params, prompts, _ = nano_llama
+    gen = ContinuousGenerator(cfg, params, slots=2, max_cache_len=16,
+                              prompt_buckets=(8,), max_queue=1)
+    with pytest.raises(ValueError, match="max_cache_len"):
+        gen.submit(prompts[0], 16)
+    with pytest.raises(ValueError, match="prompt bucket"):
+        gen.submit(np.arange(9, dtype=np.int32), 2)
+    gen.submit(prompts[0], 2)            # queued (not started)
+    with pytest.raises(OverloadedError):
+        gen.submit(prompts[1], 2)
+    gen.stop(drain=False)
+
+
+def test_generator_eos_frees_slot_early(nano_llama):
+    """eos mid-sequence completes the request (eos token included) before
+    max_new_tokens, freeing the slot for the queue."""
+    cfg, params, prompts, ref = nano_llama
+    full = ref(prompts[0], 8)
+    # first token value whose FIRST occurrence is past position 0 — using
+    # it as eos must stop the rollout exactly there (eos token included)
+    cut = next(i for i in range(1, len(full)) if full[i] not in full[:i])
+    eos = int(full[cut])
+    gen = ContinuousGenerator(cfg, params, slots=1, max_cache_len=32,
+                              eos_id=eos)
+    with gen:
+        out = gen.generate(prompts[0], 8)
+    np.testing.assert_array_equal(out, full[:cut + 1])
+
+
+# -- telemetry + dlstatus rollup ----------------------------------------------
+
+
+def test_emit_many_single_flush_stream(tmp_path):
+    from distributeddeeplearningspark_tpu import telemetry
+
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=lambda: 100.0,
+                              host=None)
+    w.emit_many("request", [dict(id=i, outcome="ok", latency_s=0.01 * i)
+                            for i in range(5)])
+    w.emit_many("request", [])           # no-op, no crash
+    w.close()
+    events = telemetry.read_events(tmp_path)
+    assert len(events) == 5
+    assert all(e["kind"] == "request" and e["ts"] == 100.0 for e in events)
+    assert [e["id"] for e in events] == list(range(5))
+
+
+def test_dlstatus_serving_rollup(tmp_path, capsys):
+    from distributeddeeplearningspark_tpu import status, telemetry
+
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=lambda: 0.0,
+                              host=None)
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.5
+        return t[0]
+
+    w._clock = clock
+    lat = [0.010, 0.020, 0.030, 0.040, 0.100]
+    w.emit_many("request", [
+        dict(engine="lenet", id=i, outcome="ok", latency_s=v,
+             queue_wait_s=v / 2, infer_s=v / 2, batch_size=4)
+        for i, v in enumerate(lat)])
+    w.emit("request", engine="lenet", id=99, outcome="shed", queue_depth=64)
+    w.emit("request", engine="lenet", id=98, outcome="error", batch_size=2)
+    w.close()
+
+    rep = status.report(str(tmp_path))
+    sv = rep["serving"]
+    assert sv["requests"] == 7 and sv["ok"] == 5
+    assert sv["shed"] == 1 and sv["errors"] == 1
+    assert sv["engines"] == ["lenet"]
+    assert sv["latency_p50_s"] == 0.030
+    assert sv["latency_p99_s"] == 0.100 and sv["latency_max_s"] == 0.100
+    assert sv["mean_batch_size"] == 4.0
+    assert sv["requests_per_s"] > 0
+
+    assert status.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "serving (lenet)" in out and "p99=100.0ms" in out
+    assert status.main([str(tmp_path), "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["serving"]["shed"] == 1
+
+
+def test_dlstatus_no_requests_serving_is_none(tmp_path):
+    from distributeddeeplearningspark_tpu import status, telemetry
+
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=lambda: 1.0,
+                              host=None)
+    w.heartbeat(step=0)
+    w.close()
+    assert status.report(str(tmp_path))["serving"] is None
+
+
+# -- dlserve CLI --------------------------------------------------------------
+
+
+def test_dlserve_cli_flag_validation():
+    from distributeddeeplearningspark_tpu.serve import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main(["--watch"])            # --watch needs --checkpoint-dir
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        cli.build_parser().parse_args(["--model", "nope"])
+    assert e.value.code == 2
+
+
+def test_engine_rejects_bad_config():
+    with pytest.raises(ValueError, match="max_batch"):
+        _mk_engine(max_batch=0)
+    with pytest.raises(ValueError, match="smaller than"):
+        _mk_engine(max_batch=8, batch_sizes=(2, 4))

@@ -13,7 +13,9 @@
 set -u -o pipefail  # pipefail: the tier's rc must be pytest's, not tail's
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
-export PYTHONPATH="/root/.axon_site:${PYTHONPATH:-}"
+# repo root on PYTHONPATH: the driver-script smokes (`python examples/...`)
+# import the package from the source tree, not an installed wheel
+export PYTHONPATH="/root/.axon_site:$(pwd):${PYTHONPATH:-}"
 
 log() {  # tier, summary-tail, exit-code, seconds
   printf '| %s | %s | %s | rc=%s | %ss |\n' \
@@ -101,6 +103,34 @@ print("culprit=host%s phase=%s hb_age=%.1fs"
   return $rc
 }
 
+# serve smoke (ISSUE 4 satellite): train a few LeNet steps, serve them with
+# the dynamic-batching engine under concurrent clients, hot-reload a newer
+# checkpoint mid-traffic — batched throughput must beat the single-request
+# engine, with zero shed requests and at least one hot reload.
+run_serve_smoke() {
+  local t0 rc out
+  t0=$(date +%s)
+  rc=0
+  out=$(JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python examples/serve_mnist.py --steps 6 --clients 16 --requests 4 \
+          2>/dev/null \
+        | python -c '
+import json, sys
+r = json.loads(sys.stdin.readlines()[-1])
+e = r["extra"]
+assert r["value"] > e["sequential_requests_per_sec"], (
+    "batched throughput did not beat sequential", r)
+assert e["requests_shed"] == 0 and e["hot_reloads"] >= 1, r
+print("rps=%s seq=%s speedup=%s reloads=%s p50=%sms"
+      % (r["value"], e["sequential_requests_per_sec"],
+         e["batching_speedup"], e["hot_reloads"], e["latency_p50_ms"]))
+') || rc=$?
+  log serve "${out:-serve smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[serve] ${out:-FAILED} (rc=${rc})"
+  return $rc
+}
+
 overall=0
 case "${1:-both}" in
   fast) run_tier fast "not slow" || overall=$? ;;
@@ -116,10 +146,12 @@ case "${1:-both}" in
   # pod-level fleet view: bundled 3-host hang fixture through
   # `dlstatus --hosts` (stalled host named, nonzero heartbeat age)
   hosts) run_hosts_smoke || overall=$? ;;
+  # serving: train→serve→hot-reload end-to-end on CPU LeNet (docs/SERVING.md)
+  serve) run_serve_smoke || overall=$? ;;
   # the executable pod-day scripts, logged with the same audit trail
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
