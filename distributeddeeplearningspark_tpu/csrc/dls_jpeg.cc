@@ -17,6 +17,7 @@
 #include <atomic>
 #include <cmath>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -441,6 +442,12 @@ void dls_jpeg_decode_batch(const uint8_t* const* datas, const int64_t* lens,
                            int n, int* rcs) {
   unsigned hc = std::thread::hardware_concurrency();
   int nt = static_cast<int>(hc ? (hc < 16u ? hc : 16u) : 4u);
+  // same cap as dls_native's default_threads: forked pipeline workers set
+  // DLS_NATIVE_THREADS=1 so N processes don't fan out N×cores threads
+  if (const char* env = std::getenv("DLS_NATIVE_THREADS")) {
+    int v = std::atoi(env);
+    if (v > 0 && v < nt) nt = v;
+  }
   if (nt > n) nt = n;
   if (nt <= 1) {
     for (int i = 0; i < n; ++i)

@@ -21,6 +21,7 @@
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <mutex>
@@ -31,7 +32,17 @@ namespace {
 
 int default_threads() {
   unsigned hc = std::thread::hardware_concurrency();
-  return hc ? static_cast<int>(std::min(hc, 16u)) : 4;
+  int nt = hc ? static_cast<int>(std::min(hc, 16u)) : 4;
+  // DLS_NATIVE_THREADS caps per-call fan-out DOWNWARD only (same semantics
+  // as dls_jpeg.cc; read per call, not cached: a forked input-pipeline
+  // worker sets it to 1 AFTER the fork so N worker processes don't each
+  // spawn hardware_concurrency threads — N×HC runnable threads on HC cores
+  // measured ~35% slower than N×1 on the 2-core CI box).
+  if (const char* env = std::getenv("DLS_NATIVE_THREADS")) {
+    int v = std::atoi(env);
+    if (v > 0 && v < nt) nt = v;
+  }
+  return nt;
 }
 
 // Parallel-for over [0, n): per-call thread spawn with dynamic (atomic)

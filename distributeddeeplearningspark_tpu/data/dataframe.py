@@ -17,7 +17,9 @@ rebuilds that surface TPU-first:
   partition ≙ one data shard, same as the RDD plane.
 - **No shuffle engine** (SURVEY.md §7 "What NOT to build"): joins remain
   out of scope, and ``groupBy(...).agg(...)`` exists WITHOUT one — chunk
-  partials merge in a driver dict (vocab-sized results), the same honest
+  partials merge in a driver dict (vocab-sized results; enforced by the
+  ``max_groups`` ceiling, which refuses high-cardinality keys with the
+  ``hash_bucket`` remediation), the same honest
   narrow-engine stance as ``rdd.reduce_by_key``. The Criteo feature
   pipeline — typed read, fillna, log-scaling, categorical hashing,
   count-features, split — is fully covered.
@@ -477,15 +479,31 @@ class GroupedData:
         out = self.agg({self._keys[0]: "count"})
         return out.withColumnRenamed(f"count({self._keys[0]})", "count")
 
-    def agg(self, spec: Mapping[str, str]) -> DataFrame:
+    def agg(self, spec: Mapping[str, str], *,
+            max_groups: int | None = None) -> DataFrame:
         """``{"col": "sum"|"mean"|"min"|"max"|"count"}`` → one row per
         distinct key tuple, pyspark-style ``fn(col)`` output names.
 
         Lazy like every other verb (the module's contract): the source
         scan runs on the output's first iteration, memoized cache()-style
         after that.
+
+        ``max_groups`` (default ``DLS_AGG_MAX_GROUPS`` or 1_000_000): the
+        distinct-key ceiling. Chunk partials merge in a DRIVER-SIDE dict
+        (SURVEY §7: no shuffle service) — fine for the vocab-sized results
+        this plane is documented for (Criteo's 26 categorical
+        vocabularies), but a user-id-like key would silently grow an
+        unbounded dict; past the ceiling the scan refuses loudly with the
+        ``hash_bucket`` remediation instead (VERDICT r5 weak-#7).
         """
         keys, df = self._keys, self._df
+        if max_groups is None:
+            import os
+
+            max_groups = int(os.environ.get("DLS_AGG_MAX_GROUPS", "")
+                             or 1_000_000)
+        if max_groups < 1:
+            raise ValueError(f"max_groups must be >= 1, got {max_groups}")
         bad = {c: f for c, f in spec.items()
                if f not in _AGG_FNS or c not in df.columns}
         if bad or not spec:
@@ -558,6 +576,18 @@ class GroupedData:
             for ch in df._iter_chunks():
                 for key, (cnt, per_col) in partial(ch).items():
                     if key not in acc:
+                        if len(acc) >= max_groups:
+                            raise ValueError(
+                                f"groupBy({keys}).agg() exceeded max_groups="
+                                f"{max_groups} distinct keys — the partials "
+                                f"merge in a driver-side dict sized for "
+                                f"vocab-scale results, and this key looks "
+                                f"high-cardinality (user-id-like). "
+                                f"hash_bucket(col({keys[0]!r}), num_buckets) "
+                                f"the key first to bound the result, or "
+                                f"raise max_groups= / DLS_AGG_MAX_GROUPS if "
+                                f"the grouped result genuinely fits the "
+                                f"driver")
                         acc[key] = [cnt, dict(per_col)]
                     else:
                         acc[key][0] += cnt

@@ -100,8 +100,20 @@ def host_batches(
     drop_remainder: bool = True,
     shard_range: tuple[int, int] | None = None,
     pad_remainder: bool = False,
+    num_workers: int | None = None,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Yield stacked host batches from an RDD of example dicts.
+
+    ``num_workers`` overrides the worker-process count of a pool-backed
+    dataset (:class:`~.workers.WorkerMappedDataset`, e.g. from
+    ``imagenet_train(num_workers=...)``): the per-example map fans out over
+    that many processes with shared-memory delivery, and ``stack_examples``
+    stacks the ring views straight into the batch. ``None`` keeps the
+    dataset's own setting (ultimately ``DLS_DATA_WORKERS``); 0 forces the
+    in-process path. The batch stream is byte-identical either way —
+    ordered delivery is part of the pool contract — so this knob is pure
+    throughput. On a dataset without a pool spec it is ignored (there is
+    no map to fan out).
 
     ``shard_range=(lo, hi)`` restricts output to data shards [lo, hi) — the
     multi-process mode: each host STACKS only the rows its own devices will
@@ -117,6 +129,8 @@ def host_batches(
     the sub-shard tail (see :func:`_pad_to_shards`) — including in
     multi-process mode, where the tail was previously dropped whole.
     """
+    if num_workers is not None and hasattr(dataset, "with_num_workers"):
+        dataset = dataset.with_num_workers(num_workers)
     n_parts = dataset.num_partitions
     lo, hi = shard_range if shard_range is not None else (0, num_shards)
 
@@ -210,7 +224,14 @@ def host_batches(
             if shard_range is not None:
                 assert per_shard is not None
                 chunk = chunk[lo * per_shard:hi * per_shard]
-            yield checked(stack_examples(chunk))
+            out = checked(stack_examples(chunk))
+            # release the example refs BEFORE the next islice refill: a
+            # worker-pool dataset's examples are views into the shared-
+            # memory ring (data/workers.py), and holding a full batch of
+            # them across the refill would make the ring carry 2× the
+            # batch bytes and stall on backpressure
+            chunk.clear()
+            yield out
 
 
 def put_global(
@@ -246,17 +267,20 @@ def device_batches(
     *,
     drop_remainder: bool = True,
     probe=None,
+    num_workers: int | None = None,
 ) -> Iterator[dict[str, jax.Array]]:
     """host_batches → sharded device arrays (no prefetch; see prefetch.py).
 
     ``probe`` (a :class:`~.prefetch.StarvationProbe`) times each host-batch
     assembly — on this unbuffered path every assembly blocks the consumer,
     so the same wait the prefetch ring would hide is measured directly.
+    ``num_workers`` passes through to :func:`host_batches` (worker-pool
+    override for pool-backed datasets).
     """
     nshards = num_data_shards(mesh)
     hb: Iterator[dict[str, np.ndarray]] = host_batches(
         dataset, batch_size, num_shards=nshards, drop_remainder=drop_remainder,
-        shard_range=process_shard_range(nshards),
+        shard_range=process_shard_range(nshards), num_workers=num_workers,
     )
     if probe is not None:
         hb = probe.timed(hb)

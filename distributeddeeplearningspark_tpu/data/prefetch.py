@@ -100,7 +100,17 @@ class StarvationProbe:
             yield x
 
     def snapshot(self, *, reset: bool = True) -> dict[str, float]:
-        """Gauges since the last snapshot, keyed for the telemetry record."""
+        """Gauges since the last snapshot, keyed for the telemetry record.
+
+        When a :mod:`~distributeddeeplearningspark_tpu.data.workers` pool is
+        live, the per-worker utilization/queue-depth rollup rides along
+        (``input_workers``, ``worker_util_mean/min``, ``worker_items``,
+        ``worker_overflow``, ``worker_ahead_mean``, ``worker_ring_used_mb``)
+        so ``dlstatus`` can tell pool-bound (util ≈ 1 while the consumer
+        still waits) from consumer-bound (util low, waits low) input.
+        Worker utilizations are pool-lifetime fractions (pools restart per
+        epoch); the wait/assembly keys stay per-lap as before.
+        """
         with self._lock:
             out = {
                 "input_wait_s": self._wait_s,
@@ -113,7 +123,13 @@ class StarvationProbe:
                 out["prefetch_depth_min"] = self._depth_min
             if reset:
                 self._zero()
-            return out
+        try:
+            from distributeddeeplearningspark_tpu.data import workers
+
+            out.update(workers.pool_gauges())
+        except Exception:  # noqa: BLE001 — gauges must never fail a lap
+            pass
+        return out
 
 
 def prefetch_to_device(

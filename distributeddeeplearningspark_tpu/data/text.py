@@ -172,8 +172,18 @@ def packed_segments_from_docs(
     the final [SEP] its last; padding (tail window only) gets id -1 so real
     tokens never attend to pad positions even without a padding mask.
     """
-    stream = (tokenizer.encode(doc) for doc in docs)
-    for chunk, cseg, partial in _pack_token_windows(stream, seq_len - 2):
+    return packed_segments_from_tokens(
+        (tokenizer.encode(doc) for doc in docs), tokenizer, seq_len)
+
+
+def packed_segments_from_tokens(
+    doc_tokens: Iterable, tokenizer: WordPieceTokenizer, seq_len: int
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """:func:`packed_segments_from_docs` over PRE-tokenized documents — the
+    split that lets the tokenize stage (the expensive per-doc map) run in
+    the :mod:`.workers` process pool while the stateful cross-document
+    packing stays on the consumer. Accepts lists or int arrays per doc."""
+    for chunk, cseg, partial in _pack_token_windows(doc_tokens, seq_len - 2):
         ids = [tokenizer.cls_id, *chunk, tokenizer.sep_id]
         sids = [cseg[0], *cseg, cseg[-1]]
         if partial:
@@ -193,9 +203,18 @@ def padded_segments_from_docs(
     average far under 512 tokens, so most of each window is [PAD] and the
     naive tokens/sec number is mostly padding throughput.
     """
+    return _padded_from_tokens(
+        (tokenizer.encode(doc) for doc in docs), tokenizer, seq_len)
+
+
+def _padded_from_tokens(
+    doc_tokens: Iterable, tokenizer: WordPieceTokenizer, seq_len: int
+) -> Iterator[np.ndarray]:
+    """Padded-window framing over pre-tokenized docs (lists or int arrays)
+    — the tokenize/frame split that lets the worker pool own the encode."""
     budget = seq_len - 2
-    for doc in docs:
-        toks = tokenizer.encode(doc)
+    for toks in doc_tokens:
+        toks = list(toks)
         if not toks:
             continue
         for off in range(0, len(toks), budget):
@@ -203,6 +222,19 @@ def padded_segments_from_docs(
             ids = [tokenizer.cls_id, *chunk, tokenizer.sep_id]
             ids += [tokenizer.pad_id] * (seq_len - len(ids))
             yield np.array(ids, np.int32)
+
+
+def _tokens_dataset(docs: PartitionedDataset, tok_fn, num_workers: int | None,
+                    *, label: str) -> PartitionedDataset:
+    """Per-doc tokenize as a dataset stage: pooled over worker processes
+    when ``num_workers`` (or ``DLS_DATA_WORKERS``) asks for it, the plain
+    in-process ``map`` otherwise — same token stream either way."""
+    from distributeddeeplearningspark_tpu.data import workers as workers_lib
+
+    if workers_lib.resolve_num_workers(num_workers) > 0:
+        return workers_lib.WorkerMappedDataset(docs, tok_fn, num_workers,
+                                               label=label)
+    return docs.map(tok_fn)
 
 
 def mask_tokens(
@@ -281,6 +313,7 @@ def mlm_dataset(
     max_predictions: int | None = None,
     segment_ids: bool = False,
     pack: bool = True,
+    num_workers: int | None = None,
 ) -> PartitionedDataset:
     """Text RDD → MLM example RDD (tokenize → pack → mask, per partition).
 
@@ -293,6 +326,10 @@ def mlm_dataset(
     RoBERTa FULL-SENTENCES convention (documents share the window).
     ``pack=False``: one padded document per window — the reference-era
     shape, kept for the padding-waste A/B (see ``token_stats``).
+    ``num_workers`` (default ``DLS_DATA_WORKERS``): tokenize — the per-doc
+    hot loop — across worker processes (:mod:`.workers`); the stateful
+    window packing and the per-partition-seeded masking stay on the
+    consumer, so the example stream is byte-identical for any count.
     """
 
     if not pack and segment_ids:
@@ -300,17 +337,20 @@ def mlm_dataset(
             "segment_ids=True requires pack=True (padded mode has one "
             "document per window — there are no boundaries to mark)")
 
-    def per_partition(pidx: int, lines: Iterable[str]) -> Iterator[dict]:
+    token_ds = _tokens_dataset(
+        docs, lambda doc: np.asarray(tokenizer.encode(doc), np.int32),
+        num_workers, label="mlm_tokenize")
+
+    def per_partition(pidx: int, toks: Iterable[np.ndarray]) -> Iterator[dict]:
         rng = np.random.default_rng(seed * 100003 + pidx)
         if not pack:
             gen: Iterator = (
                 (ids, None)
-                for ids in padded_segments_from_docs(lines, tokenizer, seq_len))
-        elif segment_ids:
-            gen = packed_segments_from_docs(lines, tokenizer, seq_len)
+                for ids in _padded_from_tokens(toks, tokenizer, seq_len))
         else:
-            gen = ((ids, None)
-                   for ids in segments_from_docs(lines, tokenizer, seq_len))
+            gen = packed_segments_from_tokens(toks, tokenizer, seq_len)
+            if not segment_ids:
+                gen = ((ids, None) for ids, _ in gen)
         for seg, sids in gen:
             ex = mask_tokens(seg, tokenizer, rng, mask_prob=mask_prob)
             if sids is not None:
@@ -318,7 +358,7 @@ def mlm_dataset(
             yield (pack_mlm_predictions(ex, max_predictions)
                    if max_predictions else ex)
 
-    return docs.map_partitions_with_index(per_partition)
+    return token_ds.map_partitions_with_index(per_partition)
 
 
 def token_stats(dataset: PartitionedDataset, *, max_examples: int = 10_000) -> dict:
@@ -387,6 +427,7 @@ def lm_dataset(
     seq_len: int = 512,
     eos_between_docs: bool = True,
     segment_ids: bool = False,
+    num_workers: int | None = None,
 ) -> PartitionedDataset:
     """Text RDD → packed causal-LM blocks (config 5's fine-tune feed).
 
@@ -401,14 +442,19 @@ def lm_dataset(
     attention is blocked across packed-document boundaries — the model
     consumes ``batch["segment_ids"]`` through the flash kernel / ring
     (GPT-style packing without it is also standard; measure both).
+    ``num_workers``: tokenize across worker processes, packing stays on
+    the consumer — byte-identical stream for any count (see
+    :func:`mlm_dataset`).
     """
+    token_ds = _tokens_dataset(
+        docs,
+        lambda doc: np.asarray(
+            tokenizer.encode(doc)
+            + ([tokenizer.sep_id] if eos_between_docs else []), np.int32),
+        num_workers, label="lm_tokenize")
 
-    def per_partition(pidx: int, lines: Iterable[str]) -> Iterator[dict]:
+    def per_partition(pidx: int, stream: Iterable[np.ndarray]) -> Iterator[dict]:
         del pidx
-        stream = (
-            tokenizer.encode(doc) + ([tokenizer.sep_id] if eos_between_docs
-                                     else [])
-            for doc in lines)
         for chunk, cseg, partial in _pack_token_windows(stream, seq_len):
             if partial and len(chunk) <= 1:
                 continue  # a lone token has no next-token target
@@ -423,7 +469,7 @@ def lm_dataset(
                 ex["segment_ids"] = np.array(sids, np.int32)
             yield ex
 
-    return docs.map_partitions_with_index(per_partition)
+    return token_ds.map_partitions_with_index(per_partition)
 
 
 def synthetic_wikipedia(

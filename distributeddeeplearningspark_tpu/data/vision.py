@@ -160,6 +160,30 @@ def decode_jpeg(path_or_bytes) -> np.ndarray:
             "no JPEG decoder available (native build failed and PIL absent)") from e
 
 
+def _augment_decision(img: np.ndarray, seed: int, size: int
+                      ) -> tuple[tuple[int, int, int, int] | None, bool]:
+    """THE content-seeded crop/flip decision → ``(region, flip)``.
+
+    One copy of the rng-stream contract shared by :func:`train_transform`'s
+    uint8 paths, :func:`_fused_example_transform` (worker pool) and
+    ``imagenet_train_batched``'s fused batch — the byte-parity between the
+    in-process and worker-pool feeds (and checkpoint fast-forward resume
+    with it) depends on every path drawing the same stream: an already-
+    ``size``-sized frame consumes NO region draw (region is the full
+    frame), then ONE flip draw; otherwise the 10-draw crop sampler runs
+    first. ``region`` is None when the sampler gave up — callers fall back
+    to a center crop.
+    """
+    rng = np.random.default_rng(
+        (seed * 2654435761 + _content_seed(img)) & 0xFFFFFFFF)
+    h, w = img.shape[:2]
+    if h == w == size:
+        region: tuple[int, int, int, int] | None = (0, 0, h, w)
+    else:
+        region = sample_crop_region(h, w, rng)
+    return region, bool(rng.random() < 0.5)
+
+
 def train_transform(size: int = 224, seed: int = 0) -> Callable[[dict], dict]:
     """Per-example ImageNet train augmentation: crop + flip + normalize.
 
@@ -172,16 +196,15 @@ def train_transform(size: int = 224, seed: int = 0) -> Callable[[dict], dict]:
     def apply(example: dict) -> dict:
         example = _decode_if_bytes(example)
         img = example["image"]
-        rng = np.random.default_rng((seed * 2654435761 + _content_seed(img)) & 0xFFFFFFFF)
-        needs_crop = img.shape[0] != size or img.shape[1] != size
         if img.dtype == np.uint8:
-            if not needs_crop:
+            region, flip = _augment_decision(img, seed, size)
+            if img.shape[0] == img.shape[1] == size:
                 # fused flip+normalize in one native pass (numpy fallback)
                 from distributeddeeplearningspark_tpu.utils import native
 
                 img = native.crop_flip_normalize_batch(
                     img[None], np.zeros(1, np.int32), np.zeros(1, np.int32),
-                    np.array([rng.random() < 0.5], np.uint8), (size, size),
+                    np.array([flip], np.uint8), (size, size),
                     IMAGENET_MEAN, IMAGENET_STD,
                 )[0]
                 return {**example, "image": img}
@@ -191,8 +214,6 @@ def train_transform(size: int = 224, seed: int = 0) -> Callable[[dict], dict]:
             # same crop and agree to fp tolerance.
             from distributeddeeplearningspark_tpu.utils import native
 
-            region = sample_crop_region(img.shape[0], img.shape[1], rng)
-            flip = bool(rng.random() < 0.5)
             fused = (
                 native.rrc_flip_normalize(
                     img, region, flip, (size, size), IMAGENET_MEAN, IMAGENET_STD)
@@ -207,7 +228,9 @@ def train_transform(size: int = 224, seed: int = 0) -> Callable[[dict], dict]:
                 img = center_crop(img.astype(np.float32) / 255.0, size)
             img = normalize(img[:, ::-1] if flip else img)
         else:
-            if needs_crop:
+            rng = np.random.default_rng(
+                (seed * 2654435761 + _content_seed(img)) & 0xFFFFFFFF)
+            if img.shape[0] != size or img.shape[1] != size:
                 img = random_resized_crop(img, rng, size)
             img = random_flip(img, rng)
         return {**example, "image": np.ascontiguousarray(img, np.float32)}
@@ -270,7 +293,8 @@ def eval_transform(size: int = 224) -> Callable[[dict], dict]:
 
 def imagenet_train(dataset: PartitionedDataset, *, size: int = 224, seed: int = 0,
                    num_threads: int | None = None,
-                   repeat: bool = False) -> PartitionedDataset:
+                   repeat: bool = False,
+                   num_workers: int | None = None) -> PartitionedDataset:
     """RDD-shaped pipeline: shuffle → (repeat) → decode+augment.
 
     Feed it ``imagenet_folder(root, decode=False)`` so JPEG decode happens
@@ -283,16 +307,67 @@ def imagenet_train(dataset: PartitionedDataset, *, size: int = 224, seed: int = 
     ``repeat=True`` makes the stream infinite HERE — shuffle must precede
     repeat, and repeating before the parallel map keeps one thread pool
     alive across epochs instead of respawning per pass.
+
+    ``num_workers`` (default ``DLS_DATA_WORKERS``, 0 = off): run the
+    decode/augment map across worker *processes* instead of threads —
+    :class:`~.workers.WorkerMappedDataset`, real cores with no GIL and
+    shared-memory delivery. The batch stream is byte-identical for any
+    worker count (content-seeded augmentation + ordered delivery), so
+    checkpoint fast-forward resume is unaffected. When enabled it replaces
+    the thread pool (``num_threads`` is ignored) — process×thread pools
+    would oversubscribe the host.
     """
+    from distributeddeeplearningspark_tpu.data import workers as workers_lib
+
     ds = dataset.shuffle(seed)
     if repeat:
         ds = ds.repeat()
-    return ds.map_parallel(train_transform(size, seed), num_threads=num_threads)
+    tf = train_transform(size, seed)
+    if workers_lib.resolve_num_workers(num_workers) > 0:
+        return workers_lib.WorkerMappedDataset(ds, tf, num_workers,
+                                               label="imagenet_train")
+    return ds.map_parallel(tf, num_threads=num_threads)
 
 
 def imagenet_eval(dataset: PartitionedDataset, *, size: int = 224,
-                  num_threads: int | None = None) -> PartitionedDataset:
+                  num_threads: int | None = None,
+                  num_workers: int | None = None) -> PartitionedDataset:
+    from distributeddeeplearningspark_tpu.data import workers as workers_lib
+
+    if workers_lib.resolve_num_workers(num_workers) > 0:
+        return workers_lib.WorkerMappedDataset(
+            dataset, eval_transform(size), num_workers, label="imagenet_eval")
     return dataset.map_parallel(eval_transform(size), num_threads=num_threads)
+
+
+def _fused_example_transform(size: int, seed: int) -> Callable[[dict], dict]:
+    """Per-example twin of :func:`imagenet_train_batched`'s fused batch call.
+
+    Exactly the varbatch kernel's per-image math (csrc/dls_native.cc shares
+    the float expressions between ``dls_rrc_flip_normalize`` and its
+    varbatch loop) with exactly ``_fused_batch``'s decision logic — crop
+    region/flip drawn from the same content-seeded rng, same fallbacks —
+    so the worker-pool path of the batched feed is byte-identical to the
+    in-process path for any ``num_workers``.
+    """
+    tf_fallback = train_transform(size, seed)
+
+    def one(ex: dict) -> dict:
+        from distributeddeeplearningspark_tpu.utils import native
+
+        img = ex.get("image")
+        if (native.available() and isinstance(img, np.ndarray)
+                and img.dtype == np.uint8 and img.ndim == 3):
+            region, flip = _augment_decision(img, seed, size)
+            if region is not None:
+                fused = native.rrc_flip_normalize(
+                    img, region, flip, (size, size),
+                    IMAGENET_MEAN, IMAGENET_STD)
+                if fused is not None:
+                    return {**ex, "image": fused}
+        return {**ex, "image": tf_fallback(dict(ex))["image"]}
+
+    return one
 
 
 def imagenet_train_batched(
@@ -302,6 +377,7 @@ def imagenet_train_batched(
     size: int = 224,
     seed: int = 0,
     drop_remainder: bool = True,
+    num_workers: int | None = None,
 ):
     """Record-path fast feed: yield READY train batches with whole-batch
     fused native augmentation.
@@ -319,9 +395,45 @@ def imagenet_train_batched(
     Yields ``{"image": [B, size, size, 3] f32, "label": [B] i32}``; falls
     back to the per-example chain when the native library is unavailable
     or an image is pre-float. Shuffle/repeat the dataset BEFORE this feed.
+
+    ``num_workers`` (default ``DLS_DATA_WORKERS``, 0 = off): the
+    per-example fused augment runs across worker processes
+    (:mod:`.workers`) — the same kernel math as the in-process varbatch
+    call, so the batch stream stays byte-identical for any worker count —
+    and the consumer stacks shared-memory views straight into the batch
+    buffer.
     """
+    from distributeddeeplearningspark_tpu.data import workers as workers_lib
     from distributeddeeplearningspark_tpu.data.feed import _round_robin
     from distributeddeeplearningspark_tpu.utils import native
+
+    if workers_lib.resolve_num_workers(num_workers) > 0:
+        mapped = workers_lib.WorkerMappedDataset(
+            dataset, _fused_example_transform(size, seed), num_workers,
+            label="imagenet_train_batched")
+
+        def stack_mapped(buf: list[dict]) -> dict:
+            out = np.empty((len(buf), size, size, 3), np.float32)
+            for j, e in enumerate(buf):
+                out[j] = e["image"]  # shm view → batch buffer, one copy
+            rest = {k: np.stack([np.asarray(e[k]) for e in buf])
+                    for k in buf[0] if k != "image"}
+            return {"image": out, **rest}
+
+        def pooled_batches():
+            streams = [mapped.iter_partition(i)
+                       for i in range(mapped.num_partitions)]
+            buf: list[dict] = []
+            for ex in _round_robin([iter(s) for s in streams]):
+                buf.append(ex)
+                if len(buf) < batch_size:
+                    continue
+                yield stack_mapped(buf)
+                buf = []
+            if buf and not drop_remainder:
+                yield stack_mapped(buf)
+
+        return pooled_batches()
 
     # the SAME partition interleave as host_batches — the output-parity
     # contract with the per-example path depends on sharing one dealer
@@ -340,14 +452,7 @@ def imagenet_train_batched(
                 if img.dtype != np.uint8 or img.ndim != 3:
                     fallback_idx.append(j)
                     continue
-                rng = np.random.default_rng(
-                    (seed * 2654435761 + _content_seed(img)) & 0xFFFFFFFF)
-                h, w = img.shape[:2]
-                if h == w == size:
-                    region = (0, 0, h, w)  # train_transform's no-crop path
-                else:
-                    region = sample_crop_region(h, w, rng)
-                flip = bool(rng.random() < 0.5)
+                region, flip = _augment_decision(img, seed, size)
                 if region is None:  # center-crop fallback shape — rare
                     fallback_idx.append(j)
                     continue

@@ -135,6 +135,24 @@ def serving_from(events: list[dict]) -> dict | None:
     }
 
 
+#: worker-pool gauge keys a step_metrics event may carry (emitted by
+#: StarvationProbe.snapshot when a data/workers.py pool is live).
+_WORKER_KEYS = ("input_workers", "worker_util_mean", "worker_util_min",
+                "worker_items", "worker_overflow", "worker_ahead_mean",
+                "worker_ring_used_mb")
+
+
+def input_workers_from(events: list[dict]) -> dict | None:
+    """The newest input-worker-pool gauge set, or None when the run never
+    used a pool. The latest snapshot (not an average) is what answers "is
+    the pool or the consumer the bottleneck *now*" — utilizations are
+    pool-lifetime fractions already."""
+    for e in reversed(events):
+        if e.get("kind") == "step_metrics" and e.get("input_workers"):
+            return {k: e[k] for k in _WORKER_KEYS if e.get(k) is not None}
+    return None
+
+
 def report(workdir: str, *, now: float | None = None,
            hosts: bool = False) -> dict:
     """The full run report as a plain dict (what ``--json`` prints).
@@ -165,6 +183,7 @@ def report(workdir: str, *, now: float | None = None,
             ((now if now is not None else time.time()) - last_hb)
             if last_hb is not None else None),
         "goodput": telemetry.goodput(events),
+        "input_workers": input_workers_from(events),
         "serving": serving_from(events),
         "attempts": attempts_from(events),
         "recovery_events": [e for e in events if e.get("kind") == "recovery"],
@@ -252,6 +271,29 @@ def render(rep: dict) -> str:
         lines.append(f"  {comp:<20} {g[comp]:10.2f}s  "
                      f"{100.0 * g[comp] / wall:6.1f}%")
     lines.append(f"  goodput_frac         {g['goodput_frac']:10.3f}")
+    iw = rep.get("input_workers")
+    if iw:
+        starved = (g.get("input_starved_s") or 0.0) > 0.05 * (g["wall_s"] or 1)
+        util = iw.get("worker_util_mean", 0.0)
+        if util >= 0.85 and starved:
+            verdict = "pool-bound — workers saturated; add workers/cores"
+        elif starved:
+            verdict = ("source-bound — training waits but workers idle; "
+                       "the raw source (IO) is the limit")
+        else:
+            verdict = "keeping up — consumer/device is the bottleneck"
+        lines.append("")
+        lines.append(
+            f"input workers: {iw['input_workers']} process(es)  "
+            f"util mean={util:.2f}"
+            + (f" min={iw['worker_util_min']:.2f}"
+               if iw.get("worker_util_min") is not None else "")
+            + f"  items={iw.get('worker_items', 0)}"
+            + f"  ahead={iw.get('worker_ahead_mean', 0.0):.1f}"
+            + (f"  OVERFLOW={iw['worker_overflow']} (raise "
+               f"DLS_DATA_WORKER_RING_MB)" if iw.get("worker_overflow")
+               else ""))
+        lines.append(f"  verdict: {verdict}")
     sv = rep.get("serving")
     if sv:
         lines.append("")

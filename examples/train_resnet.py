@@ -56,6 +56,12 @@ def main() -> None:
                    help="shorter-side size baked into materialized records "
                         "(0 = auto: max(256, image-size/0.875) so training "
                         "crops never upscale degraded frames)")
+    p.add_argument("--data-workers", type=int, default=None,
+                   help="decode/augment worker processes (default: "
+                        "DLS_DATA_WORKERS env; 0 = in-process). Byte-"
+                        "identical batch stream at any count — see "
+                        "docs/PERFORMANCE.md 'Scaling the host input "
+                        "pipeline'")
     p.add_argument("--eval-dir", default=None,
                    help="validation root (same layout); reports top-1/top-5 "
                         "after training via the exact tail-inclusive evaluator")
@@ -131,7 +137,8 @@ def main() -> None:
             num_classes=args.num_classes,
             num_partitions=max(spark.default_parallelism, 1),
         )
-    ds = vision.imagenet_train(ds, size=args.image_size, repeat=True)
+    ds = vision.imagenet_train(ds, size=args.image_size, repeat=True,
+                               num_workers=args.data_workers)
 
     model = RESNETS[args.variant](num_classes=args.num_classes)
     schedule = optim.warmup_cosine(args.lr, warmup_steps=min(args.steps // 10, 500),

@@ -103,6 +103,83 @@ print("culprit=host%s phase=%s hb_age=%.1fs"
   return $rc
 }
 
+# input-pipeline smoke (ISSUE 5 satellite): synthetic JPEG corpus through
+# the REAL path twice — the serial in-process map vs a 2-process
+# data/workers.py pool. The pool must win on throughput (byte-identical
+# stream is the tier-1 tests' job), and the run's telemetry must carry the
+# new per-worker utilization gauges.
+run_input_smoke() {
+  local t0 rc out
+  t0=$(date +%s)
+  rc=0
+  out=$(python - <<'PYEOF'
+import json, os, sys, tempfile, time
+import numpy as np
+from PIL import Image
+
+root = tempfile.mkdtemp(prefix="dls_input_smoke_")
+rng = np.random.default_rng(0)
+for cls in range(2):
+    d = os.path.join(root, f"class_{cls}")
+    os.makedirs(d)
+    for i in range(24):
+        arr = rng.integers(0, 255, (500, 500, 3), np.uint8)
+        Image.fromarray(arr).save(os.path.join(d, f"i{i}.jpg"), quality=90)
+
+from distributeddeeplearningspark_tpu import status, telemetry
+from distributeddeeplearningspark_tpu.data.feed import host_batches
+from distributeddeeplearningspark_tpu.data.prefetch import StarvationProbe
+from distributeddeeplearningspark_tpu.data.sources import imagenet_folder
+from distributeddeeplearningspark_tpu.data.vision import imagenet_train
+
+base = imagenet_folder(root, num_partitions=1, decode=False)
+wd = tempfile.mkdtemp(prefix="dls_input_tele_")
+writer = telemetry.EventWriter(wd, process=0, host=0)
+probe = StarvationProbe()
+
+def rate(nw, num_threads=None):
+    ds = imagenet_train(base, seed=0, repeat=True, num_workers=nw,
+                        num_threads=num_threads)
+    feed = host_batches(ds, 32)
+    next(feed)  # pool spin-up + warm caches outside the window
+    t0 = time.perf_counter()
+    seen = 0
+    for _ in range(4):
+        seen += len(next(feed)["label"])
+    r = seen / (time.perf_counter() - t0)
+    if nw:  # snapshot while the pool is live → worker gauges ride along
+        writer.step_metrics(1, steps=4, lap_s=seen / r,
+                            metrics={"images_per_sec": r},
+                            **probe.snapshot())
+    feed.close()
+    return r
+
+# shared/throttled CI vCPUs swing ±50% between back-to-back runs, so a
+# single A-vs-B window can be decided by a neighbor's load spike:
+# interleave the arms (A,B,A,B) and compare best-of-each (peak capability)
+serial = pooled = 0.0
+for _ in range(2):
+    serial = max(serial, rate(0, num_threads=0))
+    pooled = max(pooled, rate(2))
+writer.close()
+rep = status.report(wd)
+iw = rep["input_workers"]
+assert iw and iw["input_workers"] == 2, f"worker gauges missing: {iw}"
+assert iw["worker_util_mean"] > 0.0
+assert "input workers: 2 process(es)" in status.render(rep)
+speedup = pooled / serial
+assert speedup > 1.0, (
+    f"2-worker pool ({pooled:.1f} img/s) did not beat the serial map "
+    f"({serial:.1f} img/s)")
+print(f"serial={serial:.1f} pooled2={pooled:.1f} img/s "
+      f"speedup={speedup:.2f} util={iw['worker_util_mean']:.2f}")
+PYEOF
+) || rc=$?
+  log input "${out:-input smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[input] ${out:-FAILED} (rc=${rc})"
+  return $rc
+}
+
 # serve smoke (ISSUE 4 satellite): train a few LeNet steps, serve them with
 # the dynamic-batching engine under concurrent clients, hot-reload a newer
 # checkpoint mid-traffic — batched throughput must beat the single-request
@@ -148,10 +225,13 @@ case "${1:-both}" in
   hosts) run_hosts_smoke || overall=$? ;;
   # serving: train→serve→hot-reload end-to-end on CPU LeNet (docs/SERVING.md)
   serve) run_serve_smoke || overall=$? ;;
+  # input pipeline: 2-worker pool beats the serial map on a synthetic JPEG
+  # corpus, and telemetry carries the per-worker gauges (docs/PERFORMANCE.md)
+  input) run_input_smoke || overall=$? ;;
   # the executable pod-day scripts, logged with the same audit trail
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|input|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
