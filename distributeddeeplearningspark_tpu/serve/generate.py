@@ -18,10 +18,25 @@ a fixed pool of **KV-cache slots** stepped together forever:
   callback the step it is produced — time-to-first-token is one prefill,
   not one full batch.
 
+**Paged KV arena** (``page_size=N``): instead of a private
+``[max_cache_len]`` slab per slot, KV storage becomes a pool of fixed-size
+pages with a block table per slot (:mod:`.kv` — the same out-of-order
+first-fit discipline ``data/workers.py`` proved for shm planes). Each
+jitted call gathers a slot's dense cache view from its pages and scatters
+the updated view back, so the attention math — and therefore every
+sampled token — is identical to the fixed-slot pool; what changes is the
+memory discipline: pages reclaim out of order on eos, and **prefix
+caching** lets requests sharing a page-aligned prompt prefix (the
+system-prompt case) reference the same prefilled pages and prefill only
+their remainder. (On TPU the gather/scatter is the XLA-portable
+formulation; a paged attention kernel that indexes pages in place is the
+chip-path successor — docs/SERVING.md "Paged KV sizing".)
+
 Params are read once per step, so :meth:`ContinuousGenerator.swap_params`
 (checkpoint hot-reload) takes effect at the next token without dropping
-or restarting in-flight sequences. Admission is the same bounded-queue /
-typed-shed contract as :mod:`.engine`.
+or restarting in-flight sequences; a swap also invalidates the prefix
+cache (its K/V was computed under the old tree). Admission is the same
+bounded-queue / typed-shed contract as :mod:`.engine`.
 """
 
 from __future__ import annotations
@@ -41,6 +56,7 @@ from distributeddeeplearningspark_tpu.serve.engine import (
     EngineStoppedError,
     OverloadedError,
 )
+from distributeddeeplearningspark_tpu.serve.kv import PagedKVArena, PrefixCache
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.serve")
 
@@ -67,6 +83,8 @@ class _GenRequest:
     t_submit: float = 0.0
     t_admit: float = 0.0
     tokens: list[int] = field(default_factory=list)
+    prefix_hit: bool = False                # admission reused cached pages
+    prefix_tokens: int = 0                  # prompt tokens NOT re-prefilled
 
 
 class ContinuousGenerator:
@@ -97,6 +115,23 @@ class ContinuousGenerator:
     max_queue:
         Admission bound; beyond it :meth:`submit` sheds with
         :class:`~.engine.OverloadedError`.
+    page_size:
+        None (default) = the PR 4 fixed-slot pool. An int switches KV
+        storage to the paged arena: must divide ``max_cache_len`` and
+        every prompt bucket. Token output is identical either way (pinned
+        by tests) — paging changes memory discipline, not math.
+    kv_pages:
+        Paged mode's pool size in pages (page 0 is the reserved trash
+        page). Default ``slots × pages_per_slot + pages_per_slot + 1`` —
+        every slot full plus one sequence's worth of headroom for
+        prefix-cache retention.
+    prefix_cache:
+        Paged mode only: share page-aligned prompt-prefix K/V between
+        requests (hash-keyed map; hits skip re-prefilling the shared
+        pages). Invalidated on :meth:`swap_params`.
+    gauge_interval_s:
+        Cadence of the ``serve`` telemetry gauge (KV occupancy, prefix
+        hit rate, active slots) when a ``workdir`` is bound.
     """
 
     def __init__(
@@ -114,6 +149,10 @@ class ContinuousGenerator:
         seed: int = 0,
         prompt_buckets: Sequence[int] | None = None,
         max_queue: int = 256,
+        page_size: int | None = None,
+        kv_pages: int | None = None,
+        prefix_cache: bool = True,
+        gauge_interval_s: float = 5.0,
         workdir: str | None = None,
         name: str = "generate",
     ):
@@ -137,9 +176,17 @@ class ContinuousGenerator:
         self.eos_id = eos_id
         self.pad_id = int(pad_id)
         self.max_queue = int(max_queue)
-        self.prompt_buckets = tuple(sorted(
-            prompt_buckets if prompt_buckets is not None
-            else default_prompt_buckets(self.max_cache_len)))
+        if prompt_buckets is None:
+            prompt_buckets = default_prompt_buckets(self.max_cache_len)
+            if page_size:
+                # paged prefill scatters whole pages, so the DEFAULT ladder
+                # self-aligns: each bucket rounds up to a page multiple
+                # (explicitly passed buckets are validated, not rewritten)
+                prompt_buckets = {
+                    min(self.max_cache_len,
+                        -(-b // int(page_size)) * int(page_size))
+                    for b in prompt_buckets}
+        self.prompt_buckets = tuple(sorted(set(prompt_buckets)))
         if self.prompt_buckets[-1] > self.max_cache_len:
             raise ValueError(
                 f"largest prompt bucket {self.prompt_buckets[-1]} exceeds "
@@ -147,6 +194,8 @@ class ContinuousGenerator:
         # same contract as InferenceEngine: request events only when a
         # workdir is given (telemetry-silent otherwise)
         self._tele = telemetry.configure(workdir) if workdir else None
+        self.gauge_interval_s = float(gauge_interval_s)
+        self._last_gauge = 0.0
 
         self._model = decode_model(cfg, self.max_cache_len)
         self._params = params
@@ -201,15 +250,28 @@ class ContinuousGenerator:
         self._step = jax.jit(step)
         self._insert = jax.jit(insert)
 
-        # empty slot pool: cache structure from an abstract eval (free), zeros
-        abstract = jax.eval_shape(
-            lambda p: self._model.apply(
-                {"params": p},
-                {"input_ids": jnp.zeros((self.slots, 1), jnp.int32)},
-                train=False, mutable=["cache"])[1]["cache"],
-            params)
-        self._cache = jax.tree.map(
-            lambda a: jnp.zeros(a.shape, a.dtype), abstract)
+        # cache structure from an abstract eval (free)
+        def abstract_cache(batch, cache_len):
+            m = decode_model(cfg, cache_len) if cache_len != self.max_cache_len \
+                else self._model
+            return jax.eval_shape(
+                lambda p: m.apply(
+                    {"params": p},
+                    {"input_ids": jnp.zeros((batch, 1), jnp.int32)},
+                    train=False, mutable=["cache"])[1]["cache"],
+                params)
+
+        abstract = abstract_cache(self.slots, self.max_cache_len)
+        self.page_size = int(page_size) if page_size is not None else None
+        if self.page_size is None:
+            self._arena = None
+            self._prefix = None
+            # dense fixed-slot pool: zeros
+            self._cache = jax.tree.map(
+                lambda a: jnp.zeros(a.shape, a.dtype), abstract)
+        else:
+            self._init_paged(abstract, abstract_cache, kv_pages,
+                             prefix_cache, sample)
         self._cur_tok = np.zeros((self.slots,), np.int32)
 
         self._queue: list[_GenRequest] = []
@@ -222,7 +284,146 @@ class ContinuousGenerator:
         self._rid = itertools.count()
         self._stats = {"requests": 0, "shed": 0, "completed": 0, "steps": 0,
                        "admitted": 0, "reloads": 0, "max_active": 0,
-                       "tokens": 0}
+                       "tokens": 0, "deferred": 0}
+
+    # -- paged KV arena setup ------------------------------------------------
+
+    def _init_paged(self, abstract, abstract_cache, kv_pages, prefix_cache,
+                    sample) -> None:
+        """Build the page pool, block tables, and the paged jit twins.
+
+        Per-leaf axis identification is structural, not positional: the
+        slot (batch) axis is the one that moves when the abstract cache is
+        re-evaluated at ``slots+1``, the length axis the one that moves at
+        ``max_cache_len + page_size`` — robust to scanned-layer stacking
+        and any future cache leaves. Leaves with no length axis are the
+        int32 per-row indices; they have no pool storage (positions live
+        host-side in ``self._pos``) and are rebuilt at assemble time."""
+        jax, jnp = self._jax, self._jnp
+        page = self.page_size
+        if self.max_cache_len % page:
+            raise ValueError(
+                f"page_size {page} must divide max_cache_len "
+                f"{self.max_cache_len}")
+        bad = [b for b in self.prompt_buckets if b % page]
+        if bad:
+            raise ValueError(
+                f"page_size {page} must divide every prompt bucket "
+                f"(violating: {bad}) — prefill scatters whole pages")
+        self._pps = self.max_cache_len // page
+        num_pages = (int(kv_pages) if kv_pages is not None
+                     else self.slots * self._pps + self._pps + 1)
+        if num_pages < self._pps + 1:
+            raise ValueError(
+                f"kv_pages {num_pages} cannot back one full sequence "
+                f"({self._pps} pages + the trash page)")
+        self._arena = PagedKVArena(num_pages, page)
+        self._prefix = PrefixCache(self._arena) if prefix_cache else None
+        self._prefix_version = self.params_version
+
+        leaves0, treedef = jax.tree.flatten(abstract)
+        leavesB = jax.tree.leaves(abstract_cache(self.slots + 1,
+                                                 self.max_cache_len))
+        leavesL = jax.tree.leaves(abstract_cache(self.slots,
+                                                 self.max_cache_len + page))
+        self._cache_treedef = treedef
+        self._leaf_meta: list[tuple] = []
+        pool = []
+        for s0, sb, sl in zip(leaves0, leavesB, leavesL):
+            slot_ax = [i for i, (a, b) in enumerate(zip(s0.shape, sb.shape))
+                       if a != b]
+            len_ax = [i for i, (a, b) in enumerate(zip(s0.shape, sl.shape))
+                      if a != b]
+            if not len_ax:
+                # per-row index leaf: int32, slot axis last by construction
+                assert s0.dtype == jnp.int32 and slot_ax == [len(s0.shape) - 1], \
+                    (s0.shape, s0.dtype, slot_ax)
+                self._leaf_meta.append(("idx", s0.shape, s0.dtype))
+                continue
+            assert len(slot_ax) == 1 and len_ax == [slot_ax[0] + 1], \
+                (s0.shape, slot_ax, len_ax)
+            sa = slot_ax[0]
+            pool_shape = (s0.shape[:sa] + (num_pages, page)
+                          + s0.shape[sa + 2:])
+            pool.append(jnp.zeros(pool_shape, s0.dtype))
+            self._leaf_meta.append(("kv", sa, s0.dtype))
+        self._pool = pool
+        self._tables = np.zeros((self.slots, self._pps), np.int32)
+        self._slot_pages: list[list[int]] = [[] for _ in range(self.slots)]
+        self._pos = np.zeros((self.slots,), np.int32)
+
+        def assemble(pool, tables, pos):
+            """Dense cache view: per KV leaf, gather the block-table pages
+            and merge (pages, page_size) back into the length axis; index
+            leaves broadcast from ``pos``."""
+            dense, it = [], iter(pool)
+            for meta in self._leaf_meta:
+                if meta[0] == "kv":
+                    _, sa, _ = meta
+                    g = jnp.take(next(it), tables, axis=sa)
+                    dense.append(g.reshape(
+                        g.shape[:sa]
+                        + (g.shape[sa], g.shape[sa + 1] * g.shape[sa + 2])
+                        + g.shape[sa + 3:]))
+                else:
+                    _, shape, dtype = meta
+                    dense.append(jnp.broadcast_to(
+                        pos.astype(dtype), shape[:-1] + (tables.shape[0],)))
+            return jax.tree.unflatten(self._cache_treedef, dense)
+
+        def scatter(pool, cache, tables):
+            """Write a dense cache view back to its pages. Duplicate page
+            ids across the table (shared prefix pages, the trash page)
+            scatter in arbitrary order — shared pages always receive
+            identical values (decode never writes below prompt_len), and
+            the trash page is garbage by contract."""
+            out, pi = [], 0
+            flat = tables.reshape(-1)
+            for meta, leaf in zip(self._leaf_meta, jax.tree.leaves(cache)):
+                if meta[0] != "kv":
+                    continue
+                _, sa, _ = meta
+                s, length = leaf.shape[sa], leaf.shape[sa + 1]
+                d = leaf.reshape(
+                    leaf.shape[:sa] + (s * (length // page), page)
+                    + leaf.shape[sa + 2:])
+                idx = (slice(None),) * sa + (flat,)
+                out.append(pool[pi].at[idx].set(d))
+                pi += 1
+            return out
+
+        def paged_step(params, pool, tables, pos, tok, key):
+            cache = assemble(pool, tables, pos)
+            logits, mut = self._model.apply(
+                {"params": params, "cache": cache},
+                {"input_ids": tok[:, None]}, train=False, mutable=["cache"])
+            return scatter(pool, mut["cache"], tables), sample(
+                logits[:, -1], key)
+
+        def paged_prefill(params, pool, row_tables, start, ids, true_end, key):
+            """Prefill ``ids`` (window at cache position ``start``) into the
+            row backed by ``row_tables`` — ``start=0`` is a full prefill,
+            ``start>0`` continues from cached prefix pages. Index leaves
+            reset to ``true_end`` (pads beyond the prompt were written but
+            stay masked until decode overwrites them)."""
+            row = assemble(pool, row_tables,
+                           jnp.full((1,), start, jnp.int32))
+            logits, mut = self._model.apply(
+                {"params": params, "cache": row}, {"input_ids": ids},
+                train=False, mutable=["cache"])
+            cache = jax.tree.map(
+                lambda x: jnp.full_like(x, true_end)
+                if x.dtype == jnp.int32 else x,
+                mut["cache"])
+            tok = sample(logits[jnp.arange(1), true_end - start - 1], key)
+            return scatter(pool, cache, row_tables), tok
+
+        self._paged_step = jax.jit(paged_step)
+        self._paged_prefill = jax.jit(paged_prefill)
+
+    @property
+    def paged(self) -> bool:
+        return self.page_size is not None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -316,6 +517,10 @@ class ContinuousGenerator:
             out["queue_depth"] = len(self._queue)
             out["active"] = sum(r is not None for r in self._active)
         out["params_version"] = self.params_version
+        if self._arena is not None:
+            out.update(self._arena.stats())
+        if self._prefix is not None:
+            out.update(self._prefix.stats())
         return out
 
     # -- hot reload ----------------------------------------------------------
@@ -323,7 +528,9 @@ class ContinuousGenerator:
     def swap_params(self, params: Any, *, version: int | str | None = None) -> None:
         """Swap the param tree between decode steps: in-flight sequences
         keep their KV cache and continue on the new params at the next
-        token — nothing is dropped or restarted."""
+        token — nothing is dropped or restarted. The prefix cache is
+        invalidated (its pages hold K/V computed under the old tree); the
+        serving thread flushes it before the next admission."""
         jax = self._jax
         old = self._params
         try:
@@ -364,7 +571,10 @@ class ContinuousGenerator:
                 tokens=len(req.tokens),
                 queue_wait_s=round(req.t_admit - req.t_submit, 6),
                 latency_s=round(done - req.t_submit, 6),
-                batch_size=n_active)
+                batch_size=n_active,
+                **({"prefix_hit": req.prefix_hit,
+                    "prefix_tokens": req.prefix_tokens}
+                   if self.paged and self._prefix is not None else {}))
 
     def _emit_token(self, req: _GenRequest, tok: int) -> bool:
         """Record one sampled token; True when the sequence is complete."""
@@ -377,8 +587,20 @@ class ContinuousGenerator:
         return (len(req.tokens) >= req.max_new_tokens
                 or (self.eos_id is not None and tok == self.eos_id))
 
-    def _admit(self, req: _GenRequest, slot: int, params) -> None:
-        """Prefill ``req`` and insert its cache row into ``slot``."""
+    def _admit(self, req: _GenRequest, slot: int, params, version) -> bool:
+        """Prefill ``req`` and insert its cache row into ``slot``.
+
+        ``version`` is the caller's snapshot taken with ``params`` under
+        one lock hold — prefix-cache entries must be keyed by the version
+        of the tree that actually computed them, and reading
+        ``self.params_version`` here would race a concurrent swap (old
+        params registered under the new version = stale K/V surviving the
+        post-swap flush). Returns False when admission must wait (paged
+        mode, arena out of pages until a completion frees some) — the
+        caller re-queues the request at the front. The dense path always
+        admits."""
+        if self.paged:
+            return self._admit_paged(req, slot, params, version)
         jax = self._jax
         req.t_admit = time.monotonic()
         bucket = self._bucket(req.prompt.size)
@@ -393,13 +615,131 @@ class ContinuousGenerator:
         if self._emit_token(req, tok):
             # one-token request (or instant eos): never occupies the slot
             self._finish(req, n_active=n_active)
-            return
+            return True
         self._cache = self._insert(self._cache, row, np.int32(slot))
         self._cur_tok[slot] = tok
         self._active[slot] = req
         with self._cond:
             self._stats["max_active"] = max(self._stats["max_active"],
                                             n_active)
+        return True
+
+    # -- paged admission -----------------------------------------------------
+
+    def _admit_paged(self, req: _GenRequest, slot: int, params,
+                     version) -> bool:
+        jax, page = self._jax, self.page_size
+        plen = int(req.prompt.size)
+        total = plen + req.max_new_tokens
+
+        # longest cached prefix, shrunk until a remainder bucket fits the
+        # cache (near-full prompts may need a shallower reuse depth)
+        n_shared, shared = (self._prefix.lookup(req.prompt, version)
+                            if self._prefix is not None else (0, []))
+        while True:
+            start = n_shared * page
+            rem = plen - start
+            rb = next((b for b in self.prompt_buckets
+                       if b >= rem and start + b <= self.max_cache_len), None)
+            if rb is not None:
+                break
+            # submit() guarantees plen fits the largest bucket, so the
+            # loop terminates at n_shared == 0 at the latest
+            self._arena.release([shared.pop()])
+            n_shared -= 1
+        hit = n_shared > 0
+
+        # back every position prefill or decode will touch
+        cover = -(-max(total, start + rb) // page)
+        owned = self._arena.alloc(cover - n_shared)
+        if owned is None and self._prefix is not None:
+            # the cache is a scavenger of free pages, never a reason to
+            # refuse admission: LRU-evict until the allocation fits
+            self._prefix.evict_until(cover - n_shared)
+            owned = self._arena.alloc(cover - n_shared)
+        if owned is None:
+            # pages are held by in-flight slots; a completion will free
+            # them. Re-queue (caller) — progress is guaranteed because a
+            # full sequence always fits an empty arena (ctor invariant).
+            if shared:
+                self._arena.release(shared)
+            with self._cond:
+                self._stats["deferred"] += 1
+            return False
+
+        pages = shared + owned
+        self._slot_pages[slot] = pages
+        self._tables[slot, :] = 0
+        self._tables[slot, :len(pages)] = pages
+
+        req.t_admit = time.monotonic()
+        req.prefix_hit, req.prefix_tokens = hit, start
+        ids = np.full((1, rb), self.pad_id, np.int32)
+        ids[0, :rem] = req.prompt[start:]
+        try:
+            self._pool, tok = self._paged_prefill(
+                params, self._pool, self._tables[slot:slot + 1],
+                np.int32(start), ids, np.int32(plen), self._split_key())
+            tok = int(jax.device_get(tok)[0])
+        except BaseException:
+            # a poisoned prompt fails ITS future in _loop — but the pages
+            # just allocated/retained must go back, or every such failure
+            # leaks `cover` pages until the arena wedges shut
+            self._release_slot(slot)
+            raise
+        if self._prefix is not None:
+            self._prefix.record(start if hit else 0)
+            # register every page-aligned depth of THIS prompt (retains the
+            # pages) — done before any release so an instant finish can't
+            # reclaim pages the cache wants
+            self._prefix.register(req.prompt, pages[:plen // page], version)
+        with self._cond:
+            self._stats["admitted"] += 1
+        n_active = sum(r is not None for r in self._active) + 1
+        if self._emit_token(req, tok):
+            self._release_slot(slot)
+            self._finish(req, n_active=n_active)
+            return True
+        self._pos[slot] = plen
+        self._cur_tok[slot] = tok
+        self._active[slot] = req
+        with self._cond:
+            self._stats["max_active"] = max(self._stats["max_active"],
+                                            n_active)
+        return True
+
+    def _release_slot(self, slot: int) -> None:
+        """Return a slot's pages to the arena (pages the prefix cache
+        retained survive at lower refcount) and reset its table row."""
+        if self._slot_pages[slot]:
+            self._arena.release(self._slot_pages[slot])
+            self._slot_pages[slot] = []
+        self._tables[slot, :] = 0
+        self._pos[slot] = 0
+
+    # -- telemetry gauges ----------------------------------------------------
+
+    def _maybe_gauge(self, *, force: bool = False) -> None:
+        if self._tele is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_gauge < self.gauge_interval_s:
+            return
+        self._last_gauge = now
+        fields: dict[str, Any] = {
+            "engine": self.name,
+            "active": sum(r is not None for r in self._active),
+            "queue_depth": len(self._queue),
+            "completed": self._stats["completed"],
+            "params_version": self.params_version,
+        }
+        if self._arena is not None:
+            fields.update(self._arena.stats())
+        if self._prefix is not None:
+            fields.update(self._prefix.stats())
+        self._tele.emit("serve", **fields)
+
+    # -- the loop ------------------------------------------------------------
 
     def _loop(self) -> None:
         jax = self._jax
@@ -409,17 +749,28 @@ class ContinuousGenerator:
                         and all(r is None for r in self._active))
                 if idle:
                     if self._stopped:
+                        self._maybe_gauge(force=True)
                         return
                     self._cond.wait(0.05)
                     continue
+                # one lock hold: the version must be THE version of this
+                # params snapshot (admissions key prefix-cache entries by it)
                 params = self._params
-                admissions: list[tuple[_GenRequest, int]] = []
-                for slot in range(self.slots):
-                    if self._active[slot] is None and self._queue:
-                        admissions.append((self._queue.pop(0), slot))
-            for req, slot in admissions:
+                version = self.params_version
+            # a params swap stales every cached prefix K/V — flush before
+            # any admission could hit one (serving thread owns the cache)
+            if self._prefix is not None and self._prefix_version != version:
+                self._prefix.flush()
+                self._prefix_version = version
+            while True:
+                with self._cond:
+                    free = next((s for s in range(self.slots)
+                                 if self._active[s] is None), None)
+                    if free is None or not self._queue:
+                        break
+                    req = self._queue.pop(0)
                 try:
-                    self._admit(req, slot, params)
+                    admitted = self._admit(req, free, params, version)
                 except Exception as e:  # noqa: BLE001 — a poisoned prompt
                     # fails ITS future; the pool keeps serving the rest
                     logger.exception("prefill failed (request %d)", req.rid)
@@ -428,11 +779,35 @@ class ContinuousGenerator:
                         self._tele.emit("request", engine=self.name,
                                         id=req.rid, outcome="error",
                                         error=f"{type(e).__name__}: {e}")
+                    continue
+                if not admitted:
+                    # arena full: the request keeps its queue position and
+                    # waits for a completion to free pages
+                    with self._cond:
+                        self._queue.insert(0, req)
+                    break
+            self._maybe_gauge()
             if all(r is None for r in self._active):
                 continue
-            self._cache, nxt = self._step(
-                params, self._cache, self._cur_tok, self._split_key())
-            nxt = np.asarray(jax.device_get(nxt))
+            if self.paged:
+                self._pool, nxt = self._paged_step(
+                    params, self._pool, self._tables, self._pos,
+                    self._cur_tok, self._split_key())
+                nxt = np.asarray(jax.device_get(nxt))
+                # advance positions only AFTER the step has executed
+                # (device_get above): jax's CPU backend zero-copies
+                # aligned numpy arguments, so mutating self._pos while
+                # the dispatched step is still in flight races the
+                # execution — the step sometimes reads the incremented
+                # position and decodes one slot ahead (flaky token
+                # divergence vs the dense pool)
+                for slot, req in enumerate(self._active):
+                    if req is not None:
+                        self._pos[slot] += 1
+            else:
+                self._cache, nxt = self._step(
+                    params, self._cache, self._cur_tok, self._split_key())
+                nxt = np.asarray(jax.device_get(nxt))
             with self._cond:
                 self._stats["steps"] += 1
             n_active = sum(r is not None for r in self._active)
@@ -442,6 +817,8 @@ class ContinuousGenerator:
                 tok = int(nxt[slot])
                 if self._emit_token(req, tok):
                     self._active[slot] = None       # frees the slot: the
-                    self._finish(req, n_active=n_active)  # next queued request
-                    continue                        # joins mid-flight
+                    if self.paged:                  # next queued request
+                        self._release_slot(slot)    # joins mid-flight
+                    self._finish(req, n_active=n_active)
+                    continue
                 self._cur_tok[slot] = tok

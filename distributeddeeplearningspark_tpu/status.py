@@ -10,9 +10,10 @@ was productive, and what ate the rest".
 
 ::
 
-    dlstatus <workdir>            # goodput table, attempts, recovery events
-    dlstatus <workdir> --json     # machine-readable report
-    dlstatus <workdir> --hosts    # + per-host fleet table, skew, verdicts
+    dlstatus <workdir>                # goodput table, attempts, recovery
+    dlstatus <workdir> --json         # machine-readable report
+    dlstatus <workdir> --hosts        # + per-host fleet table, skew, verdicts
+    dlstatus <workdir> --fleet-serve  # + per-replica serving table
 
 A workdir that served traffic (:mod:`..serve` — ``request`` events in the
 stream) additionally gets the serving rollup: request counts by outcome
@@ -25,6 +26,13 @@ the step-skew timeline, and — when the evidence supports one — a straggler
 or hang verdict naming the culprit host. Like the rest of the report it is
 a pure fold over the JSONL streams, so it works on crashed and partial
 streams (a silent host is exactly what it localizes).
+
+``--fleet-serve`` adds the serving-fleet view
+(:func:`..telemetry.fleet.serving_fleet`): one row per replica process
+with request counts, p50/p99, shed rate, KV page occupancy, and
+prefix-cache hit rate — the table that names which replica is shedding,
+paging-pressured, or dead-silent (docs/POD_PLAYBOOK.md "A serving replica
+died").
 """
 
 from __future__ import annotations
@@ -92,13 +100,10 @@ def attempts_from(events: list[dict]) -> list[dict]:
     return rows
 
 
-def _percentile(sorted_vals: list[float], q: float) -> float | None:
-    """Nearest-rank percentile over an already-sorted list (no numpy — the
-    reader side must stay importable without the training stack)."""
-    if not sorted_vals:
-        return None
-    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[i]
+# the ONE percentile definition (nearest-rank, jax-free) now lives beside
+# the serving-fleet rollup that also needs it; re-exported here because
+# dlserve and the tests import it as status._percentile
+_percentile = fleet_lib._percentile
 
 
 def serving_from(events: list[dict]) -> dict | None:
@@ -154,9 +159,10 @@ def input_workers_from(events: list[dict]) -> dict | None:
 
 
 def report(workdir: str, *, now: float | None = None,
-           hosts: bool = False) -> dict:
+           hosts: bool = False, fleet_serve: bool = False) -> dict:
     """The full run report as a plain dict (what ``--json`` prints).
-    ``hosts=True`` adds the ``fleet`` key (per-host table, skew, verdicts)."""
+    ``hosts=True`` adds the ``fleet`` key (per-host table, skew, verdicts);
+    ``fleet_serve=True`` adds ``fleet_serve`` (per-replica serving table)."""
     events = telemetry.read_events(workdir)
     heartbeats = [e for e in events if e.get("kind") == "heartbeat"]
     # the MOST RECENT step-bearing event, not the max step: a divergence
@@ -172,6 +178,8 @@ def report(workdir: str, *, now: float | None = None,
     rep_fleet = fleet_lib.fleet_report(events, now=now) if hosts else None
     return {
         **({"fleet": rep_fleet} if hosts else {}),
+        **({"fleet_serve": fleet_lib.serving_fleet(events)}
+           if fleet_serve else {}),
         "workdir": workdir,
         "event_files": telemetry.event_files(workdir),
         "num_events": len(events),
@@ -248,6 +256,37 @@ def render_fleet(fl: dict) -> list[str]:
     return lines
 
 
+def _fmt_pct(v: float | None) -> str:
+    return "-" if v is None else f"{100.0 * v:.0f}%"
+
+
+def render_fleet_serve(fs: dict) -> list[str]:
+    """The ``--fleet-serve`` section: one serving row per replica process."""
+    lines: list[str] = []
+    t = fs["totals"]
+    lines.append(
+        f"serving fleet: {len(fs['replicas'])} process(es), "
+        f"{t['ok']}/{t['requests']} requests ok"
+        + (f"  prefix hit rate {_fmt_pct(t['prefix_hit_rate'])}"
+           f" ({t['prefix_tokens_saved']} prompt tokens saved)"
+           if t["prefix_hit_rate"] is not None else ""))
+    lines.append(
+        f"  {'replica':<8}  {'ok':>6}  {'shed':>5}  {'err':>4}  "
+        f"{'p50':>8}  {'p99':>8}  {'shed%':>6}  {'kv occ':>6}  {'prefix':>6}")
+    for r in fs["replicas"]:
+        p50 = (f"{r['latency_p50_s'] * 1e3:.1f}ms"
+               if r["latency_p50_s"] is not None else "-")
+        p99 = (f"{r['latency_p99_s'] * 1e3:.1f}ms"
+               if r["latency_p99_s"] is not None else "-")
+        lines.append(
+            f"  {r['process']:<8}  {r['ok']:>6}  {r['shed']:>5}  "
+            f"{r['errors']:>4}  {p50:>8}  {p99:>8}  "
+            f"{_fmt_pct(r['shed_rate']):>6}  "
+            f"{_fmt_pct(r.get('kv_page_occupancy')):>6}  "
+            f"{_fmt_pct(r.get('prefix_hit_rate')):>6}")
+    return lines
+
+
 def render(rep: dict) -> str:
     """Human-readable report (the default output)."""
     lines: list[str] = []
@@ -264,6 +303,9 @@ def render(rep: dict) -> str:
     if rep.get("fleet"):
         lines.append("")
         lines.extend(render_fleet(rep["fleet"]))
+    if rep.get("fleet_serve"):
+        lines.append("")
+        lines.extend(render_fleet_serve(rep["fleet_serve"]))
     lines.append("")
     lines.append("goodput breakdown")
     wall = g["wall_s"] or float("inf")
@@ -360,8 +402,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--hosts", action="store_true",
                     help="per-host fleet table, step skew, and straggler/"
                          "hang verdicts (multi-host runs)")
+    ap.add_argument("--fleet-serve", action="store_true",
+                    help="per-replica serving table: p50/p99, shed rate, "
+                         "KV page occupancy, prefix-cache hit rate")
     args = ap.parse_args(argv)
-    rep = report(args.workdir, hosts=args.hosts)
+    rep = report(args.workdir, hosts=args.hosts,
+                 fleet_serve=args.fleet_serve)
     if not rep["num_events"]:
         print(f"dlstatus: no telemetry events under {args.workdir} "
               f"(looked in {telemetry.telemetry_dir(args.workdir)})",

@@ -34,9 +34,14 @@ present):
   :mod:`..parallel.collectives`; feeds the fleet table's comms-wait column.
 - ``request`` — one served inference request (:mod:`..serve`): ``engine``,
   ``outcome`` ("ok"/"shed"/"error"), and for ok ``queue_wait_s``,
-  ``infer_s``, ``latency_s``, ``batch_size``. ``dlstatus`` folds these
-  into the p50/p99 serving rollup; they never enter goodput accounting
-  (serving wall-clock is not training overhead).
+  ``infer_s``, ``latency_s``, ``batch_size`` (continuous decode adds
+  ``prefix_hit``/``prefix_tokens``; router tenant sheds add ``tenant``).
+  ``dlstatus`` folds these into the p50/p99 serving rollup; they never
+  enter goodput accounting (serving wall-clock is not training overhead).
+- ``serve`` — a serving-state gauge (:mod:`..serve.generate`): KV page
+  occupancy, prefix-cache hit rate, active slots, queue depth. The
+  newest one per process is a replica's "now" in ``dlstatus
+  --fleet-serve`` (:func:`.fleet.serving_fleet`).
 
 Worker-side events additionally carry ``host`` (the process index from the
 ``DLS_*`` env contract via :func:`~..utils.env.process_identity`, plus

@@ -345,6 +345,90 @@ def localize_hang(events: Iterable[dict], *, now: float | None = None,
     }
 
 
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    """Nearest-rank percentile over an already-sorted list (no numpy — the
+    reader side must stay importable without the training stack). The ONE
+    percentile definition: ``status.py`` and ``dlserve`` both import it,
+    so CLI-printed and rollup p50/p99 can never drift."""
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _fold_serving(reqs: list[dict], gauges: list[dict]) -> dict[str, Any]:
+    """One serving row from request events + the newest ``serve`` gauge."""
+    ok = [e for e in reqs if e.get("outcome") == "ok"]
+    lat = sorted(float(e["latency_s"]) for e in ok
+                 if e.get("latency_s") is not None)
+    span = (float(reqs[-1]["ts"]) - float(reqs[0]["ts"])) if reqs else 0.0
+    row = {
+        "requests": len(reqs),
+        "ok": len(ok),
+        "shed": sum(e.get("outcome") == "shed" for e in reqs),
+        "errors": sum(e.get("outcome") == "error" for e in reqs),
+        "shed_rate": (sum(e.get("outcome") == "shed" for e in reqs)
+                      / len(reqs)) if reqs else None,
+        "latency_p50_s": _percentile(lat, 0.50),
+        "latency_p99_s": _percentile(lat, 0.99),
+        "requests_per_s": (len(ok) / span) if span > 0 else None,
+        "engines": sorted({str(e["engine"]) for e in reqs
+                           if e.get("engine") is not None}),
+    }
+    if gauges:
+        g = gauges[-1]  # latest snapshot answers "what is the state NOW"
+        row.update({k: g.get(k) for k in (
+            "kv_pages_total", "kv_pages_used", "kv_page_occupancy",
+            "prefix_hits", "prefix_misses", "prefix_hit_rate",
+            "prefix_tokens_saved", "active", "params_version")
+            if g.get(k) is not None})
+    return row
+
+
+def serving_fleet(events: Iterable[dict]) -> dict[str, Any] | None:
+    """Per-replica serving rollup (what ``dlstatus --fleet-serve`` renders).
+
+    Replica identity is the writer ``process`` field — the fleet launcher
+    exports ``DLS_PROCESS_ID`` per replica, so replica k's events are
+    ``p<k>``'s; the router's tenant-budget sheds ride under its own
+    ``router`` process row. Each row folds that process's ``request``
+    events (p50/p99, shed rate, throughput) with its newest ``serve``
+    gauge (KV page occupancy, prefix-cache hit rate, active slots).
+    None when the run served nothing."""
+    events = [e for e in events if "ts" in e]
+    reqs = [e for e in events if e.get("kind") == "request"]
+    gauges = [e for e in events if e.get("kind") == "serve"]
+    if not reqs and not gauges:
+        return None
+    procs: dict[str, dict[str, list]] = {}
+    for e in reqs:
+        procs.setdefault(str(e.get("process")), {"r": [], "g": []})["r"].append(e)
+    for e in gauges:
+        procs.setdefault(str(e.get("process")), {"r": [], "g": []})["g"].append(e)
+    replicas = []
+    for proc in sorted(procs):
+        row = _fold_serving(procs[proc]["r"], procs[proc]["g"])
+        row["process"] = proc
+        replicas.append(row)
+    totals = _fold_serving(reqs, [])
+    totals.pop("engines", None)
+    # fleet-level cache/arena view: sums of the per-replica counters, and
+    # the worst (highest) page occupancy — the replica closest to paging
+    # pressure is the one an operator acts on
+    hits = sum(r.get("prefix_hits", 0) or 0 for r in replicas)
+    misses = sum(r.get("prefix_misses", 0) or 0 for r in replicas)
+    totals["prefix_hits"] = hits
+    totals["prefix_misses"] = misses
+    totals["prefix_hit_rate"] = (round(hits / (hits + misses), 4)
+                                 if hits + misses else None)
+    totals["prefix_tokens_saved"] = sum(
+        r.get("prefix_tokens_saved", 0) or 0 for r in replicas)
+    occ = [r["kv_page_occupancy"] for r in replicas
+           if r.get("kv_page_occupancy") is not None]
+    totals["kv_page_occupancy_max"] = max(occ) if occ else None
+    return {"replicas": replicas, "totals": totals}
+
+
 def fleet_report(events: Iterable[dict], *, now: float | None = None
                  ) -> dict[str, Any]:
     """The full pod-level report (what ``dlstatus --hosts`` renders).

@@ -408,6 +408,91 @@ class TestInt8Base:
         assert delta < 0.01, (ce_d, ce_q, delta)
         assert float(np.exp(delta)) < 1.0101  # perplexity ratio ≤ ~1%
 
+    def _forward_rel_err(self, dense_cfg, q_cfg, outlier_tree, batch):
+        """(max, mean) forward logits error of int8-vs-dense on the SAME
+        tree, relative to the dense logits scale."""
+        q_params = llama_io.quantize_base_int8(outlier_tree)
+        out_dense = LlamaForCausalLM(dense_cfg).apply(
+            {"params": outlier_tree}, batch, train=False)
+        out_q = LlamaForCausalLM(q_cfg).apply(
+            {"params": q_params}, batch, train=False)
+        err = np.abs(np.asarray(out_q, np.float32)
+                     - np.asarray(out_dense, np.float32))
+        ref = np.abs(np.asarray(out_dense, np.float32)).max()
+        return err.max() / ref, err.mean() / ref, q_params
+
+    def test_quality_bound_at_outlier_weights(self):
+        """The quality bound with TEETH at absmax-per-channel's known
+        failure mode (VERDICT r5 missing-#4): outlier weights. One
+        outlier in a channel inflates that channel's absmax scale, which
+        multiplies the quantization error of every OTHER weight sharing
+        the channel. Two regimes, both measured on this geometry when
+        written:
+
+        - **Outlier channels** (the realistic LLM shape: a few channels
+          per kernel carry x32 spikes, the rest are clean): measured max
+          logits error 2.3% of the logits scale — the 5% bound of the
+          clean-init parity test above STILL HOLDS, because the damage is
+          confined to the spiked channels.
+        - **Heavy-tailed everywhere** (0.5% of ALL entries x32 — at tiny
+          width that lands an outlier in nearly every channel): measured
+          max logits error 49%, mean 4.3%. Per-channel absmax genuinely
+          fails here, and this test pins the measured band rather than
+          pretending otherwise: the documented degradation is the
+          motivation line for any future outlier-aware scheme (clip /
+          SmoothQuant-style migration), whose success criterion is
+          dropping the lower edge of this band."""
+        dense_cfg, q_cfg = self._cfgs()
+        batch = make_batch()
+        params = LlamaForCausalLM(dense_cfg).init(
+            jax.random.PRNGKey(0), batch, train=False)["params"]
+
+        def inject(fn):
+            rng = np.random.default_rng(42)
+
+            def f(path, x):
+                x = np.asarray(x, np.float32)
+                if "base/kernel" not in path_str(path):
+                    return x
+                return fn(rng, x.copy())
+            return jax.tree_util.tree_map_with_path(f, params)
+
+        # regime 1: outliers confined to 2 output channels per kernel
+        def confined(rng, x):
+            flat = x.reshape(-1, x.shape[-1])
+            for c in rng.choice(x.shape[-1], size=2, replace=False):
+                flat[rng.integers(0, flat.shape[0]), c] *= 32.0
+            return flat.reshape(x.shape)
+
+        mx, _, _ = self._forward_rel_err(
+            dense_cfg, q_cfg, inject(confined), batch)
+        assert mx < 0.05, f"confined-outlier bound broke: {mx:.4f}"
+
+        # regime 2: heavy-tailed everywhere
+        heavy = inject(lambda rng, x: np.where(
+            rng.random(x.shape) < 0.005, x * 32.0, x))
+        mx, mean, q_params = self._forward_rel_err(
+            dense_cfg, q_cfg, heavy, batch)
+        # the measured-degradation band: bad enough to prove the failure
+        # mode is real (>5%: the clean bound does NOT hold), bounded
+        # enough to catch a broken scale axis (O(100%) error)
+        assert 0.05 < mx < 1.0, f"heavy-tail band moved: {mx:.4f}"
+        assert mean < 0.15, f"heavy-tail mean error: {mean:.4f}"
+
+        # the construction guarantee survives even here, hand-folded on
+        # the scanned wq stack: |dequant - w| <= scale/2 everywhere,
+        # outlier channels included
+        w = np.asarray(heavy["layers"]["attention"]["wq"]["base"]
+                       ["kernel"], np.float32)     # [L, h, nh, hd]
+        q8 = np.asarray(q_params["layers"]["attention"]["wq"]
+                        ["base_q8"], np.float32)   # [L, h, nh, hd]
+        scale = np.asarray(q_params["layers"]["attention"]["wq"]
+                           ["base_scale"])         # [L, nh, hd]
+        err_w = np.abs(q8 * scale[:, None] - w)
+        assert (err_w <= scale[:, None] / 2 + 1e-7).all()
+        # and the outliers really did inflate scales: spread >= the x32
+        assert scale.max() / scale.min() > 8.0
+
     def test_io_guards_on_quantized_trees(self):
         """merge_lora / export on an int8 tree must refuse loudly — a
         silent unmerged return or a KeyError would break the deploy path

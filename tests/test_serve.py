@@ -164,6 +164,109 @@ def test_swap_params_mid_traffic_zero_dropped():
     assert eng.stats()["reloads"] == len(valid) - 1
 
 
+def test_hot_reload_racing_full_admission_queue():
+    """The edge the fleet's rolling reload leans on (ISSUE 6 satellite):
+    swap_params hammered while the admission queue sits AT max_queue.
+    Invariants: every admitted request resolves exactly once (no drop, no
+    double-serve — resolution counted via done-callbacks), every result
+    belongs to exactly one param version (no torn batch), sheds seen by
+    callers equal the engine's shed counter, and the swaps themselves
+    never error against a full queue."""
+    eng = _mk_engine(max_batch=4, max_wait_ms=0.5, max_queue=8)
+    # deterministic full-queue phase: worker not started, queue pins at 8
+    admitted = [eng.submit({"x": np.float32(1.0)}) for _ in range(8)]
+    sheds_seen = 0
+    for i in range(5):
+        eng.swap_params({"w": jnp.float32(2000.0 + i)})  # reload AT full
+        with pytest.raises(OverloadedError):
+            eng.submit({"x": np.float32(9.0)})
+        sheds_seen += 1
+    assert eng.stats()["queue_depth"] == 8
+
+    resolved, lock = [0], threading.Lock()
+
+    def on_done(_f):
+        with lock:
+            resolved[0] += 1
+
+    for f in admitted:
+        f.add_done_callback(on_done)
+
+    # racing phase: drain + new traffic while a reloader thread swaps
+    stop = threading.Event()
+    swapped: list[float] = []
+
+    def reloader():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            eng.swap_params({"w": jnp.float32(3000.0 + i)})
+            swapped.append(3000.0 + i)
+            time.sleep(0.0005)
+
+    t = threading.Thread(target=reloader)
+    with eng:
+        t.start()
+        try:
+            for i in range(200):
+                try:
+                    f = eng.submit({"x": np.float32(1.0)})
+                except OverloadedError:
+                    sheds_seen += 1
+                    continue
+                f.add_done_callback(on_done)
+                admitted.append(f)
+        finally:
+            stop.set()
+            t.join()
+    res = [float(f.result(30)["y"]) for f in admitted]
+    assert len(res) == len(admitted)                    # zero dropped
+    assert resolved[0] == len(admitted)                 # exactly once each
+    valid = {1.0} | {2000.0 + i for i in range(5)} | set(swapped)
+    assert set(res) <= valid, sorted(set(res) - valid)  # never torn
+    st = eng.stats()
+    assert st["requests"] == len(admitted)
+    assert st["shed"] == sheds_seen                     # caller view == engine
+
+
+def test_shed_accounting_matches_telemetry_request_events(tmp_path):
+    """OverloadedError accounting must tie out EXACTLY across all three
+    ledgers the fleet reconciles: exceptions callers caught, the engine's
+    stats counters, and the telemetry ``request`` events dlstatus reads
+    (ISSUE 6 satellite — a mismatch makes the --fleet-serve shed rate a
+    lie)."""
+    from distributeddeeplearningspark_tpu import telemetry
+
+    eng = _mk_engine(max_queue=3, max_batch=2, max_wait_ms=0.5,
+                     workdir=str(tmp_path))
+    admitted = [eng.submit({"x": np.float32(i)}) for i in range(3)]
+    caught = []
+    for i in range(4):
+        with pytest.raises(OverloadedError) as ei:
+            eng.submit({"x": np.float32(50.0 + i)})
+        caught.append(ei.value)
+    assert all(e.queue_depth == 3 and e.max_queue == 3 for e in caught)
+    with eng:
+        pass                                    # context exit drains the 3
+    for f in admitted:
+        f.result(30)
+
+    st = eng.stats()
+    assert st["requests"] == 3 and st["shed"] == len(caught) == 4
+    evs = [e for e in telemetry.read_events(tmp_path)
+           if e.get("kind") == "request"]
+    ok = [e for e in evs if e["outcome"] == "ok"]
+    shed = [e for e in evs if e["outcome"] == "shed"]
+    assert len(evs) == len(ok) + len(shed)      # no third outcome leaked
+    assert len(ok) == st["requests"] == 3
+    assert len(shed) == st["shed"] == 4
+    # every shed event carries the full-queue evidence and its own id —
+    # ids disjoint from the served ones (an id in both = double-counted)
+    assert all(e["queue_depth"] == 3 for e in shed)
+    assert {e["id"] for e in ok}.isdisjoint({e["id"] for e in shed})
+    telemetry.reset()
+
+
 class _EngineDouble:
     def __init__(self):
         self.swaps = []

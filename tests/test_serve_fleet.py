@@ -1,0 +1,522 @@
+"""Serving fleet subsystem (ISSUE 6): paged KV arena first-fit/refcount
+discipline, prefix-cache sharing semantics, paged-vs-dense token identity,
+router placement/tenant budgets/failover, rolling hot-reload with zero
+dropped requests, the dlstatus --fleet-serve rollup, and (slow tier) the
+real multi-process replica fleet."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu.serve import (
+    ContinuousGenerator,
+    InferenceEngine,
+    LocalReplica,
+    NoReplicaError,
+    OverloadedError,
+    PagedKVArena,
+    PrefixCache,
+    Router,
+)
+
+# -- paged KV arena -----------------------------------------------------------
+
+
+class TestPagedKVArena:
+    def test_first_fit_reuses_lowest_freed_page(self):
+        """Out-of-order release + first-fit: the hole opened by freeing a
+        LOW page is refilled by the very next allocation (the workers.py
+        shm discipline, page-granular)."""
+        a = PagedKVArena(num_pages=8, page_size=4)
+        p1 = a.alloc(3)
+        p2 = a.alloc(2)
+        assert p1 == [1, 2, 3] and p2 == [4, 5]       # page 0 reserved
+        a.release([2])                                 # hole mid-pool
+        assert a.alloc(2) == [2, 6]                    # hole refilled first
+        assert a.pages_used == 6
+
+    def test_refcounts_share_and_free(self):
+        a = PagedKVArena(num_pages=6, page_size=4)
+        pages = a.alloc(2)
+        a.retain(pages)                                # a second reader
+        assert a.release(pages) == 0                   # still referenced
+        assert a.pages_used == 2
+        assert a.release(pages) == 2                   # last ref frees
+        assert a.pages_used == 0
+
+    def test_exhaustion_returns_none_and_counts(self):
+        a = PagedKVArena(num_pages=4, page_size=4)
+        assert a.alloc(3) is not None
+        assert a.alloc(1) is None
+        assert a.alloc_failures == 1
+        assert a.stats()["kv_page_occupancy"] == 1.0
+
+    def test_misuse_guards(self):
+        a = PagedKVArena(num_pages=4, page_size=4)
+        with pytest.raises(ValueError):
+            a.release([1])
+        with pytest.raises(ValueError):
+            a.retain([1])
+        with pytest.raises(ValueError):
+            PagedKVArena(num_pages=1, page_size=4)
+
+
+class TestPrefixCache:
+    def _prompt(self, n, seed=0):
+        return np.random.default_rng(seed).integers(
+            0, 100, (n,)).astype(np.int32)
+
+    def test_register_all_depths_then_hit_at_divergence(self):
+        """Two prompts share 8 of 12 tokens (page 4): the second must hit
+        at the SHARED depth (2 pages), not the registrant's full depth."""
+        a = PagedKVArena(num_pages=16, page_size=4)
+        c = PrefixCache(a)
+        p1 = self._prompt(12, seed=1)
+        pages = a.alloc(3)
+        assert c.register(p1, pages, version=0) == 3   # depths 1..3
+        p2 = np.concatenate([p1[:8], self._prompt(6, seed=2)])
+        n, shared = c.lookup(p2, version=0)
+        assert n == 2 and shared == pages[:2]
+        c.record(n * 4)
+        assert c.hits == 1 and c.tokens_saved == 8
+        # full-prompt lookup caps at len-1: an identical prompt reuses at
+        # most 2 pages (one real token must remain to prefill)
+        n3, _ = c.lookup(p1, version=0)
+        assert n3 == 2
+
+    def test_version_mismatch_misses(self):
+        a = PagedKVArena(num_pages=16, page_size=4)
+        c = PrefixCache(a)
+        p = self._prompt(12)
+        c.register(p, a.alloc(2), version=0)
+        n, _ = c.lookup(np.concatenate([p, p]), version=1)
+        assert n == 0
+
+    def test_flush_and_lru_eviction_free_pages(self):
+        a = PagedKVArena(num_pages=16, page_size=4)
+        c = PrefixCache(a)
+        p1, p2 = self._prompt(8, seed=1), self._prompt(8, seed=2)
+        g1, g2 = a.alloc(2), a.alloc(2)
+        c.register(p1, g1, version=0)
+        c.register(p2, g2, version=0)
+        a.release(g1)
+        a.release(g2)                                  # cache holds the refs
+        assert a.pages_used == 4
+        n, got = c.lookup(np.concatenate([p2, p2]), version=0)  # p2 now MRU
+        assert n == 2
+        c.evict_until(a.pages_free + 2)                # evicts LRU = p1's
+        # only p2's 2 distinct pages survive: its cache entries and the
+        # lookup's retain share the SAME pages (refcounts, not copies)
+        assert a.pages_used == 2
+        a.release(got)
+        c.flush()
+        assert a.pages_used == 0
+
+
+# -- paged decode: token identity + prefix reuse ------------------------------
+
+
+@pytest.fixture(scope="module")
+def nano_llama_fleet():
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=128,
+                      max_position=64, dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 128, (n,)).astype(np.int32)
+               for n in (5, 7, 6, 4)]
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": prompts[0][None]},
+                        train=False)["params"]
+    return cfg, params, prompts, rng
+
+
+def test_paged_arena_token_identical_to_fixed_slot_pool(nano_llama_fleet):
+    """The acceptance pin: the SAME requests through the dense PR 4 pool
+    and the paged arena produce identical tokens — paging is a memory
+    discipline, not a numerics change (gathers are exact; garbage beyond a
+    row's length is masked to exactly-zero weight either way)."""
+    cfg, params, prompts, _ = nano_llama_fleet
+    dense = ContinuousGenerator(cfg, params, slots=2, max_cache_len=32,
+                                prompt_buckets=(8, 16))
+    with dense:
+        ref = [dense.generate(p, 6) for p in prompts]
+    paged = ContinuousGenerator(cfg, params, slots=2, max_cache_len=32,
+                                prompt_buckets=(8, 16), page_size=8)
+    with paged:
+        futs = [paged.submit(p, 6) for p in prompts]
+        out = [f.result(300) for f in futs]
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(r, o)
+    st = paged.stats()
+    assert st["completed"] == 4
+    # every slot's pages reclaimed (prompts < page_size register nothing)
+    assert st["kv_pages_used"] == 0 and st["kv_page_allocs"] > 0
+
+
+def test_prefix_cache_reuses_pages_and_matches_dense(nano_llama_fleet):
+    """Prefix-heavy workload (shared 16-token system prompt): later
+    requests hit the cache, skip re-prefilling the shared pages, and still
+    produce exactly the dense pool's tokens. The ≥2× prefill-savings
+    acceptance: ≥half the prompt tokens are served from cached pages."""
+    cfg, params, _, rng = nano_llama_fleet
+    system = rng.integers(0, 128, (16,)).astype(np.int32)
+    prompts = [np.concatenate([system,
+                               rng.integers(0, 128, (4,)).astype(np.int32)])
+               for _ in range(4)]
+    paged = ContinuousGenerator(cfg, params, slots=2, max_cache_len=64,
+                                prompt_buckets=(8, 16, 24, 32), page_size=8)
+    with paged:
+        out = [paged.generate(p, 5) for p in prompts]
+    dense = ContinuousGenerator(cfg, params, slots=2, max_cache_len=64,
+                                prompt_buckets=(8, 16, 24, 32))
+    with dense:
+        ref = [dense.generate(p, 5) for p in prompts]
+    for a, b in zip(out, ref):
+        np.testing.assert_array_equal(a, b)
+    st = paged.stats()
+    assert st["prefix_hits"] == 3 and st["prefix_misses"] == 1
+    # request 1 prefills all 20 prompt tokens; 2..4 reuse 16 each
+    assert st["prefix_tokens_saved"] == 48
+    total_prompt = sum(p.size for p in prompts)
+    assert st["prefix_tokens_saved"] >= total_prompt / 2   # ≥2× savings
+    assert st["prefix_entries"] == 2                       # depths 1..2
+
+
+def test_paged_admission_defers_under_page_pressure(nano_llama_fleet):
+    """An arena sized for ~one long sequence: concurrent requests admit
+    one at a time (deferred, not failed), every future still completes,
+    and the pool is fully reclaimed afterwards."""
+    cfg, params, _, rng = nano_llama_fleet
+    prompts = [rng.integers(0, 128, (9,)).astype(np.int32)
+               for _ in range(3)]
+    gen = ContinuousGenerator(cfg, params, slots=3, max_cache_len=32,
+                              prompt_buckets=(16,), page_size=8,
+                              kv_pages=6, prefix_cache=False)
+    with gen:
+        futs = [gen.submit(p, 12) for p in prompts]
+        res = [f.result(300) for f in futs]
+    assert all(r.shape == (12,) for r in res)
+    st = gen.stats()
+    assert st["completed"] == 3
+    assert st["deferred"] >= 1            # pressure actually happened
+    assert st["kv_pages_used"] == 0       # all reclaimed
+
+
+def test_swap_params_flushes_prefix_cache(nano_llama_fleet):
+    """A hot-reload makes cached prefix K/V stale: the flush must happen
+    before the next admission can hit it."""
+    import jax
+
+    cfg, params, _, rng = nano_llama_fleet
+    system = rng.integers(0, 128, (16,)).astype(np.int32)
+    mk = lambda: np.concatenate(  # noqa: E731
+        [system, rng.integers(0, 128, (4,)).astype(np.int32)])
+    gen = ContinuousGenerator(cfg, params, slots=2, max_cache_len=64,
+                              prompt_buckets=(8, 16, 24, 32), page_size=8)
+    with gen:
+        gen.generate(mk(), 3)
+        gen.generate(mk(), 3)
+        assert gen.stats()["prefix_hits"] == 1
+        gen.swap_params(jax.tree.map(lambda x: x * 1.01, params))
+        gen.generate(mk(), 3)             # post-swap: stale entries flushed
+        st = gen.stats()
+    assert st["prefix_hits"] == 1 and st["prefix_misses"] == 2
+    assert st["reloads"] == 1
+
+
+# -- router -------------------------------------------------------------------
+
+
+class _FakeReplica:
+    """Handle double with a controllable future queue."""
+
+    def __init__(self, name):
+        self.name = name
+        self.alive = True
+        self.submitted = []
+
+    def submit(self, payload, op="infer"):
+        from concurrent.futures import Future
+
+        fut = Future()
+        self.submitted.append((payload, op, fut))
+        return fut
+
+
+def test_router_places_by_queue_depth_and_p99():
+    """Dispatch minimizes (outstanding+1)×p99: a slow replica attracts
+    less load the moment its completions come back slow."""
+    fast, slow = _FakeReplica("fast"), _FakeReplica("slow")
+    r = Router([fast, slow], p99_window=8)
+    # seed latency history: resolve one request from each at skewed speed
+    for rep, lat in ((fast, 0.001), (slow, 0.1)):
+        f = r.submit({"x": 1})
+        payload, op, inner = rep.submitted[-1] if rep.submitted else (None,) * 3
+        # resolve whichever replica got it; force history by direct append
+    # deterministic: install latency history directly
+    r._lat["fast"].extend([0.001] * 8)
+    r._lat["slow"].extend([0.100] * 8)
+    for _ in range(10):
+        r.submit({"x": 1})
+    # all outstanding; fast should have absorbed ~10× slow's share
+    assert len(fast.submitted) > len(slow.submitted)
+    st = r.stats()
+    assert st["dispatched"] == 12
+    assert st["replicas"]["fast"]["recent_p99_ms"] == 1.0
+
+
+def test_router_tenant_budget_sheds_typed_with_telemetry(tmp_path):
+    """Per-tenant budgets: the over-budget tenant sheds with the typed
+    error AND a telemetry request event naming it; other tenants admit."""
+    from distributeddeeplearningspark_tpu import telemetry
+
+    rep = _FakeReplica("r0")
+    r = Router([rep], default_tenant_budget=2, workdir=str(tmp_path))
+    r.submit({"x": 1}, tenant="greedy")
+    r.submit({"x": 2}, tenant="greedy")
+    with pytest.raises(OverloadedError):
+        r.submit({"x": 3}, tenant="greedy")
+    r.submit({"x": 4}, tenant="polite")    # different tenant: admitted
+    assert r.stats()["shed_tenant"] == 1
+    r._tele.close()
+    evs = [e for e in telemetry.read_events(tmp_path)
+           if e.get("kind") == "request"]
+    assert len(evs) == 1
+    assert evs[0]["outcome"] == "shed" and evs[0]["tenant"] == "greedy"
+    assert evs[0]["process"] == "router"
+
+    # budget releases when requests complete
+    for payload, op, fut in rep.submitted:
+        fut.set_result({"ok": True})
+    deadline = time.monotonic() + 5
+    while r.stats()["tenants"].get("greedy") and time.monotonic() < deadline:
+        time.sleep(0.005)
+    r.submit({"x": 5}, tenant="greedy")    # admitted again
+
+
+def test_router_fails_over_on_replica_death():
+    """A replica dying mid-request re-dispatches to a survivor; the dead
+    one stops being a candidate."""
+    from distributeddeeplearningspark_tpu.serve.router import ReplicaDiedError
+
+    a, b = _FakeReplica("a"), _FakeReplica("b")
+    r = Router([a, b])
+    futs = [r.submit({"x": i}) for i in range(4)]
+    victim, survivor = (a, b) if a.submitted else (b, a)
+    victim.alive = False
+    for payload, op, fut in list(victim.submitted):
+        fut.set_exception(ReplicaDiedError("gone"))
+    # every re-dispatched request landed on the survivor
+    for payload, op, fut in list(survivor.submitted):
+        if not fut.done():
+            fut.set_result({"y": 0})
+    for f in futs:
+        assert f.result(10) == {"y": 0} or f.result(10)["ok"]
+    assert r.stats()["failovers"] >= 1
+    assert len(survivor.submitted) == 4
+
+
+def test_router_drain_guard_and_no_replica_error():
+    a, b = _FakeReplica("a"), _FakeReplica("b")
+    r = Router([a, b])
+    r.drain("a")
+    with pytest.raises(RuntimeError, match="zero serving"):
+        r.drain("b")
+    r.undrain("a")
+    a.alive = b.alive = False
+    with pytest.raises(NoReplicaError):
+        r.submit({"x": 1})
+
+
+# -- rolling reload (in-process fleet) ----------------------------------------
+
+
+def test_rolling_reload_zero_dropped_in_process():
+    """Two engine replicas under concurrent load; a rolling drain→swap→
+    undrain across both completes with every request answered and both
+    replicas on new params — the zero-global-downtime contract, minus the
+    process boundary (the slow tier + CI smoke cover that)."""
+    import jax.numpy as jnp
+
+    def fwd(params, batch):
+        return {"y": batch["x"] * params["w"]}
+
+    engines = [InferenceEngine(fwd, {"w": jnp.float32(1.0)}, max_batch=4,
+                               max_wait_ms=1.0, max_queue=4096,
+                               name=f"e{i}").start()
+               for i in range(2)]
+    reps = [LocalReplica(f"r{i}", e,
+                         reload_fn=lambda n: {"w": jnp.float32(100.0 + n)})
+            for i, e in enumerate(engines)]
+    router = Router(reps)
+    stop = threading.Event()
+    futs, lock = [], threading.Lock()
+
+    def client():
+        while not stop.is_set():
+            try:
+                f = router.submit({"example": {"x": np.float32(1.0)}})
+            except OverloadedError:
+                continue
+            with lock:
+                futs.append(f)
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while router.stats()["dispatched"] < 8 \
+                and time.monotonic() < deadline:
+            time.sleep(0.002)
+        # the rolling reload: one replica at a time
+        for rep in reps:
+            router.drain(rep.name)
+            while router.inflight(rep.name) > 0:
+                time.sleep(0.001)
+            rep.call("reload")
+            router.undrain(rep.name)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    res = [float(f.result(30)["y"]) for f in futs]
+    assert len(res) == len(futs)                      # zero dropped
+    assert set(res) <= {1.0, 101.0}                   # old or new, never torn
+    assert 101.0 in res                               # reload actually landed
+    for e in engines:
+        assert e.params_version == 1
+        e.stop()
+
+
+# -- dlstatus --fleet-serve ----------------------------------------------------
+
+
+def test_dlstatus_fleet_serve_rollup(tmp_path, capsys):
+    """Synthetic two-replica stream (+ router sheds) through the
+    --fleet-serve report: per-replica p99/shed rate/KV occupancy/prefix
+    hit rate, and fleet totals."""
+    import json
+
+    from distributeddeeplearningspark_tpu import status, telemetry
+
+    for proc, base in (("p0", 0.010), ("p1", 0.020)):
+        w = telemetry.EventWriter(tmp_path, process=proc, host=None,
+                                  clock=lambda: 1.0)
+        w.emit_many("request", [
+            dict(engine="tinyllama", id=i, outcome="ok",
+                 latency_s=base * (1 + i), queue_wait_s=0.001, batch_size=2)
+            for i in range(5)])
+        w.emit("request", engine="tinyllama", id=99, outcome="shed",
+               queue_depth=3)
+        w.emit("serve", engine="tinyllama", kv_pages_total=12,
+               kv_pages_used=6, kv_page_occupancy=0.5, prefix_hits=3,
+               prefix_misses=1, prefix_hit_rate=0.75,
+               prefix_tokens_saved=48, active=2)
+        w.close()
+    wr = telemetry.EventWriter(tmp_path, process="router", host=None,
+                               clock=lambda: 1.0)
+    wr.emit("request", engine="router", outcome="shed", tenant="greedy")
+    wr.close()
+
+    rep = status.report(str(tmp_path), fleet_serve=True)
+    fs = rep["fleet_serve"]
+    assert [r["process"] for r in fs["replicas"]] == ["p0", "p1", "router"]
+    p0 = fs["replicas"][0]
+    assert p0["ok"] == 5 and p0["shed"] == 1
+    assert p0["shed_rate"] == pytest.approx(1 / 6)
+    assert p0["latency_p99_s"] == pytest.approx(0.050)
+    assert p0["kv_page_occupancy"] == 0.5
+    assert p0["prefix_hit_rate"] == 0.75
+    t = fs["totals"]
+    assert t["requests"] == 13 and t["ok"] == 10 and t["shed"] == 3
+    assert t["prefix_hits"] == 6 and t["prefix_hit_rate"] == 0.75
+    assert t["prefix_tokens_saved"] == 96
+    assert t["kv_page_occupancy_max"] == 0.5
+
+    assert status.main([str(tmp_path), "--fleet-serve"]) == 0
+    out = capsys.readouterr().out
+    assert "serving fleet: 3 process(es)" in out
+    assert "prefix hit rate 75%" in out
+    # no serve traffic at all → key is None, render skips the section
+    empty = tmp_path / "empty"
+    w = telemetry.EventWriter(empty, process="p0", clock=lambda: 1.0)
+    w.heartbeat(step=0)
+    w.close()
+    assert status.report(str(empty), fleet_serve=True)["fleet_serve"] is None
+    import json as _json  # noqa: F401 — keep the --json path covered too
+    assert status.main([str(tmp_path), "--fleet-serve", "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["fleet_serve"]["totals"]["prefix_tokens_saved"] == 96
+
+
+# -- the real thing: replica processes (slow tier) ----------------------------
+
+
+@pytest.mark.slow
+def test_fleet_processes_end_to_end(tmp_path):
+    """2 lenet replica PROCESSES (gang env contract): infer through the
+    router, per-replica telemetry in ONE workdir, a rolling reload with
+    zero dropped requests, and a kill → route-around → restart drill."""
+    from distributeddeeplearningspark_tpu import status
+    from distributeddeeplearningspark_tpu.serve.fleet import ServingFleet
+
+    rng = np.random.default_rng(0)
+
+    def payload(i):
+        return {"example": {
+            "image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32)}}
+
+    spec = {"model": "lenet", "seed": 0, "max_batch": 8, "max_queue": 4096,
+            "warmup": False}
+    with ServingFleet(spec, replicas=2, workdir=str(tmp_path)) as fleet:
+        router = fleet.router()
+        futs = [router.submit(payload(i)) for i in range(16)]
+        res = [f.result(120) for f in futs]
+        assert len(res) == 16
+        assert all("logits" in r or r is not None for r in res)
+
+        # rolling reload mid-traffic
+        futs = [router.submit(payload(i)) for i in range(16)]
+        recs = fleet.rolling_reload(router)
+        assert [r["replica"] for r in recs] == ["r0", "r1"]
+        assert all(r["params_version"] == 1 for r in recs)
+        for f in futs:
+            f.result(120)                  # zero dropped across the reload
+
+        # replica death: kill r0, requests route around it, restart brings
+        # it back under the same name
+        fleet.handles[0].proc.kill()
+        fleet.handles[0].proc.wait()
+        deadline = time.monotonic() + 10
+        while fleet.handles[0].alive and time.monotonic() < deadline:
+            time.sleep(0.05)
+        futs = [router.submit(payload(i)) for i in range(8)]
+        for f in futs:
+            f.result(120)                  # survivors absorbed the load
+        assert fleet.restart_dead(router) == ["r0"]
+        assert fleet.handles[0].alive
+        fut = router.submit(payload(0))
+        fut.result(120)
+
+    rep = status.report(str(tmp_path), fleet_serve=True)
+    fs = rep["fleet_serve"]
+    procs = {r["process"] for r in fs["replicas"]}
+    assert {"p0", "p1"} <= procs           # both replicas left events
+    assert fs["totals"]["ok"] >= 41
+    recov = [e for e in rep["recovery_events"]
+             if e.get("event") in ("rolling-reload", "replica-restart")]
+    assert {e["event"] for e in recov} == {"rolling-reload",
+                                           "replica-restart"}

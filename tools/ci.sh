@@ -208,6 +208,57 @@ print("rps=%s seq=%s speedup=%s reloads=%s p50=%sms"
   return $rc
 }
 
+# fleet-serve smoke (ISSUE 6 satellite): 2 tinyllama replica PROCESSES
+# (paged KV arena + prefix cache) behind the router under concurrent
+# synthetic load sharing a system prompt, one rolling hot-reload
+# mid-traffic. Asserts zero dropped in-flight requests, >=1 prefix-cache
+# hit, both replicas reloaded, and the `dlstatus --fleet-serve` JSON schema.
+run_fleet_serve_smoke() {
+  local t0 rc wd out
+  t0=$(date +%s)
+  rc=0
+  wd=$(mktemp -d /tmp/dls_fleet_smoke.XXXXXX)
+  out=$( (python -m distributeddeeplearningspark_tpu.serve.cli \
+          --model tinyllama --replicas 2 --rolling-reload \
+          --clients 4 --requests-per-client 4 --tenants 2 \
+          --prefix-tokens 32 --suffix-tokens 8 --max-new-tokens 8 \
+          --workdir "$wd" 2>"$wd/dlserve.log" \
+        && python -m distributeddeeplearningspark_tpu.status "$wd" \
+             --fleet-serve --json) \
+        | python -c '
+import json, sys
+lines = sys.stdin.read().strip().splitlines()
+serve, stat = json.loads(lines[0]), json.loads(lines[-1])
+e = serve["extra"]
+assert e["requests_dropped"] == 0 and e["requests_failed"] == 0, e
+assert e["rolling_reload"]["performed"], e["rolling_reload"]
+assert e["rolling_reload"]["replicas_reloaded"] == 2, e["rolling_reload"]
+assert e["prefix"]["hits"] >= 1, e["prefix"]
+fs = stat["fleet_serve"]
+assert fs is not None, "dlstatus --fleet-serve found no serving events"
+procs = {r["process"] for r in fs["replicas"]}
+assert {"p0", "p1"} <= procs, procs
+for r in fs["replicas"]:
+    for k in ("requests", "ok", "shed", "shed_rate", "latency_p50_s",
+              "latency_p99_s"):
+        assert k in r, (k, r)
+t = fs["totals"]
+for k in ("requests", "ok", "shed", "prefix_hits", "prefix_hit_rate",
+          "prefix_tokens_saved", "kv_page_occupancy_max"):
+    assert k in t, (k, t)
+assert t["ok"] >= 16, t
+print("rps=%s ok=%s dropped=0 reloads=%s prefix_hits=%s hit_rate=%s"
+      % (serve["value"], t["ok"],
+         e["rolling_reload"]["replicas_reloaded"],
+         t["prefix_hits"], t["prefix_hit_rate"]))
+') || { rc=$?; tail -5 "$wd/dlserve.log" 2>/dev/null; }
+  log fleet-serve "${out:-fleet-serve smoke failed}" "${rc}" \
+    $(( $(date +%s) - t0 ))
+  echo "[fleet-serve] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$wd"
+  return $rc
+}
+
 overall=0
 case "${1:-both}" in
   fast) run_tier fast "not slow" || overall=$? ;;
@@ -225,6 +276,9 @@ case "${1:-both}" in
   hosts) run_hosts_smoke || overall=$? ;;
   # serving: train→serve→hot-reload end-to-end on CPU LeNet (docs/SERVING.md)
   serve) run_serve_smoke || overall=$? ;;
+  # serving fleet: 2 replica processes + router + rolling reload + paged
+  # KV/prefix cache, zero dropped requests (docs/SERVING.md "Fleet")
+  fleet-serve) run_fleet_serve_smoke || overall=$? ;;
   # input pipeline: 2-worker pool beats the serial map on a synthetic JPEG
   # corpus, and telemetry carries the per-worker gauges (docs/PERFORMANCE.md)
   input) run_input_smoke || overall=$? ;;
@@ -232,6 +286,6 @@ case "${1:-both}" in
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|input|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|input|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
