@@ -112,6 +112,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--suffix-tokens", type=int, default=8,
                    help="tinyllama: per-request unique prompt tail")
     p.add_argument("--max-new-tokens", type=int, default=8)
+    p.add_argument("--fault-sleep-ms", type=float, default=0.0,
+                   help="fleet mode drill: make ONE replica slow by "
+                        "sleeping this long before every decode step — "
+                        "the deterministic fault the SLO sentinel smoke "
+                        "injects (dlstatus --slo flips its verdict, "
+                        "--traces names the slow replica's decode stage)")
+    p.add_argument("--fault-replica", type=int, default=0,
+                   help="which replica --fault-sleep-ms slows (default 0)")
     return p
 
 
@@ -297,6 +305,8 @@ def fleet_main(args) -> int:
         "page_size": args.page_size,
         "gauge_interval_s": 0.5,
         "pin_cores": args.pin_cores,
+        **({"step_delay_ms": {str(args.fault_replica): args.fault_sleep_ms}}
+           if args.fault_sleep_ms else {}),
     }
     payload_fn, op = _fleet_payload_fn(args)
     print(f"dlserve: launching {args.replicas} {args.model} replica(s), "
@@ -421,10 +431,23 @@ def main(argv: list[str] | None = None) -> int:
         build_parser().error("--model tinyllama runs in fleet mode "
                              "(--replicas N)")
     fleet_flags = args.rolling_reload or args.compare_single_replica \
-        or args.pin_cores or args.tenant_budget is not None
+        or args.pin_cores or args.tenant_budget is not None \
+        or args.fault_sleep_ms
     if fleet_flags and not args.replicas:
         build_parser().error("--rolling-reload/--compare-single-replica/"
-                             "--pin-cores/--tenant-budget need --replicas N")
+                             "--pin-cores/--tenant-budget/--fault-sleep-ms "
+                             "need --replicas N")
+    if args.fault_sleep_ms < 0:
+        # a negative sleep would reach time.sleep() inside the replica's
+        # decode loop and kill its serving thread with a ValueError
+        build_parser().error("--fault-sleep-ms must be >= 0")
+    if args.fault_sleep_ms and not (0 <= args.fault_replica < args.replicas):
+        # an out-of-range id would make the drill a silent no-op: every
+        # replica healthy, the SLO verdict GOOD, and the operator
+        # concluding the sentinel tolerates a fault that never ran
+        build_parser().error(
+            f"--fault-replica {args.fault_replica} is out of range for "
+            f"--replicas {args.replicas}")
     if args.replicas:
         if args.watch or args.compare_sequential:
             build_parser().error("--watch/--compare-sequential are the "

@@ -40,6 +40,7 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.serve")
 
@@ -69,6 +70,8 @@ class _Request:
     example: dict[str, np.ndarray]
     future: Future = field(default_factory=Future)
     t_submit: float = 0.0
+    ts_submit: float = 0.0                 # wall-clock twin (span t0)
+    trace: dict | None = None              # upstream trace context
 
 
 def default_buckets(max_batch: int, *, multiple_of: int = 1) -> tuple[int, ...]:
@@ -183,6 +186,8 @@ class InferenceEngine:
         self._stats = {"requests": 0, "shed": 0, "errors": 0, "batches": 0,
                        "rows": 0, "reloads": 0}
         self._bucket_counts: dict[int, int] = {}
+        self._last_hb = 0.0
+        self.heartbeat_interval_s = 1.0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -213,6 +218,8 @@ class InferenceEngine:
                 for req in self._queue:
                     req.future.set_exception(
                         EngineStoppedError("engine stopped before dispatch"))
+                    if self._tele is not None:
+                        self._tele.clear_span(("req", req.rid))
                 self._queue.clear()
             self._cond.notify_all()
             thread = self._thread
@@ -228,15 +235,25 @@ class InferenceEngine:
 
     # -- client surface ------------------------------------------------------
 
-    def submit(self, example: dict[str, Any]) -> Future:
+    def submit(self, example: dict[str, Any], *,
+               trace: dict | None = None) -> Future:
         """Enqueue one example; returns a Future resolving to its output row.
+
+        ``trace`` is an upstream trace context (``{"trace_id",
+        "parent_id"}`` — the router hands it across the replica socket);
+        the request's ``queue``/``infer`` stage spans then join that trace.
+        Without one (and with a workdir bound) the engine roots a fresh
+        trace per request, so a bare engine is traceable too.
 
         Raises :class:`OverloadedError` immediately when the queue is full
         (load shed — the caller owns the retry policy) and
         :class:`EngineStoppedError` when the engine isn't running."""
         req = _Request(rid=next(self._rid),
-                       example={k: np.asarray(v) for k, v in example.items()})
+                       example={k: np.asarray(v) for k, v in example.items()},
+                       trace=(trace if isinstance(trace, dict)
+                              and trace.get("trace_id") else None))
         req.t_submit = time.monotonic()
+        req.ts_submit = time.time()
         with self._cond:
             if self._stopped:
                 raise EngineStoppedError("engine is stopped")
@@ -245,10 +262,20 @@ class InferenceEngine:
                 if self._tele is not None:
                     self._tele.emit("request", engine=self.name, id=req.rid,
                                     outcome="shed",
-                                    queue_depth=len(self._queue))
+                                    queue_depth=len(self._queue),
+                                    **({"trace": req.trace["trace_id"]}
+                                       if req.trace else {}))
                 raise OverloadedError(len(self._queue), self.max_queue)
             self._queue.append(req)
             self._stats["requests"] += 1
+            if self._tele is not None:
+                # liveness note only (no write): heartbeats name the
+                # oldest in-flight request so a wedged batch localizes
+                # like a wedged restore. MUST happen under the lock —
+                # once it drops, the dispatcher can complete the request
+                # and clear_span BEFORE a late note re-inserts it, which
+                # would leave a forever-open "request" on every heartbeat
+                self._tele.note_span(("req", req.rid), "request")
             self._cond.notify_all()
         return req.future
 
@@ -347,6 +374,49 @@ class InferenceEngine:
             return put_global(batch, self.mesh)
         return batch  # jit's default placement
 
+    def _maybe_heartbeat(self) -> None:
+        """A liveness stamp per batch (rate-limited): its open-span
+        enrichment is what lets a replica wedged INSIDE a forward be
+        localized — the heartbeat before the dispatch is the stream's
+        last record, and it names the oldest in-flight request."""
+        if self._tele is None:
+            return
+        now = time.monotonic()
+        if now - self._last_hb < self.heartbeat_interval_s:
+            return
+        self._last_hb = now
+        self._tele.heartbeat()
+
+    def _emit_spans(self, reqs: list[_Request], wts0: float, wts1: float,
+                    *, n: int, bucket: int | None, outcome: str,
+                    error: str | None = None) -> None:
+        """The per-request span trees of one batch, ONE emit_many flush:
+        ``queue`` (submit → batch collect) + ``infer`` (the jitted
+        forward), children of the upstream trace context when the request
+        carried one (router/fleet path) or of a fresh per-request root
+        span otherwise."""
+        if self._tele is None:
+            return
+        recs: list[dict] = []
+        for r in reqs:
+            buf = trace_lib.SpanBuffer.from_context(r.trace)
+            parent = buf.parent_id
+            if not buf.joined:
+                parent = buf.add("request", r.ts_submit, wts1,
+                                 engine=self.name, outcome=outcome,
+                                 **({"error": error} if error else {}))
+            # queue starts at the ROUTER's accept time when the context
+            # carries one: socket transit + dispatch bookkeeping are
+            # queueing from the request's point of view, not lost coverage
+            buf.add("queue", trace_lib.SpanBuffer.upstream_t0(
+                r.trace, r.ts_submit), wts0, parent_id=parent)
+            buf.add("infer", wts0, wts1, parent_id=parent,
+                    batch_size=n,
+                    **({"bucket": bucket} if bucket is not None else {}),
+                    **({"error": error} if error else {}))
+            recs.extend(buf.records)
+        self._tele.emit_many("span", recs)
+
     def _loop(self) -> None:
         jax = self._jax
         while True:
@@ -356,7 +426,9 @@ class InferenceEngine:
             reqs, params = got
             n = len(reqs)
             bucket = self._bucket(n)
+            self._maybe_heartbeat()
             t0 = time.monotonic()
+            wts0 = time.time()
             try:
                 stacked = {
                     k: np.stack([r.example[k] for r in reqs])
@@ -385,10 +457,18 @@ class InferenceEngine:
                 # one event PER request (the schema dlstatus counts by),
                 # not one per batch — an error's blast radius is its batch
                 if self._tele is not None:
+                    err = f"{type(e).__name__}: {e}"
                     self._tele.emit_many("request", [
                         dict(engine=self.name, id=r.rid, outcome="error",
-                             batch_size=n, error=f"{type(e).__name__}: {e}")
+                             batch_size=n, error=err,
+                             **({"trace": r.trace["trace_id"]}
+                                if r.trace else {}))
                         for r in reqs])
+                    self._emit_spans(reqs, wts0, time.time(), n=n,
+                                     bucket=bucket, outcome="error",
+                                     error=err)
+                    for r in reqs:
+                        self._tele.clear_span(("req", r.rid))
                 continue
             done_ts = time.monotonic()
             with self._cond:
@@ -408,8 +488,14 @@ class InferenceEngine:
                          queue_wait_s=round(t0 - r.t_submit, 6),
                          infer_s=round(infer_s, 6),
                          latency_s=round(done_ts - r.t_submit, 6),
-                         batch_size=n, bucket=bucket)
+                         batch_size=n, bucket=bucket,
+                         **({"trace": r.trace["trace_id"]}
+                            if r.trace else {}))
                     for r in reqs])
+                self._emit_spans(reqs, wts0, time.time(), n=n,
+                                 bucket=bucket, outcome="ok")
+                for r in reqs:
+                    self._tele.clear_span(("req", r.rid))
 
     # -- construction helpers ------------------------------------------------
 

@@ -165,6 +165,9 @@ def _build_replica(spec: dict, replica_id: int, workdir: str | None):
                 params, step0 = ck.restore_params()
         else:
             params = new_params(seed)
+        # deterministic "this replica got slow" fault (the SLO sentinel
+        # drill): spec maps replica id (str — JSON keys) → per-step sleep ms
+        delay_ms = (spec.get("step_delay_ms") or {}).get(str(replica_id), 0)
         engine = ContinuousGenerator(
             cfg, params,
             slots=int(spec.get("slots", 4)),
@@ -173,6 +176,7 @@ def _build_replica(spec: dict, replica_id: int, workdir: str | None):
             prefix_cache=bool(spec.get("prefix_cache", True)),
             max_queue=int(spec.get("max_queue", 1024)),
             gauge_interval_s=float(spec.get("gauge_interval_s", 1.0)),
+            step_delay_s=float(delay_ms) / 1e3,
             workdir=workdir, name=model_name)
 
         def warm():
@@ -277,7 +281,11 @@ def replica_main() -> int:
             if e is not None:
                 reply_err(mid, e)
             else:
-                reply(mid, ok=True, result=fut.result())
+                # ts = when the reply left the replica: the parent stamps
+                # it on the resolved future so the router can account the
+                # return hop as a trace stage (stream leg=return) — the
+                # last piece of the e2e latency the stage sum must cover
+                reply(mid, ok=True, result=fut.result(), ts=time.time())
 
         try:
             while True:
@@ -296,12 +304,17 @@ def replica_main() -> int:
                     elif op == "stats":
                         reply(mid, ok=True, result=engine.stats())
                     elif op == "infer":
-                        fut = engine.submit(msg["example"])
+                        # trace context crosses the socket as a plain
+                        # payload field: the replica's stage spans join
+                        # the router's tree (telemetry.trace)
+                        fut = engine.submit(msg["example"],
+                                            trace=msg.get("trace"))
                         fut.add_done_callback(
                             lambda f, mid=mid: on_future(mid, f))
                     elif op == "generate":
                         fut = engine.submit(msg["prompt"],
-                                            msg["max_new_tokens"])
+                                            msg["max_new_tokens"],
+                                            trace=msg.get("trace"))
                         fut.add_done_callback(
                             lambda f, mid=mid: on_future(mid, f))
                     elif op == "reload":
@@ -384,6 +397,8 @@ class ReplicaHandle:
             if fut is None:
                 continue
             if msg.get("ok"):
+                if msg.get("ts") is not None:
+                    fut.dls_reply_ts = msg["ts"]  # replica send time
                 fut.set_result(msg.get("result"))
             else:
                 make = _TYPED_ERRORS.get(msg.get("etype"))
@@ -441,10 +456,12 @@ class LocalReplica:
         if not self.alive:
             raise ReplicaDiedError(f"replica {self.name} is dead")
         if op == "infer":
-            return self.engine.submit(payload["example"])
+            return self.engine.submit(payload["example"],
+                                      trace=payload.get("trace"))
         if op == "generate":
             return self.engine.submit(payload["prompt"],
-                                      payload["max_new_tokens"])
+                                      payload["max_new_tokens"],
+                                      trace=payload.get("trace"))
         fut: Future = Future()
         try:
             if op in ("stats", "ping"):

@@ -57,6 +57,7 @@ from distributeddeeplearningspark_tpu.serve.engine import (
     OverloadedError,
 )
 from distributeddeeplearningspark_tpu.serve.kv import PagedKVArena, PrefixCache
+from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.serve")
 
@@ -85,6 +86,19 @@ class _GenRequest:
     tokens: list[int] = field(default_factory=list)
     prefix_hit: bool = False                # admission reused cached pages
     prefix_tokens: int = 0                  # prompt tokens NOT re-prefilled
+    # wall-clock stage marks for the request's span tree (trace_lib):
+    # queue [ts_submit, ts_pick] → admission [ts_pick, ts_prefill0] →
+    # prefill [ts_prefill0, ts_prefill1] → decode [ts_prefill1, last
+    # token] → stream [last token, done]. token_ts records each sampled
+    # token's wall time (the per-token decode timeline).
+    trace: dict | None = None               # upstream trace context
+    ts_submit: float = 0.0
+    ts_pick: float | None = None            # first admission attempt
+    ts_prefill0: float | None = None
+    ts_prefill1: float | None = None
+    token_ts: list[float] = field(default_factory=list)
+    deferred: int = 0                       # page-pressure admission waits
+    bucket: int = 0                         # prefill pad bucket used
 
 
 class ContinuousGenerator:
@@ -131,7 +145,13 @@ class ContinuousGenerator:
         pages). Invalidated on :meth:`swap_params`.
     gauge_interval_s:
         Cadence of the ``serve`` telemetry gauge (KV occupancy, prefix
-        hit rate, active slots) when a ``workdir`` is bound.
+        hit rate, active slots) when a ``workdir`` is bound. A liveness
+        heartbeat rides the same cadence, enriched with the oldest open
+        request span so a wedged decode localizes in ``dlstatus --hosts``.
+    step_delay_s:
+        Debug/drill knob: sleep this long before every decode step — the
+        deterministic "one replica got slow" fault the SLO sentinel smoke
+        injects (``dlserve --fault-sleep-ms``). 0 (default) = off.
     """
 
     def __init__(
@@ -153,6 +173,7 @@ class ContinuousGenerator:
         kv_pages: int | None = None,
         prefix_cache: bool = True,
         gauge_interval_s: float = 5.0,
+        step_delay_s: float = 0.0,
         workdir: str | None = None,
         name: str = "generate",
     ):
@@ -196,6 +217,9 @@ class ContinuousGenerator:
         self._tele = telemetry.configure(workdir) if workdir else None
         self.gauge_interval_s = float(gauge_interval_s)
         self._last_gauge = 0.0
+        # floored: a negative delay would kill the serving thread the
+        # first time the loop hands it to time.sleep()
+        self.step_delay_s = max(0.0, float(step_delay_s))
 
         self._model = decode_model(cfg, self.max_cache_len)
         self._params = params
@@ -452,6 +476,8 @@ class ContinuousGenerator:
                 for req in self._queue:
                     req.future.set_exception(
                         EngineStoppedError("generator stopped before admission"))
+                    if self._tele is not None:
+                        self._tele.clear_span(("gen", req.rid))
                 self._queue.clear()
             self._cond.notify_all()
             thread = self._thread
@@ -468,11 +494,15 @@ class ContinuousGenerator:
     # -- client surface ------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *,
-               stream: Callable[[int], None] | None = None) -> Future:
+               stream: Callable[[int], None] | None = None,
+               trace: dict | None = None) -> Future:
         """Enqueue a prompt; Future resolves to the np.int32 token array.
 
         ``stream`` is called with each token id the step it is sampled
-        (from the serving thread — keep it cheap/non-blocking)."""
+        (from the serving thread — keep it cheap/non-blocking). ``trace``
+        is an upstream trace context (``{"trace_id", "parent_id"}``); the
+        request's stage spans — queue, admission, prefill, decode,
+        stream — then join that trace instead of rooting a fresh one."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -487,8 +517,11 @@ class ContinuousGenerator:
                 f"prompt {prompt.size} exceeds largest prompt bucket "
                 f"{self.prompt_buckets[-1]}")
         req = _GenRequest(rid=next(self._rid), prompt=prompt,
-                          max_new_tokens=int(max_new_tokens), stream=stream)
+                          max_new_tokens=int(max_new_tokens), stream=stream,
+                          trace=(trace if isinstance(trace, dict)
+                                 and trace.get("trace_id") else None))
         req.t_submit = time.monotonic()
+        req.ts_submit = time.time()
         with self._cond:
             if self._stopped:
                 raise EngineStoppedError("generator is stopped")
@@ -497,10 +530,20 @@ class ContinuousGenerator:
                 if self._tele is not None:
                     self._tele.emit("request", engine=self.name, id=req.rid,
                                     outcome="shed",
-                                    queue_depth=len(self._queue))
+                                    queue_depth=len(self._queue),
+                                    **({"trace": req.trace["trace_id"]}
+                                       if req.trace else {}))
                 raise OverloadedError(len(self._queue), self.max_queue)
             self._queue.append(req)
             self._stats["requests"] += 1
+            if self._tele is not None:
+                # liveness note (no write): heartbeats carry the oldest
+                # open request so a wedged decode localizes like a wedged
+                # restore. MUST happen under the lock — once it drops, the
+                # serving loop can finish the request and clear_span
+                # BEFORE a late note re-inserts it, leaving a forever-
+                # open "request" on every heartbeat
+                self._tele.note_span(("gen", req.rid), "request")
             self._cond.notify_all()
         return req.future
 
@@ -562,6 +605,7 @@ class ContinuousGenerator:
     def _finish(self, req: _GenRequest, *, n_active: int) -> None:
         done = time.monotonic()
         req.future.set_result(np.asarray(req.tokens, np.int32))
+        ts_done = time.time()
         with self._cond:
             self._stats["completed"] += 1
             self._stats["tokens"] += len(req.tokens)
@@ -572,13 +616,70 @@ class ContinuousGenerator:
                 queue_wait_s=round(req.t_admit - req.t_submit, 6),
                 latency_s=round(done - req.t_submit, 6),
                 batch_size=n_active,
+                **({"trace": req.trace["trace_id"]} if req.trace else {}),
                 **({"prefix_hit": req.prefix_hit,
                     "prefix_tokens": req.prefix_tokens}
                    if self.paged and self._prefix is not None else {}))
+            self._emit_request_spans(req, ts_done, outcome="ok")
+            self._tele.clear_span(("gen", req.rid))
+
+    def _emit_request_spans(self, req: _GenRequest, ts_done: float, *,
+                            outcome: str, error: str | None = None) -> None:
+        """The request's whole causal stage tree, ONE emit_many flush at
+        completion: queue → admission → prefill → decode → stream. The
+        stages tile [submit, done] by construction, so the latency
+        anatomy's coverage acceptance (Σ stages ≈ e2e) holds for every
+        request the decode pool serves."""
+        buf = trace_lib.SpanBuffer.from_context(req.trace)
+        parent = buf.parent_id
+        if not buf.joined:
+            parent = buf.add("request", req.ts_submit, ts_done,
+                             engine=self.name, outcome=outcome,
+                             **({"error": error} if error else {}))
+        ts_pick = req.ts_pick if req.ts_pick is not None else ts_done
+        # queue starts at the ROUTER's accept time when the context
+        # carries one: socket transit + dispatch bookkeeping are queueing
+        # from the request's point of view, not lost coverage
+        buf.add("queue", trace_lib.SpanBuffer.upstream_t0(
+            req.trace, req.ts_submit), ts_pick, parent_id=parent)
+        if req.ts_prefill0 is not None:
+            buf.add("admission", ts_pick, req.ts_prefill0, parent_id=parent,
+                    deferred=req.deferred, prefix_hit=req.prefix_hit,
+                    prefix_tokens=req.prefix_tokens)
+            if req.ts_prefill1 is None and error is not None:
+                # prefill itself raised: its whole elapsed time IS the
+                # prefill stage — booked anywhere else (it used to land
+                # in `stream`) the anatomy sends the operator chasing a
+                # ghost stage
+                buf.add("prefill", req.ts_prefill0, ts_done,
+                        parent_id=parent,
+                        prompt_tokens=int(req.prompt.size),
+                        bucket=req.bucket, error=error)
+                buf.flush(self._tele)
+                return
+            ts_p1 = (req.ts_prefill1 if req.ts_prefill1 is not None
+                     else req.ts_prefill0)
+            buf.add("prefill", req.ts_prefill0, ts_p1, parent_id=parent,
+                    prompt_tokens=int(req.prompt.size), bucket=req.bucket,
+                    prefix_tokens=req.prefix_tokens)
+            last_tok = req.token_ts[-1] if req.token_ts else ts_p1
+            timeline = req.token_ts[:trace_lib.MAX_TOKEN_TIMELINE]
+            buf.add("decode", ts_p1, last_tok, parent_id=parent,
+                    tokens=len(req.tokens),
+                    first_token_s=(round(req.token_ts[0] - req.ts_submit, 6)
+                                   if req.token_ts else None),
+                    token_ms=[round((t - ts_p1) * 1e3, 2) for t in timeline])
+            buf.add("stream", last_tok, ts_done, parent_id=parent)
+        elif error is not None:
+            # died before prefill: the queue span plus the error evidence
+            buf.add("admission", ts_pick, ts_done, parent_id=parent,
+                    deferred=req.deferred, error=error)
+        buf.flush(self._tele)
 
     def _emit_token(self, req: _GenRequest, tok: int) -> bool:
         """Record one sampled token; True when the sequence is complete."""
         req.tokens.append(tok)
+        req.token_ts.append(time.time())
         if req.stream is not None:
             try:
                 req.stream(tok)
@@ -604,11 +705,14 @@ class ContinuousGenerator:
         jax = self._jax
         req.t_admit = time.monotonic()
         bucket = self._bucket(req.prompt.size)
+        req.bucket = bucket
         ids = np.full((1, bucket), self.pad_id, np.int32)
         ids[0, :req.prompt.size] = req.prompt
+        req.ts_prefill0 = time.time()
         row, tok = self._prefill(params, ids,
                                  np.int32(req.prompt.size), self._split_key())
         tok = int(jax.device_get(tok)[0])
+        req.ts_prefill1 = time.time()
         with self._cond:
             self._stats["admitted"] += 1
         n_active = sum(r is not None for r in self._active) + 1
@@ -665,6 +769,7 @@ class ContinuousGenerator:
                 self._arena.release(shared)
             with self._cond:
                 self._stats["deferred"] += 1
+            req.deferred += 1
             return False
 
         pages = shared + owned
@@ -674,13 +779,16 @@ class ContinuousGenerator:
 
         req.t_admit = time.monotonic()
         req.prefix_hit, req.prefix_tokens = hit, start
+        req.bucket = rb
         ids = np.full((1, rb), self.pad_id, np.int32)
         ids[0, :rem] = req.prompt[start:]
+        req.ts_prefill0 = time.time()
         try:
             self._pool, tok = self._paged_prefill(
                 params, self._pool, self._tables[slot:slot + 1],
                 np.int32(start), ids, np.int32(plen), self._split_key())
             tok = int(jax.device_get(tok)[0])
+            req.ts_prefill1 = time.time()
         except BaseException:
             # a poisoned prompt fails ITS future in _loop — but the pages
             # just allocated/retained must go back, or every such failure
@@ -726,6 +834,10 @@ class ContinuousGenerator:
         if not force and now - self._last_gauge < self.gauge_interval_s:
             return
         self._last_gauge = now
+        # liveness stamp on the gauge cadence: when the decode loop later
+        # wedges inside a step, this heartbeat is the stream's last record
+        # and names the oldest in-flight request (note_span enrichment)
+        self._tele.heartbeat()
         fields: dict[str, Any] = {
             "engine": self.name,
             "active": sum(r is not None for r in self._active),
@@ -769,6 +881,8 @@ class ContinuousGenerator:
                     if free is None or not self._queue:
                         break
                     req = self._queue.pop(0)
+                if req.ts_pick is None:   # first admission attempt only —
+                    req.ts_pick = time.time()  # re-queues keep queue=wait
                 try:
                     admitted = self._admit(req, free, params, version)
                 except Exception as e:  # noqa: BLE001 — a poisoned prompt
@@ -776,9 +890,15 @@ class ContinuousGenerator:
                     logger.exception("prefill failed (request %d)", req.rid)
                     req.future.set_exception(e)
                     if self._tele is not None:
+                        err = f"{type(e).__name__}: {e}"
                         self._tele.emit("request", engine=self.name,
                                         id=req.rid, outcome="error",
-                                        error=f"{type(e).__name__}: {e}")
+                                        error=err,
+                                        **({"trace": req.trace["trace_id"]}
+                                           if req.trace else {}))
+                        self._emit_request_spans(req, time.time(),
+                                                 outcome="error", error=err)
+                        self._tele.clear_span(("gen", req.rid))
                     continue
                 if not admitted:
                     # arena full: the request keeps its queue position and
@@ -789,6 +909,8 @@ class ContinuousGenerator:
             self._maybe_gauge()
             if all(r is None for r in self._active):
                 continue
+            if self.step_delay_s:
+                time.sleep(self.step_delay_s)
             if self.paged:
                 self._pool, nxt = self._paged_step(
                     params, self._pool, self._tables, self._pos,

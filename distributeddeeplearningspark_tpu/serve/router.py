@@ -45,6 +45,7 @@ from typing import Any
 
 from distributeddeeplearningspark_tpu import telemetry
 from distributeddeeplearningspark_tpu.serve.engine import OverloadedError
+from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
 from distributeddeeplearningspark_tpu.telemetry.fleet import _percentile
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.serve")
@@ -187,7 +188,16 @@ class Router:
         replicas, ``"generate"`` for continuous-decode replicas); payload
         fields are the op's kwargs. Raises :class:`~.engine.OverloadedError`
         when the tenant's budget is spent (the typed shed contract) and
-        :class:`NoReplicaError` when nothing can serve."""
+        :class:`NoReplicaError` when nothing can serve.
+
+        With a workdir bound the router is the **trace root**: it mints
+        the request's ``trace_id``, stamps the trace context
+        (``{"trace_id", "parent_id"}``) into the payload so the replica's
+        stage spans join the same tree across the socket, and at
+        resolution emits the root ``request`` span (tenant/outcome/hops)
+        plus its own ``place``/``failover`` children. Tenant-budget sheds
+        are rejected before any dispatch and carry no trace — their
+        evidence is the ``request`` event."""
         budget = self.tenant_budgets.get(tenant, self.default_tenant_budget)
         with self._lock:
             out = self._tenant_out.get(tenant, 0)
@@ -202,37 +212,91 @@ class Router:
             self._rid += 1
         fut: Future = Future()
         t0 = time.monotonic()
+        ctx = None
+        if self._tele is not None:
+            ctx = {"buf": trace_lib.SpanBuffer(),
+                   "root_sid": trace_lib.new_span_id(),
+                   "ts0": time.time(), "hops": 0, "tenant": tenant,
+                   "done": False}
         try:
-            self._dispatch(payload, op, tenant, t0, fut, tried=set())
-        except BaseException:
+            self._dispatch(payload, op, tenant, t0, fut, set(), ctx)
+        except BaseException as e:
             with self._lock:
                 self._tenant_out[tenant] -= 1
+            self._finish_trace(ctx, "error", error=f"{type(e).__name__}: {e}")
             raise
         return fut
 
+    def _finish_trace(self, ctx, outcome: str, error: str | None = None,
+                      end_ts: float | None = None) -> None:
+        """Close the request's root span and flush the router's whole
+        span buffer (root + place/failover children) in ONE emit_many.
+        ``end_ts`` lets the caller share ONE timestamp between the last
+        stage span and the root's close — two adjacent ``time.time()``
+        calls can drift ms apart under GIL contention, and that drift
+        would read as unexplained latency in the anatomy's coverage."""
+        if ctx is None or ctx["done"] or self._tele is None:
+            return
+        ctx["done"] = True
+        buf = ctx["buf"]
+        buf.add("request", ctx["ts0"],
+                time.time() if end_ts is None else end_ts,
+                span_id=ctx["root_sid"], engine=self.name,
+                tenant=ctx["tenant"], outcome=outcome, hops=ctx["hops"],
+                **({"error": error} if error else {}))
+        buf.flush(self._tele)
+
     def _dispatch(self, payload, op, tenant, t0, fut: Future,
-                  tried: set[str]) -> None:
+                  tried: set[str], ctx=None) -> None:
+        tp0 = time.time() if ctx is not None else 0.0
         name = self._pick(tried)
+        if ctx is not None:
+            # t0 = when the ROUTER accepted the request: the replica's
+            # queue span starts there, so socket transit + dispatch
+            # bookkeeping are accounted as queueing, not lost coverage
+            payload = {**payload,
+                       "trace": {**ctx["buf"].context(ctx["root_sid"]),
+                                 "t0": ctx["ts0"]}}
         try:
             inner = self._replicas[name].submit(payload, op)
         except Exception as e:  # noqa: BLE001 — a handle that can't even
             # accept the request counts as a dead dispatch: fail over
             self._settle(name, None, t0)
-            self._failover(payload, op, tenant, t0, fut, tried | {name}, e)
+            self._failover(payload, op, tenant, t0, fut, tried | {name}, e,
+                           ctx, failed=name)
             return
+        if ctx is not None:
+            ctx["buf"].add("place", tp0, time.time(),
+                           parent_id=ctx["root_sid"], replica=name)
         inner.add_done_callback(
             lambda f: self._on_done(f, name, payload, op, tenant, t0, fut,
-                                    tried))
+                                    tried, ctx))
 
-    def _failover(self, payload, op, tenant, t0, fut, tried, exc) -> None:
+    def _failover(self, payload, op, tenant, t0, fut, tried, exc,
+                  ctx=None, failed: str | None = None) -> None:
         with self._lock:
             self._stats["failovers"] += 1
+        if ctx is not None:
+            ctx["hops"] += 1
+            now = time.time()
+            # a point span marking the hop: the re-dispatch's own `place`
+            # child carries where the request went next
+            ctx["buf"].add("failover", now, now, parent_id=ctx["root_sid"],
+                           from_replica=failed,
+                           error=f"{type(exc).__name__}: {exc}")
         logger.warning("router: replica failed mid-request (%s); "
                        "failing over", exc)
         try:
-            self._dispatch(payload, op, tenant, t0, fut, tried)
+            self._dispatch(payload, op, tenant, t0, fut, tried, ctx)
         except NoReplicaError:
             self._settle(None, tenant, t0)
+            # every replica refused: when the refusal was the typed shed
+            # (in-process engines raise OverloadedError from submit), the
+            # root must say shed — overload reads as capacity, not a bug
+            self._finish_trace(ctx,
+                               "shed" if isinstance(exc, OverloadedError)
+                               else "error",
+                               error=f"{type(exc).__name__}: {exc}")
             fut.set_exception(exc)
 
     def _settle(self, name: str | None, tenant: str | None, t0: float,
@@ -250,27 +314,41 @@ class Router:
                 self._tenant_out[tenant] -= 1
 
     def _on_done(self, inner: Future, name, payload, op, tenant, t0,
-                 fut: Future, tried: set[str]) -> None:
+                 fut: Future, tried: set[str], ctx=None) -> None:
         exc = inner.exception()
         if isinstance(exc, ReplicaDiedError):
             # the replica died with this request in flight: inference is
             # idempotent, so retry once per surviving replica
             self._settle(name, None, t0)
-            with self._lock:
-                self._stats["failovers"] += 1
-            try:
-                self._dispatch(payload, op, tenant, t0, fut, tried | {name})
-            except NoReplicaError:
-                self._settle(None, tenant, t0)
-                fut.set_exception(exc)
+            self._failover(payload, op, tenant, t0, fut, tried | {name},
+                           exc, ctx, failed=name)
             return
         self._settle(name, tenant, t0,
                      latency=(time.monotonic() - t0) if exc is None else None)
         with self._lock:
             self._stats["completed" if exc is None else "errors"] += 1
         if exc is not None:
+            # a replica-side OverloadedError is the typed shed contract,
+            # not a failure: the tenant folds (serving_fleet, slo_report)
+            # branch on shed vs error, and overload must read as capacity
+            self._finish_trace(ctx,
+                               "shed" if isinstance(exc, OverloadedError)
+                               else "error",
+                               error=f"{type(exc).__name__}: {exc}")
             fut.set_exception(exc)
         else:
+            now = None
+            if ctx is not None:
+                # return hop: the replica stamped when its reply left
+                # (ReplicaHandle stashes it on the future) — socket
+                # transit back + reader dispatch is stream time from the
+                # request's point of view, the last stage-sum piece
+                rts = getattr(inner, "dls_reply_ts", None)
+                if rts is not None:
+                    now = time.time()
+                    ctx["buf"].add("stream", min(float(rts), now), now,
+                                   parent_id=ctx["root_sid"], leg="return")
+            self._finish_trace(ctx, "ok", end_ts=now)
             fut.set_result(inner.result())
 
     # -- introspection -------------------------------------------------------

@@ -14,6 +14,9 @@ was productive, and what ate the rest".
     dlstatus <workdir> --json         # machine-readable report
     dlstatus <workdir> --hosts        # + per-host fleet table, skew, verdicts
     dlstatus <workdir> --fleet-serve  # + per-replica serving table
+    dlstatus <workdir> --traces       # + request latency anatomy (trace fold)
+    dlstatus <workdir> --slo 0.25     # + SLO sentinel: p99 target, burn rate
+    dlstatus <workdir> --export-trace out.json  # Chrome/Perfetto trace_event
 
 A workdir that served traffic (:mod:`..serve` — ``request`` events in the
 stream) additionally gets the serving rollup: request counts by outcome
@@ -159,11 +162,19 @@ def input_workers_from(events: list[dict]) -> dict | None:
 
 
 def report(workdir: str, *, now: float | None = None,
-           hosts: bool = False, fleet_serve: bool = False) -> dict:
+           hosts: bool = False, fleet_serve: bool = False,
+           traces: bool = False, slo_target: float | None = None,
+           slo_budget: float = 0.01,
+           events: list[dict] | None = None) -> dict:
     """The full run report as a plain dict (what ``--json`` prints).
     ``hosts=True`` adds the ``fleet`` key (per-host table, skew, verdicts);
-    ``fleet_serve=True`` adds ``fleet_serve`` (per-replica serving table)."""
-    events = telemetry.read_events(workdir)
+    ``fleet_serve=True`` adds ``fleet_serve`` (per-replica serving table);
+    ``traces=True`` adds ``traces`` (the per-stage latency anatomy);
+    ``slo_target`` (p99 seconds) adds ``slo`` (per-tenant burn rates and
+    GOOD/BURNING/EXHAUSTED verdicts against ``slo_budget``); ``events``
+    skips the stream read when the caller already holds it."""
+    if events is None:
+        events = telemetry.read_events(workdir)
     heartbeats = [e for e in events if e.get("kind") == "heartbeat"]
     # the MOST RECENT step-bearing event, not the max step: a divergence
     # rollback legitimately rewinds the step counter, and the honest "where
@@ -180,6 +191,10 @@ def report(workdir: str, *, now: float | None = None,
         **({"fleet": rep_fleet} if hosts else {}),
         **({"fleet_serve": fleet_lib.serving_fleet(events)}
            if fleet_serve else {}),
+        **({"traces": fleet_lib.latency_anatomy(events)} if traces else {}),
+        **({"slo": fleet_lib.slo_report(events, target_p99_s=slo_target,
+                                        budget=slo_budget)}
+           if slo_target is not None else {}),
         "workdir": workdir,
         "event_files": telemetry.event_files(workdir),
         "num_events": len(events),
@@ -269,7 +284,14 @@ def render_fleet_serve(fs: dict) -> list[str]:
         f"{t['ok']}/{t['requests']} requests ok"
         + (f"  prefix hit rate {_fmt_pct(t['prefix_hit_rate'])}"
            f" ({t['prefix_tokens_saved']} prompt tokens saved)"
-           if t["prefix_hit_rate"] is not None else ""))
+           if t["prefix_hit_rate"] is not None else "")
+        + (f"  failovers={t['failovers']}" if t.get("failovers") else ""))
+    if t.get("tenants"):
+        for name, row in t["tenants"].items():
+            lines.append(
+                f"  tenant {name}: {row['requests']} request(s), "
+                f"shed rate {_fmt_pct(row['shed_rate'])} "
+                f"({row['shed']} shed, {row['errors']} error(s))")
     lines.append(
         f"  {'replica':<8}  {'ok':>6}  {'shed':>5}  {'err':>4}  "
         f"{'p50':>8}  {'p99':>8}  {'shed%':>6}  {'kv occ':>6}  {'prefix':>6}")
@@ -284,6 +306,63 @@ def render_fleet_serve(fs: dict) -> list[str]:
             f"{_fmt_pct(r['shed_rate']):>6}  "
             f"{_fmt_pct(r.get('kv_page_occupancy')):>6}  "
             f"{_fmt_pct(r.get('prefix_hit_rate')):>6}")
+    return lines
+
+
+def _fmt_ms(v: float | None) -> str:
+    return "-" if v is None else f"{v * 1e3:.1f}ms"
+
+
+def render_traces(tr: dict) -> list[str]:
+    """The ``--traces`` section: per-stage latency anatomy + exemplars."""
+    lines: list[str] = []
+    lines.append(
+        f"request traces: {tr['requests']} ({tr['complete']} complete, "
+        f"{tr['incomplete']} incomplete)  e2e p50={_fmt_ms(tr['e2e_p50_s'])} "
+        f"p99={_fmt_ms(tr['e2e_p99_s'])}"
+        + (f"  stage coverage {_fmt_pct(tr['coverage_median'])} of e2e"
+           if tr["coverage_median"] is not None else ""))
+    if tr["stages"]:
+        lines.append(f"  {'stage':<12} {'count':>6}  {'p50':>9}  {'p99':>9}  "
+                     f"{'total':>9}")
+        for name, s in tr["stages"].items():
+            lines.append(
+                f"  {name:<12} {s['count']:>6}  {_fmt_ms(s['p50_s']):>9}  "
+                f"{_fmt_ms(s['p99_s']):>9}  {s['total_s']:>8.2f}s")
+    for p, stages in (tr.get("per_process") or {}).items():
+        decomp = "  ".join(f"{n}={_fmt_ms(s['p99_s'])}"
+                           for n, s in stages.items())
+        lines.append(f"  [{p}] p99 by stage: {decomp}")
+    if tr["slowest"]:
+        lines.append("  slowest requests:")
+        for r in tr["slowest"]:
+            chain = " > ".join(
+                f"{s['name']} {_fmt_ms(s['dur_s'])}"
+                for s in sorted(r["stage_spans"], key=lambda s: s["t0"]))
+            where = f" [{r['process']}]" if r.get("process") else ""
+            lines.append(
+                f"    {r['trace_id']}{where} e2e={_fmt_ms(r['e2e_s'])}"
+                + (f" hops={r['hops']}" if r.get("hops") else "")
+                + f": {chain}")
+    return lines
+
+
+def render_slo(s: dict) -> list[str]:
+    """The ``--slo`` section: per-tenant burn rate and verdict."""
+    lines: list[str] = []
+    lines.append(
+        f"SLO: p99 target {_fmt_ms(s['target_p99_s'])}, error budget "
+        f"{100.0 * s['budget']:.1f}% of requests")
+    lines.append(
+        f"  {'tenant':<10} {'req':>6} {'ok':>6} {'shed':>5} {'err':>4} "
+        f"{'slow':>5}  {'viol%':>6}  {'burn':>6}  {'p99':>9}  verdict")
+    rows = list(s["tenants"].items()) + [("TOTAL", s["totals"])]
+    for name, r in rows:
+        lines.append(
+            f"  {name:<10} {r['requests']:>6} {r['ok']:>6} {r['shed']:>5} "
+            f"{r['errors']:>4} {r['slow']:>5}  "
+            f"{100.0 * r['violation_frac']:>5.1f}%  {r['burn_rate']:>5.1f}x  "
+            f"{_fmt_ms(r['p99_s']):>9}  {r['verdict']}")
     return lines
 
 
@@ -306,6 +385,12 @@ def render(rep: dict) -> str:
     if rep.get("fleet_serve"):
         lines.append("")
         lines.extend(render_fleet_serve(rep["fleet_serve"]))
+    if rep.get("traces"):
+        lines.append("")
+        lines.extend(render_traces(rep["traces"]))
+    if rep.get("slo"):
+        lines.append("")
+        lines.extend(render_slo(rep["slo"]))
     lines.append("")
     lines.append("goodput breakdown")
     wall = g["wall_s"] or float("inf")
@@ -405,14 +490,44 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--fleet-serve", action="store_true",
                     help="per-replica serving table: p50/p99, shed rate, "
                          "KV page occupancy, prefix-cache hit rate")
+    ap.add_argument("--traces", action="store_true",
+                    help="request latency anatomy from span traces: "
+                         "per-stage p50/p99 and the slowest exemplars")
+    ap.add_argument("--slo", type=float, metavar="P99_S", default=None,
+                    help="judge served traffic against this p99 target "
+                         "(seconds): per-tenant burn rate and "
+                         "GOOD/BURNING/EXHAUSTED verdicts")
+    ap.add_argument("--slo-budget", type=float, default=0.01,
+                    help="violation fraction the SLO tolerates "
+                         "(default 0.01 = 99%% of requests in target)")
+    ap.add_argument("--export-trace", metavar="OUT.json", default=None,
+                    help="write the run's spans (serve requests + train "
+                         "phases) as Chrome/Perfetto trace_event JSON")
     args = ap.parse_args(argv)
+    # ONE stream read shared between the report and the exporter — a
+    # rotation-capped long-lived fleet's segments are a real parse cost
+    events = telemetry.read_events(args.workdir)
     rep = report(args.workdir, hosts=args.hosts,
-                 fleet_serve=args.fleet_serve)
+                 fleet_serve=args.fleet_serve, traces=args.traces,
+                 slo_target=args.slo, slo_budget=args.slo_budget,
+                 events=events)
     if not rep["num_events"]:
         print(f"dlstatus: no telemetry events under {args.workdir} "
               f"(looked in {telemetry.telemetry_dir(args.workdir)})",
               file=sys.stderr)
         return 1
+    if args.export_trace:
+        from distributeddeeplearningspark_tpu.telemetry import (
+            trace as trace_lib,
+        )
+
+        data = trace_lib.chrome_trace(events)
+        with open(args.export_trace, "w") as f:
+            json.dump(_json_safe(data), f)
+        n = sum(e.get("ph") in ("X", "B") for e in data["traceEvents"])
+        print(f"dlstatus: wrote {n} span(s) to {args.export_trace} "
+              f"(open in ui.perfetto.dev or chrome://tracing)",
+              file=sys.stderr)
     if args.json:
         print(json.dumps(_json_safe(rep), default=str))
     else:

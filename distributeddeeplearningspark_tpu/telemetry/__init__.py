@@ -42,6 +42,15 @@ present):
   occupancy, prefix-cache hit rate, active slots, queue depth. The
   newest one per process is a replica's "now" in ``dlstatus
   --fleet-serve`` (:func:`.fleet.serving_fleet`).
+- ``span`` — one closed span of a request-level distributed trace
+  (:mod:`.trace`): ``trace_id``/``span_id``/``parent_id``/``name``/
+  ``t0``/``t1`` + free-form ``attrs``. Spans are buffered per request and
+  appended with :meth:`EventWriter.emit_many` at completion (ONE flush per
+  request, so the serve hot loop stays cheap); a crash mid-request leaves
+  a partial trace the reader flags ``incomplete``, never throws on.
+  ``dlstatus --traces`` folds them into the latency anatomy, ``dlstatus
+  --export-trace`` exports them (plus train ``phase`` spans lowered into
+  the same model) as Chrome ``trace_event`` JSON.
 
 Worker-side events additionally carry ``host`` (the process index from the
 ``DLS_*`` env contract via :func:`~..utils.env.process_identity`, plus
@@ -75,6 +84,24 @@ TELEMETRY_DIRNAME = "telemetry"
 #: Env var carrying the run's workdir to every process (the supervisor
 #: exports it; a bare `Trainer` falls back to its checkpointer directory).
 WORKDIR_ENV = "DLS_TELEMETRY_DIR"
+
+#: Env var capping one process's event file size in MB: past it the writer
+#: rotates to ``events-<process>.<n>.jsonl`` segments (the reader merges
+#: them transparently). Unset/invalid = unbounded (the training default —
+#: runs are finite; long-lived serving fleets should cap).
+MAX_MB_ENV = "DLS_TELEMETRY_MAX_MB"
+
+
+def _max_bytes_from_env() -> int | None:
+    raw = os.environ.get(MAX_MB_ENV)
+    if not raw:
+        return None
+    try:
+        mb = float(raw)
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r", MAX_MB_ENV, raw)
+        return None
+    return int(mb * 1024 * 1024) if mb > 0 else None
 
 #: phase name -> goodput component it is accounted under. Blocking spans
 #: only: async background work (orbax writes, manifest CRC threads) must
@@ -131,11 +158,18 @@ class EventWriter:
 
     def __init__(self, workdir: str | os.PathLike, *, process: str | None = None,
                  clock=time.time, host: int | None | object = _HOST_FROM_ENV,
-                 hosts: int | None = None):
+                 hosts: int | None = None, max_mb: float | None = None):
         self.workdir = os.path.abspath(os.fspath(workdir))
         self.process = process or _default_process()
-        self.path = os.path.join(self.workdir, TELEMETRY_DIRNAME,
-                                 f"events-{self.process}.jsonl")
+        # size-capped segment rotation (long-lived serving fleets must not
+        # grow one unbounded file per process): segment 0 is the classic
+        # ``events-<process>.jsonl``, later ones ``events-<process>.<n>.jsonl``
+        # — all matched by the reader's events-*.jsonl glob, merged by ts.
+        self._max_bytes = (int(max_mb * 1024 * 1024)
+                           if max_mb else _max_bytes_from_env())
+        self._seg = 0
+        self._bytes = 0
+        self.path = self._seg_path(0)
         # host identity stamped on every event (fleet aggregation key).
         # Default: the DLS_* env contract. host=None opts a non-host process
         # (supervisor, tpu_watch, bench) out of the fleet table; an explicit
@@ -159,6 +193,15 @@ class EventWriter:
         # not a set — nested identical names (restore inside restore) must
         # pop correctly
         self._open_phases: list[str] = []
+        # open-span notes (serving request liveness): insertion-ordered, so
+        # next(iter(...)) is the OLDEST in-flight request — the one a hang
+        # verdict should name (see note_span)
+        self._open_spans: dict[Any, tuple[str, float]] = {}
+
+    def _seg_path(self, seg: int) -> str:
+        name = (f"events-{self.process}.jsonl" if seg == 0
+                else f"events-{self.process}.{seg}.jsonl")
+        return os.path.join(self.workdir, TELEMETRY_DIRNAME, name)
 
     def _record(self, kind: str, fields: dict[str, Any]) -> dict[str, Any]:
         rec = {"ts": self._clock(), "kind": kind, "process": self.process,
@@ -169,15 +212,51 @@ class EventWriter:
                 rec.setdefault("hosts", self.hosts)
         return rec
 
+    def _resume_segment(self) -> None:
+        """Continue appending to the newest existing segment (a restarted
+        process must extend its predecessor's rotation sequence, not
+        overwrite segment 0 growth accounting)."""
+        seg = 0
+        for p in glob.glob(os.path.join(
+                self.workdir, TELEMETRY_DIRNAME,
+                f"events-{self.process}.*.jsonl")):
+            tag = os.path.basename(p)[len(f"events-{self.process}."):-len(".jsonl")]
+            if tag.isdigit():
+                seg = max(seg, int(tag))
+        self._seg = seg
+        self.path = self._seg_path(seg)
+        try:
+            self._bytes = os.path.getsize(self.path)
+        except OSError:
+            self._bytes = 0
+
     def _write_lines(self, lines: list[str]) -> None:
         """Append + flush under the already-held lock (ONE flush per call
-        — the single write path emit and emit_many share)."""
+        — the single write path emit and emit_many share). Rotates to the
+        next segment first when the append would push the current one past
+        the size cap (a single oversized batch still lands whole — events
+        are never split across segments)."""
+        data = "\n".join(lines) + "\n"
         try:
             if self._f is None:
                 os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                self._resume_segment()
                 self._f = open(self.path, "a")
-            self._f.write("\n".join(lines) + "\n")
+            if (self._max_bytes is not None and self._bytes > 0
+                    and self._bytes + len(data) > self._max_bytes):
+                self._f.close()
+                # None BEFORE the reopen: if it raises, a later emit must
+                # retry the open path, not write to a closed handle (a
+                # ValueError no handler catches — telemetry failures
+                # degrade to a warning, never kill a serving thread)
+                self._f = None
+                self._seg += 1
+                self._bytes = 0
+                self.path = self._seg_path(self._seg)
+                self._f = open(self.path, "a")
+            self._f.write(data)
             self._f.flush()
+            self._bytes += len(data)
         except OSError as e:
             if not self._warned:
                 logger.warning("telemetry disabled (%s): %s", self.path, e)
@@ -202,12 +281,19 @@ class EventWriter:
                             if self._open_phases[i] == name:
                                 del self._open_phases[i]
                                 break
-            elif (kind == "heartbeat" and "phase" not in rec
-                  and self._open_phases):
+            elif kind == "heartbeat" and "phase" not in rec:
                 # a heartbeat names where the process IS, not just that it
                 # lives — the field hang localization reads when a host's
-                # last event is a heartbeat
-                rec["phase"] = self._open_phases[-1]
+                # last event is a heartbeat. Open phases win (training);
+                # otherwise the OLDEST open request span (serving) plays
+                # the same role, so a wedged request localizes exactly
+                # like a wedged restore.
+                if self._open_phases:
+                    rec["phase"] = self._open_phases[-1]
+                elif self._open_spans:
+                    name, t0 = next(iter(self._open_spans.values()))
+                    rec["phase"] = name
+                    rec["phase_t0"] = t0
             self._write_lines([json.dumps(rec, default=str)])
 
     def emit_many(self, kind: str, records: "list[dict[str, Any]]") -> None:
@@ -234,6 +320,24 @@ class EventWriter:
             self._write_lines([json.dumps(self._record(kind, fields),
                                           default=str)
                                for fields in records])
+
+    def note_span(self, key: Any, name: str) -> None:
+        """Mark an in-flight request span open (serving liveness).
+
+        Nothing is written: the note only enriches subsequent heartbeats —
+        when no training phase is open, a heartbeat carries the oldest
+        noted span's ``name`` as its ``phase`` plus ``phase_t0`` (when the
+        request began), so hang localization can say "replica 1 stuck in
+        request for 312s" from the stream's last record alone, exactly as
+        it says "stuck in restore". ``key`` is any hashable request
+        identity; :meth:`clear_span` removes it."""
+        with self._lock:
+            self._open_spans.pop(key, None)
+            self._open_spans[key] = (name, self._clock())
+
+    def clear_span(self, key: Any) -> None:
+        with self._lock:
+            self._open_spans.pop(key, None)
 
     @contextlib.contextmanager
     def phase(self, name: str, **fields: Any):

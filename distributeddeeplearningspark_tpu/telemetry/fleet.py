@@ -74,6 +74,7 @@ def _fold_host(host: int, events: list[dict]) -> dict[str, Any]:
     collectives = 0
     open_phases: list[tuple[str, float]] = []
     hb_phase = None
+    hb_phase_t0 = None
     process = None
     for e in events:
         ts = float(e["ts"])
@@ -86,6 +87,19 @@ def _fold_host(host: int, events: list[dict]) -> dict[str, Any]:
             last_hb_ts = ts
             if e.get("phase") is not None:
                 hb_phase = e["phase"]
+                # a serving replica's heartbeat carries its oldest OPEN
+                # request span as phase + phase_t0 (EventWriter.note_span)
+                # — the request-side twin of "in restore since ts"
+                hb_phase_t0 = e.get("phase_t0")
+            else:
+                # a phase-LESS heartbeat means the process is in nothing
+                # notable NOW: a completed request must not stick as the
+                # replica's position for the next hour (request spans,
+                # unlike phases, leave no end event to clear it; training
+                # heartbeats inside the always-open `run` phase never
+                # take this branch)
+                hb_phase = None
+                hb_phase_t0 = None
         elif kind == "phase":
             name = e.get("name")
             if not name:
@@ -98,6 +112,7 @@ def _fold_host(host: int, events: list[dict]) -> dict[str, Any]:
                     # not leak into this attempt's "current phase"
                     open_phases.clear()
                     hb_phase = None
+                    hb_phase_t0 = None
                 open_phases.append((name, ts))
             elif e.get("edge") == "end":
                 for i in range(len(open_phases) - 1, -1, -1):
@@ -108,6 +123,7 @@ def _fold_host(host: int, events: list[dict]) -> dict[str, Any]:
                     # the phase a heartbeat last reported has ENDED — a
                     # clean exit must not read as "still in restore"
                     hb_phase = None
+                    hb_phase_t0 = None
         elif kind == "collective":
             comms_wait += float(e.get("wait_s", 0.0) or 0.0)
             collectives += 1
@@ -124,6 +140,14 @@ def _fold_host(host: int, events: list[dict]) -> dict[str, Any]:
             break
     if phase is None:
         phase = hb_phase
+        # a request-span heartbeat knows WHEN the request began: the hang
+        # verdict's dwell then measures from the request start, like an
+        # open restore measures from its begin
+        if hb_phase_t0 is not None:
+            try:
+                phase_since = float(hb_phase_t0)
+            except (TypeError, ValueError):
+                pass
     g = telemetry.goodput(events)
     first_ts, last_ts = float(events[0]["ts"]), float(events[-1]["ts"])
     return {
@@ -426,7 +450,234 @@ def serving_fleet(events: Iterable[dict]) -> dict[str, Any] | None:
     occ = [r["kv_page_occupancy"] for r in replicas
            if r.get("kv_page_occupancy") is not None]
     totals["kv_page_occupancy_max"] = max(occ) if occ else None
+    # router-level accounting the replica rows can't see: failover hops
+    # (a replica died mid-request and the router re-dispatched — counted
+    # from its `failover` spans) and per-tenant shed rates (tenant-budget
+    # sheds carry `tenant` on the router's request events; completed
+    # requests carry it on their root span)
+    spans = [e for e in events if e.get("kind") == "span"]
+    totals["failovers"] = sum(e.get("name") == "failover" for e in spans)
+    tenants: dict[str, dict] = {}
+
+    def _tenant_row(t: str) -> dict:
+        return tenants.setdefault(
+            str(t), {"requests": 0, "ok": 0, "shed": 0, "errors": 0})
+
+    for e in spans:
+        if e.get("name") != "request" or e.get("parent_id"):
+            continue
+        attrs = e.get("attrs") or {}
+        if attrs.get("tenant") is None:
+            continue
+        row = _tenant_row(attrs["tenant"])
+        row["requests"] += 1
+        oc = attrs.get("outcome")
+        if oc == "ok":
+            row["ok"] += 1
+        elif oc == "shed":
+            row["shed"] += 1
+        else:
+            row["errors"] += 1
+    for e in reqs:
+        if e.get("outcome") == "shed" and e.get("tenant") is not None:
+            row = _tenant_row(e["tenant"])
+            row["requests"] += 1
+            row["shed"] += 1
+    for row in tenants.values():
+        row["shed_rate"] = (round(row["shed"] / row["requests"], 4)
+                            if row["requests"] else None)
+    totals["tenants"] = tenants or None
     return {"replicas": replicas, "totals": totals}
+
+
+def latency_anatomy(events: Iterable[dict], *, slow_n: int = 3
+                    ) -> dict[str, Any] | None:
+    """Per-stage latency decomposition from request traces — what
+    ``dlstatus --traces`` renders.
+
+    Folds :func:`~.trace.request_anatomy` into: per-stage p50/p99 across
+    all requests, the same broken out per writing process (replica), the
+    median stage coverage (Σ stages / e2e — how much of the latency the
+    decomposition explains), and the ``slow_n`` slowest complete requests
+    as exemplar records (their full stage spans, for the tree render).
+    Incomplete traces (crash mid-request) are counted, never fatal. None
+    when the run has no request traces."""
+    from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
+
+    events = [e for e in events if "ts" in e]
+    reqs = trace_lib.request_anatomy(events)
+    if not reqs:
+        return None
+    complete = [r for r in reqs if not r["incomplete"]
+                and r["e2e_s"] is not None]
+    # the latency pools fold SERVED requests only: a shed's root-only
+    # trace (closed root, zero stage spans, few-ms e2e) is complete but
+    # would drag coverage toward 0 and p50 toward 0 exactly during the
+    # shed-heavy incident the operator is debugging
+    served = [r for r in complete if r["outcome"] == "ok" and r["stages"]]
+
+    def _stage_fold(rows: list[dict]) -> dict[str, dict]:
+        by_name: dict[str, list[float]] = {}
+        for r in rows:
+            for name, dur in r["stages"].items():
+                by_name.setdefault(name, []).append(dur)
+        return {
+            name: {"count": len(durs),
+                   "p50_s": _percentile(sorted(durs), 0.50),
+                   "p99_s": _percentile(sorted(durs), 0.99),
+                   "total_s": sum(durs)}
+            for name, durs in sorted(by_name.items())}
+
+    by_proc: dict[str, list[dict]] = {}
+    for r in reqs:
+        procs = {s["process"] for s in r["stage_spans"]
+                 if s["process"] is not None}
+        for p in procs:
+            sub = {"stages": {}}
+            for s in r["stage_spans"]:
+                if s["process"] == p and s["dur_s"] is not None:
+                    sub["stages"][s["name"]] = (
+                        sub["stages"].get(s["name"], 0.0) + s["dur_s"])
+            by_proc.setdefault(str(p), []).append(sub)
+    e2e = sorted(r["e2e_s"] for r in served)
+    coverage = sorted(r["coverage"] for r in served
+                      if r["coverage"] is not None)
+    slowest = sorted(served, key=lambda r: -r["e2e_s"])[:slow_n]
+    return {
+        "requests": len(reqs),
+        "complete": len(complete),
+        "incomplete": len(reqs) - len(complete),
+        "e2e_p50_s": _percentile(e2e, 0.50),
+        "e2e_p99_s": _percentile(e2e, 0.99),
+        "coverage_median": _percentile(coverage, 0.50),
+        "stages": _stage_fold(served),
+        "per_process": {p: _stage_fold(rows)
+                        for p, rows in sorted(by_proc.items())},
+        "slowest": slowest,
+    }
+
+
+#: burn-rate ladder for the SLO verdict: spending the error budget at
+#: ≤1× is sustainable (GOOD); above it the budget is BURNING; at ≥10×
+#: the period's budget is effectively gone (EXHAUSTED) — the SRE-workbook
+#: fast-burn threshold shape.
+SLO_EXHAUST_BURN = 10.0
+
+
+def slo_report(events: Iterable[dict], *, target_p99_s: float,
+               budget: float = 0.01,
+               exhaust_burn: float = SLO_EXHAUST_BURN) -> dict[str, Any] | None:
+    """Judge served traffic against a latency SLO — ``dlstatus --slo``.
+
+    A request **violates** when it was shed, errored, or completed slower
+    than ``target_p99_s``. ``budget`` is the violation fraction the SLO
+    tolerates (0.01 = "99% of requests in target"); the **burn rate** is
+    ``violation_frac / budget`` — 1.0 means spending exactly the budget.
+    Verdicts: ``GOOD`` (≤1×), ``BURNING`` (>1×), ``EXHAUSTED``
+    (≥``exhaust_burn``× — the error budget for the observed window is
+    gone many times over; page, don't ticket).
+
+    Attribution: completed requests come from root ``request`` spans when
+    the run was traced (they carry ``tenant``/``outcome``/duration);
+    tenant-budget sheds from the router's ``request`` events. An untraced
+    run (no spans) falls back to plain ``request`` events under one
+    ``default`` tenant, so the sentinel still judges a bare single-engine
+    run. None when nothing was served."""
+    events = [e for e in events if "ts" in e]
+    roots = [e for e in events
+             if e.get("kind") == "span" and e.get("name") == "request"
+             and not e.get("parent_id") and e.get("t1") is not None]
+    reqs = [e for e in events if e.get("kind") == "request"]
+    tenants: dict[str, dict] = {}
+
+    def row(t) -> dict:
+        return tenants.setdefault(str(t), {
+            "requests": 0, "ok": 0, "shed": 0, "errors": 0, "slow": 0,
+            "lat": []})
+
+    if roots:
+        for e in roots:
+            attrs = e.get("attrs") or {}
+            r = row(attrs.get("tenant") or "default")
+            r["requests"] += 1
+            oc = attrs.get("outcome")
+            if oc == "shed":
+                r["shed"] += 1
+            elif oc != "ok":
+                r["errors"] += 1
+            else:
+                lat = max(0.0, float(e["t1"]) - float(e["t0"]))
+                r["lat"].append(lat)
+                if lat > target_p99_s:
+                    r["slow"] += 1
+                else:
+                    r["ok"] += 1
+        # sheds that never became traces: the router's tenant-budget
+        # rejections (pre-dispatch, carry `tenant`) and a bare engine's
+        # queue-full sheds (no router, no trace — a traced run of a bare
+        # engine must still see its own overload). Replica-side sheds
+        # INSIDE a traced fleet request carry `trace`: their root span
+        # already counted the violation, so they are skipped here.
+        for e in reqs:
+            if e.get("outcome") == "shed" and e.get("trace") is None:
+                r = row(e.get("tenant") or "default")
+                r["requests"] += 1
+                r["shed"] += 1
+    else:
+        for e in reqs:
+            r = row(e.get("tenant") or "default")
+            r["requests"] += 1
+            oc = e.get("outcome")
+            if oc == "shed":
+                r["shed"] += 1
+            elif oc == "error":
+                r["errors"] += 1
+            elif e.get("latency_s") is not None:
+                lat = float(e["latency_s"])
+                r["lat"].append(lat)
+                if lat > target_p99_s:
+                    r["slow"] += 1
+                else:
+                    r["ok"] += 1
+            else:
+                r["ok"] += 1
+    if not tenants:
+        return None
+
+    def judge(r: dict) -> dict:
+        violations = r["shed"] + r["errors"] + r["slow"]
+        frac = violations / r["requests"] if r["requests"] else 0.0
+        burn = (frac / budget if budget > 0
+                else (float("inf") if frac else 0.0))
+        verdict = ("GOOD" if burn <= 1.0
+                   else "EXHAUSTED" if burn >= exhaust_burn else "BURNING")
+        lat = sorted(r.pop("lat"))
+        return {
+            **r,
+            "violations": violations,
+            "violation_frac": round(frac, 4),
+            "burn_rate": round(burn, 2),
+            "p99_s": _percentile(lat, 0.99),
+            "verdict": verdict,
+        }
+
+    # the TOTAL row goes through the same judge() as every tenant — one
+    # verdict ladder, never two copies that can drift. Accumulate before
+    # judging: judge() consumes each row's lat list.
+    total = {"requests": 0, "ok": 0, "shed": 0, "errors": 0, "slow": 0,
+             "lat": []}
+    for r in tenants.values():
+        for k in ("requests", "ok", "shed", "errors", "slow"):
+            total[k] += r[k]
+        total["lat"].extend(r["lat"])
+    per_tenant = {t: judge(r) for t, r in sorted(tenants.items())}
+    totals = judge(total)
+    return {
+        "target_p99_s": target_p99_s,
+        "budget": budget,
+        "tenants": per_tenant,
+        "totals": totals,
+    }
 
 
 def fleet_report(events: Iterable[dict], *, now: float | None = None

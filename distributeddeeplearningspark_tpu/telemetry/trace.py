@@ -1,0 +1,385 @@
+"""Request-level distributed tracing — the span model on the JSONL bus.
+
+Aggregate p50/p99 per replica (PR 6) says *that* a request was slow, never
+*where* the time went: router queue? replica admission? prefill? a decode
+step stalled behind a rolling reload? The Spark event-log/UI answer — and
+the per-stage accounting argument of MPMD pipeline parallelism — is a
+per-unit-of-work timeline. This module is that timeline's substrate:
+
+- **Span records** ride the existing telemetry bus as a ``span`` event
+  kind: ``trace_id`` (one request end to end), ``span_id``, ``parent_id``
+  (causality), ``name`` (the stage), ``t0``/``t1`` (epoch seconds), plus
+  free-form ``attrs``. Writers buffer a request's spans host-side and
+  append them with ONE :meth:`~.EventWriter.emit_many` flush at
+  completion, so the serve hot loop pays a list-append per stage, not a
+  write.
+- **Trace context** is a two-field dict ``{"trace_id", "parent_id"}``
+  handed across layers (the router puts it in the replica-socket payload;
+  the engines accept it on ``submit``) so every layer's spans join one
+  causal tree: router placement → replica queue wait → bucket/admission →
+  prefill (prefix-cache depth as an attr) → decode (first-token + per-
+  token timeline) → stream, with failover hops as extra children.
+- **The reader is a pure fold** (:func:`trace_trees`): it groups span
+  events by ``trace_id`` and builds parent/child trees, tolerating
+  everything a crash can leave — a parentless span (its parent's emit
+  died with the process), an unclosed span (``t1`` missing), duplicate or
+  garbage records — by flagging the tree ``incomplete``, never throwing.
+- **Train-side reuse**: :func:`spans_from_phases` lowers the existing
+  ``phase`` begin/end pairs into the same span model (one synthetic trace
+  per process), so training runs open in the same viewers with zero new
+  writer-side instrumentation.
+- **Export**: :func:`chrome_trace` renders both serve request spans and
+  lowered train phase spans as Chrome/Perfetto ``trace_event`` JSON
+  (``dlstatus --export-trace out.json`` → open in ``ui.perfetto.dev`` or
+  ``chrome://tracing``).
+
+The folds downstream — per-stage latency anatomy and the SLO sentinel —
+live beside the other stream folds in :mod:`.fleet`
+(:func:`~.fleet.latency_anatomy`, :func:`~.fleet.slo_report`), rendered by
+``dlstatus --traces`` / ``--slo``.
+
+Like the rest of the reader side: no jax, works identically on a crashed
+run's partial streams.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterable
+
+#: the event kind span records ride the bus under.
+SPAN_KIND = "span"
+
+#: cap on per-token timeline entries stored in a decode span's attrs —
+#: a 16k-token generation must not turn one span record into a megabyte.
+MAX_TOKEN_TIMELINE = 256
+
+
+def new_trace_id() -> str:
+    """16-hex-char request identity (random, collision-safe per run)."""
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(4).hex()
+
+
+def span(trace_id: str, span_id: str, name: str, t0: float,
+         t1: float | None, *, parent_id: str | None = None,
+         **attrs: Any) -> dict[str, Any]:
+    """One span record (the fields of a ``span`` event). ``t1=None`` marks
+    a span known open but never closed — writers normally only emit closed
+    spans; the reader meets open ones in lowered phases and torn streams."""
+    rec: dict[str, Any] = {
+        "trace_id": trace_id, "span_id": span_id, "name": name,
+        "t0": float(t0), "t1": None if t1 is None else float(t1),
+    }
+    if parent_id is not None:
+        rec["parent_id"] = parent_id
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+class SpanBuffer:
+    """Per-request span collector: stage spans append host-side (cheap),
+    one :meth:`flush` writes them all with a single ``emit_many`` — the
+    durability granularity a request actually has (a crash loses at most
+    the request being reported, whose incompleteness is itself evidence).
+    """
+
+    def __init__(self, trace_id: str | None = None,
+                 parent_id: str | None = None):
+        self.trace_id = trace_id or new_trace_id()
+        self.parent_id = parent_id
+        self.records: list[dict[str, Any]] = []
+
+    @classmethod
+    def from_context(cls, ctx: dict | None) -> "SpanBuffer":
+        """Join an upstream trace (``ctx`` = the two-field trace context)
+        or start a fresh one when the caller is the trace root."""
+        if not isinstance(ctx, dict) or not ctx.get("trace_id"):
+            return cls()
+        return cls(str(ctx["trace_id"]),
+                   str(ctx["parent_id"]) if ctx.get("parent_id") else None)
+
+    @property
+    def joined(self) -> bool:
+        """True when this buffer continues an upstream trace (the root
+        span is the upstream's job, not ours)."""
+        return self.parent_id is not None
+
+    def context(self, parent_id: str | None = None) -> dict[str, str]:
+        """The trace context to hand the next layer down."""
+        ctx = {"trace_id": self.trace_id}
+        if parent_id or self.parent_id:
+            ctx["parent_id"] = parent_id or self.parent_id
+        return ctx
+
+    @staticmethod
+    def upstream_t0(ctx: dict | None, default: float) -> float:
+        """The upstream context's request-start time (the router stamps
+        ``t0`` = when IT accepted the request), clamped to ``default``
+        (the local submit time). Queue spans start here so cross-process
+        socket transit is accounted as queueing, not lost coverage."""
+        if isinstance(ctx, dict) and ctx.get("t0") is not None:
+            try:
+                return min(default, float(ctx["t0"]))
+            except (TypeError, ValueError):
+                pass
+        return default
+
+    def add(self, name: str, t0: float, t1: float | None, *,
+            parent_id: str | None = None, span_id: str | None = None,
+            **attrs: Any) -> str:
+        sid = span_id or new_span_id()
+        self.records.append(span(
+            self.trace_id, sid, name, t0, t1,
+            parent_id=parent_id if parent_id is not None else self.parent_id,
+            **attrs))
+        return sid
+
+    def flush(self, writer) -> None:
+        if writer is not None and self.records:
+            writer.emit_many(SPAN_KIND, self.records)
+        self.records = []
+
+
+# -- reader ------------------------------------------------------------------
+
+
+def spans_of(events: Iterable[dict]) -> list[dict]:
+    """The well-formed span events of a stream (garbage skipped, never
+    raised on — the torn-stream contract of every reader here)."""
+    out = []
+    for e in events:
+        if e.get("kind") != SPAN_KIND:
+            continue
+        if not e.get("trace_id") or not e.get("span_id") or not e.get("name"):
+            continue
+        try:
+            float(e["t0"])
+            if e.get("t1") is not None:
+                float(e["t1"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        out.append(e)
+    return out
+
+
+def spans_from_phases(events: Iterable[dict]) -> list[dict]:
+    """Lower train-side ``phase`` begin/end pairs into span records.
+
+    One synthetic trace per process (``train:<process>``); nesting follows
+    the begin/end stack, so ``checkpoint-wait`` inside ``checkpoint``
+    becomes a child span. A ``run`` begin resets the stack (a relaunched
+    attempt appending to the same file must not parent into the crashed
+    session's spans); a begin with no end becomes an open span
+    (``t1=None``) — the honest shape of a crash mid-phase."""
+    open_by_proc: dict[str, list[dict]] = {}
+    out: list[dict] = []
+    for e in events:
+        if e.get("kind") != "phase" or not e.get("name") or "ts" not in e:
+            continue
+        proc = str(e.get("process"))
+        stack = open_by_proc.setdefault(proc, [])
+        name, edge, ts = e["name"], e.get("edge"), float(e["ts"])
+        if edge == "begin":
+            if name == "run":
+                # crashed session's spans: close them open-ended
+                out.extend(s for s in stack)
+                stack.clear()
+            rec = span(f"train:{proc}", new_span_id(), name, ts, None,
+                       parent_id=stack[-1]["span_id"] if stack else None)
+            rec["process"] = proc
+            stack.append(rec)
+        elif edge == "end":
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i]["name"] == name:
+                    rec = stack.pop(i)
+                    rec["t1"] = ts
+                    out.append(rec)
+                    break
+            # an end with no begin (file rotated away / torn head): dropped
+    for stack in open_by_proc.values():
+        out.extend(stack)  # still-open spans, t1=None
+    return out
+
+
+def trace_trees(events: Iterable[dict], *,
+                include_phases: bool = False) -> dict[str, dict]:
+    """Group spans by trace and build causal trees — the crash-tolerant
+    fold every trace consumer goes through.
+
+    Returns ``{trace_id: {"trace_id", "root", "orphans", "incomplete",
+    "num_spans"}}`` where ``root``/``orphans`` are nodes of the shape
+    ``{"span": rec, "children": [nodes sorted by t0]}``. A tree is
+    ``incomplete`` when it has no root (the root's emit died with the
+    process), when spans reference parents that never arrived (they land
+    under ``orphans`` so their evidence still renders), or when any span
+    is still open (``t1`` missing). Duplicated span ids keep the first
+    record. Never throws on torn/interleaved streams."""
+    spans = spans_of(events)
+    if include_phases:
+        spans = spans + spans_from_phases(events)
+    by_trace: dict[str, list[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(str(s["trace_id"]), []).append(s)
+    out: dict[str, dict] = {}
+    for tid, recs in by_trace.items():
+        nodes: dict[str, dict] = {}
+        for s in recs:
+            nodes.setdefault(str(s["span_id"]), {"span": s, "children": []})
+        roots: list[dict] = []
+        orphans: list[dict] = []
+        for node in nodes.values():
+            pid = node["span"].get("parent_id")
+            if pid is None:
+                roots.append(node)
+            elif str(pid) in nodes and str(pid) != str(node["span"]["span_id"]):
+                nodes[str(pid)]["children"].append(node)
+            else:
+                orphans.append(node)
+        for node in nodes.values():
+            node["children"].sort(key=lambda n: float(n["span"]["t0"]))
+        roots.sort(key=lambda n: float(n["span"]["t0"]))
+        root = roots[0] if roots else None
+        orphans.extend(roots[1:])  # two roots: keep the earliest, flag rest
+        open_spans = any(s.get("t1") is None for s in recs)
+        out[tid] = {
+            "trace_id": tid,
+            "root": root,
+            "orphans": sorted(orphans, key=lambda n: float(n["span"]["t0"])),
+            "incomplete": root is None or bool(orphans) or open_spans,
+            "num_spans": len(nodes),
+        }
+    return out
+
+
+def _dur(s: dict) -> float | None:
+    if s.get("t1") is None:
+        return None
+    return max(0.0, float(s["t1"]) - float(s["t0"]))
+
+
+#: span names that are stages of a request (the latency decomposition),
+#: vs. bookkeeping children (place, failover) that overlap them.
+STAGE_NAMES = ("queue", "admission", "prefill", "decode", "stream", "infer")
+
+
+def request_anatomy(events: Iterable[dict]) -> list[dict]:
+    """One record per request trace: end-to-end, per-stage durations, and
+    how much of the request the stages account for.
+
+    ``coverage`` is Σ(stage spans) / e2e — the acceptance metric ("the
+    decomposition explains ≥95% of the latency"); stages tile the
+    replica's residence by construction, so the gap is socket transit +
+    dispatch bookkeeping. Incomplete trees still yield a record (flagged)
+    so a crash's partial evidence renders instead of vanishing."""
+    out = []
+    for tid, tree in sorted(trace_trees(events).items()):
+        root = tree["root"]
+        root_span = root["span"] if root else None
+        if root_span is not None and root_span["name"] != "request":
+            continue  # not a request trace (future span users)
+        nodes = []
+
+        def walk(n):
+            nodes.append(n["span"])
+            for c in n["children"]:
+                walk(c)
+
+        if root:
+            walk(root)
+        for o in tree["orphans"]:
+            walk(o)
+        stage_spans = [{"name": s["name"], "dur_s": _dur(s),
+                        "process": s.get("process"), "t0": float(s["t0"]),
+                        "attrs": s.get("attrs") or {}}
+                       for s in nodes if s["name"] in STAGE_NAMES]
+        stages: dict[str, float] = {}
+        for s in stage_spans:
+            if s["dur_s"] is not None:
+                stages[s["name"]] = stages.get(s["name"], 0.0) + s["dur_s"]
+        e2e = _dur(root_span) if root_span else None
+        attrs = (root_span.get("attrs") or {}) if root_span else {}
+        out.append({
+            "trace_id": tid,
+            "process": root_span.get("process") if root_span else None,
+            "engine": attrs.get("engine"),
+            "tenant": attrs.get("tenant"),
+            "outcome": attrs.get("outcome"),
+            "hops": attrs.get("hops", 0),
+            "t0": float(root_span["t0"]) if root_span else (
+                min((s["t0"] for s in stage_spans), default=None)),
+            "e2e_s": e2e,
+            "stages": stages,
+            "stage_spans": stage_spans,
+            "coverage": (sum(stages.values()) / e2e
+                         if e2e else None),
+            "incomplete": tree["incomplete"],
+            "num_spans": tree["num_spans"],
+        })
+    return out
+
+
+# -- Chrome trace_event export ------------------------------------------------
+
+
+def chrome_trace(events: Iterable[dict]) -> dict[str, Any]:
+    """Both halves of a run — serve request spans and train phase spans —
+    as Chrome/Perfetto ``trace_event`` JSON (the "JSON array format":
+    ``{"traceEvents": [...]}``, complete ``"X"`` events with microsecond
+    ``ts``/``dur``, open spans as lone ``"B"``s, plus ``"M"`` metadata
+    naming processes and rows). ``pid`` is the writing process, ``tid``
+    one row per trace within it, so a request's stages stack on their own
+    line and any run opens in a real trace viewer."""
+    events = [e for e in events if "ts" in e]
+    serve = spans_of(events)
+    train = spans_from_phases(events)
+    all_spans = ([("serve", s) for s in serve]
+                 + [("train", s) for s in train])
+    if not all_spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    epoch = min(float(s["t0"]) for _, s in all_spans)
+
+    pids: dict[str, int] = {}
+    tids: dict[tuple[int, str], int] = {}
+    tid_next: dict[int, int] = {}
+    trace_events: list[dict] = []
+
+    def pid_of(proc: str) -> int:
+        if proc not in pids:
+            pids[proc] = len(pids) + 1
+            trace_events.append({
+                "name": "process_name", "ph": "M", "pid": pids[proc],
+                "tid": 0, "args": {"name": proc}})
+        return pids[proc]
+
+    def tid_of(pid: int, row: str) -> int:
+        key = (pid, row)
+        if key not in tids:
+            tids[key] = tid_next.get(pid, 0)
+            tid_next[pid] = tids[key] + 1
+            trace_events.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": tids[key], "args": {"name": row}})
+        return tids[key]
+
+    for cat, s in sorted(all_spans, key=lambda cs: float(cs[1]["t0"])):
+        proc = str(s.get("process") or "?")
+        pid = pid_of(proc)
+        row = ("phases" if cat == "train"
+               else f"req {str(s['trace_id'])[:8]}")
+        tid = tid_of(pid, row)
+        args = dict(s.get("attrs") or {})
+        args["trace_id"] = s["trace_id"]
+        base = {"name": s["name"], "cat": cat, "pid": pid, "tid": tid,
+                "ts": (float(s["t0"]) - epoch) * 1e6, "args": args}
+        if s.get("t1") is None:
+            trace_events.append({**base, "ph": "B"})  # open: begin only
+        else:
+            trace_events.append({
+                **base, "ph": "X",
+                "dur": max(0.0, float(s["t1"]) - float(s["t0"])) * 1e6})
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}

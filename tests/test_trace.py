@@ -1,0 +1,813 @@
+"""Request-level distributed tracing (ISSUE 7): span model + crash-tolerant
+tree fold, train-phase lowering, Chrome trace_event export, latency anatomy,
+SLO sentinel, telemetry segment rotation, heartbeat/span hang localization,
+and the serve-layer span emission (engine, generator, router).
+
+Reader-side tests run on synthetic timestamped records (fake clocks, no
+sleeps) — the folds are pure functions over streams, torn or whole. The
+jit-bearing tests at the bottom drive a real engine/generator and pin the
+acceptance shape: every request yields a complete causal tree whose stage
+sum covers ≥95% of its end-to-end latency.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu import status, telemetry
+from distributeddeeplearningspark_tpu.telemetry import fleet as fleet_lib
+from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def _span_ev(trace_id, span_id, name, t0, t1, *, parent_id=None,
+             process="p0", ts=None, **attrs):
+    """A span record as it appears ON THE BUS (what EventWriter appends)."""
+    rec = {"ts": ts if ts is not None else (t1 if t1 is not None else t0),
+           "kind": "span", "process": process, "trace_id": trace_id,
+           "span_id": span_id, "name": name, "t0": t0, "t1": t1}
+    if parent_id is not None:
+        rec["parent_id"] = parent_id
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+def _request_tree(tid, *, t0=100.0, dur=1.0, process="p0", tenant=None,
+                  outcome="ok", stages=("queue", "prefill", "decode")):
+    """A complete request trace: root + evenly-split stage children."""
+    attrs = {"outcome": outcome, "hops": 0}
+    if tenant is not None:
+        attrs["tenant"] = tenant
+    evs = [_span_ev(tid, f"{tid}-root", "request", t0, t0 + dur,
+                    process=process, **attrs)]
+    step = dur / len(stages)
+    for i, name in enumerate(stages):
+        evs.append(_span_ev(tid, f"{tid}-s{i}", name, t0 + i * step,
+                            t0 + (i + 1) * step, parent_id=f"{tid}-root",
+                            process=process))
+    return evs
+
+
+# -- SpanBuffer / context -----------------------------------------------------
+
+
+def test_span_buffer_roots_fresh_trace_without_context():
+    buf = trace_lib.SpanBuffer.from_context(None)
+    assert not buf.joined
+    root = buf.add("request", 1.0, 2.0, outcome="ok")
+    buf.add("queue", 1.0, 1.5, parent_id=root)
+    assert len(buf.records) == 2
+    assert buf.records[0]["span_id"] == root
+    assert buf.records[1]["parent_id"] == root
+    assert buf.records[0]["attrs"] == {"outcome": "ok"}
+
+
+def test_span_buffer_joins_upstream_context():
+    """The two-field trace context the router puts on the replica socket:
+    a joined buffer parents its spans under the upstream span, and does
+    NOT emit its own root."""
+    buf = trace_lib.SpanBuffer.from_context(
+        {"trace_id": "abc", "parent_id": "root1"})
+    assert buf.joined and buf.trace_id == "abc"
+    buf.add("queue", 1.0, 1.5)
+    assert buf.records[0]["parent_id"] == "root1"
+    # a malformed / empty context roots a fresh trace instead of crashing
+    assert not trace_lib.SpanBuffer.from_context({}).joined
+    assert not trace_lib.SpanBuffer.from_context("garbage").joined
+
+
+def test_span_buffer_flush_writes_once_and_clears(tmp_path):
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=lambda: 5.0,
+                              host=None)
+    buf = trace_lib.SpanBuffer()
+    root = buf.add("request", 1.0, 2.0)
+    buf.add("queue", 1.0, 1.5, parent_id=root)
+    buf.flush(w)
+    assert buf.records == []
+    buf.flush(w)  # empty flush: no-op
+    buf.flush(None)  # writer-less serving: no-op, no crash
+    w.close()
+    evs = telemetry.read_events(tmp_path)
+    assert len(evs) == 2 and all(e["kind"] == "span" for e in evs)
+
+
+# -- trace_trees: the crash-tolerant fold ------------------------------------
+
+
+def test_trace_trees_builds_causal_tree():
+    evs = _request_tree("t1")
+    trees = trace_lib.trace_trees(evs)
+    tree = trees["t1"]
+    assert not tree["incomplete"]
+    assert tree["root"]["span"]["name"] == "request"
+    names = [c["span"]["name"] for c in tree["root"]["children"]]
+    assert names == ["queue", "prefill", "decode"]  # sorted by t0
+
+
+def test_trace_trees_parentless_span_is_orphan_flagged_incomplete():
+    """Crash mid-request: the child spans' emit landed but the root's
+    died with the process — the evidence still renders as orphans."""
+    evs = _request_tree("t1")[1:]  # drop the root
+    tree = trace_lib.trace_trees(evs)["t1"]
+    assert tree["incomplete"]
+    assert tree["root"] is None
+    assert len(tree["orphans"]) == 3
+
+
+def test_trace_trees_unclosed_span_flagged_incomplete():
+    evs = _request_tree("t1")
+    evs[2]["t1"] = None  # prefill never closed
+    tree = trace_lib.trace_trees(evs)["t1"]
+    assert tree["incomplete"] and tree["root"] is not None
+
+
+def test_trace_trees_duplicate_and_garbage_records_never_throw():
+    evs = _request_tree("t1")
+    evs.append(dict(evs[0]))                        # duplicate span id
+    evs.append({"ts": 1.0, "kind": "span"})         # no ids at all
+    evs.append({"ts": 1.0, "kind": "span", "trace_id": "t1",
+                "span_id": "x", "name": "bad", "t0": "not-a-float"})
+    evs.append({"ts": 1.0, "kind": "step_metrics", "step": 3})
+    tree = trace_lib.trace_trees(evs)["t1"]
+    assert tree["num_spans"] == 4 and not tree["incomplete"]
+
+
+def test_trace_trees_two_roots_keeps_earliest():
+    evs = _request_tree("t1")
+    evs.append(_span_ev("t1", "r2", "request", 200.0, 201.0))
+    tree = trace_lib.trace_trees(evs)["t1"]
+    assert tree["root"]["span"]["span_id"] == "t1-root"
+    assert tree["incomplete"]  # the extra root is flagged, not silently kept
+    assert any(o["span"]["span_id"] == "r2" for o in tree["orphans"])
+
+
+def test_trace_trees_self_parented_span_is_orphan_not_cycle():
+    evs = [_span_ev("t1", "s1", "request", 1.0, 2.0, parent_id="s1")]
+    tree = trace_lib.trace_trees(evs)["t1"]
+    assert tree["root"] is None and len(tree["orphans"]) == 1
+
+
+# -- train-phase lowering -----------------------------------------------------
+
+
+def _phase_ev(ts, name, edge, process="p0"):
+    return {"ts": ts, "kind": "phase", "process": process, "name": name,
+            "edge": edge}
+
+
+def test_spans_from_phases_nesting_and_open_spans():
+    """begin/end pairs lower to nested spans; a begin with no end becomes
+    an open span (t1=None) — the honest shape of a crash mid-phase."""
+    evs = [
+        _phase_ev(0.0, "run", "begin"),
+        _phase_ev(1.0, "checkpoint", "begin"),
+        _phase_ev(1.2, "checkpoint-wait", "begin"),
+        _phase_ev(1.8, "checkpoint-wait", "end"),
+        _phase_ev(2.0, "checkpoint", "end"),
+        _phase_ev(3.0, "restore", "begin"),  # crash: no end, run never ends
+    ]
+    spans = trace_lib.spans_from_phases(evs)
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["checkpoint-wait"]["parent_id"] == \
+        by_name["checkpoint"]["span_id"]
+    assert by_name["checkpoint"]["parent_id"] == by_name["run"]["span_id"]
+    assert by_name["restore"]["t1"] is None and by_name["run"]["t1"] is None
+    assert all(s["trace_id"] == "train:p0" for s in spans)
+
+
+def test_spans_from_phases_run_begin_resets_stack():
+    """A relaunched attempt appends to the same file: its phases must not
+    parent into the crashed session's open spans."""
+    evs = [
+        _phase_ev(0.0, "run", "begin"),
+        _phase_ev(1.0, "restore", "begin"),      # crashed mid-restore
+        _phase_ev(10.0, "run", "begin"),         # relaunch
+        _phase_ev(11.0, "compile", "begin"),
+        _phase_ev(12.0, "compile", "end"),
+    ]
+    spans = trace_lib.spans_from_phases(evs)
+    compile_s = next(s for s in spans if s["name"] == "compile")
+    runs = [s for s in spans if s["name"] == "run"]
+    assert compile_s["parent_id"] == runs[-1]["span_id"]
+    restore = next(s for s in spans if s["name"] == "restore")
+    assert restore["t1"] is None
+    # an end with no begin (rotated-away head) is dropped, not raised on
+    assert trace_lib.spans_from_phases(
+        [_phase_ev(1.0, "eval", "end")]) == []
+
+
+# -- Chrome trace_event export ------------------------------------------------
+
+
+def test_chrome_trace_valid_and_covers_serve_and_train():
+    evs = _request_tree("t1") + [
+        _phase_ev(50.0, "run", "begin", process="p1"),
+        _phase_ev(51.0, "compile", "begin", process="p1"),
+        _phase_ev(55.0, "compile", "end", process="p1"),
+    ]
+    data = json.loads(json.dumps(trace_lib.chrome_trace(evs)))
+    assert data["displayTimeUnit"] == "ms"
+    tevs = data["traceEvents"]
+    cats = {e.get("cat") for e in tevs if e.get("ph") in ("X", "B")}
+    assert cats == {"serve", "train"}
+    complete = [e for e in tevs if e["ph"] == "X"]
+    for e in complete:
+        assert {"name", "pid", "tid", "ts", "dur"} <= set(e)
+        assert e["ts"] >= 0 and e["dur"] >= 0  # µs, relative to the epoch
+    # the open `run` phase exports as a lone B (begin) event
+    opens = [e for e in tevs if e["ph"] == "B"]
+    assert {e["name"] for e in opens} == {"run"}
+    # metadata rows name every process
+    meta = [e for e in tevs if e["ph"] == "M" and e["name"] == "process_name"]
+    assert {m["args"]["name"] for m in meta} == {"p0", "p1"}
+    assert trace_lib.chrome_trace([]) == {"traceEvents": [],
+                                          "displayTimeUnit": "ms"}
+
+
+# -- request anatomy / latency fold ------------------------------------------
+
+
+def test_request_anatomy_coverage_and_incomplete():
+    evs = _request_tree("t1", t0=100.0, dur=1.0)
+    evs += _request_tree("t2", t0=200.0, dur=2.0)[:2]  # torn: root + queue
+    evs[-1]["t1"] = None                               # queue never closed
+    recs = {r["trace_id"]: r for r in trace_lib.request_anatomy(evs)}
+    full = recs["t1"]
+    assert not full["incomplete"] and full["e2e_s"] == pytest.approx(1.0)
+    assert full["coverage"] == pytest.approx(1.0)
+    assert set(full["stages"]) == {"queue", "prefill", "decode"}
+    assert recs["t2"]["incomplete"]
+
+
+def test_latency_anatomy_percentiles_and_slowest():
+    evs = []
+    for i, dur in enumerate([0.1, 0.2, 0.3, 5.0]):
+        evs += _request_tree(f"t{i}", t0=100.0 + 10 * i, dur=dur,
+                             process=f"p{i % 2}")
+    la = fleet_lib.latency_anatomy(evs, slow_n=2)
+    assert la["requests"] == 4 and la["complete"] == 4
+    assert la["coverage_median"] == pytest.approx(1.0)
+    assert set(la["stages"]) == {"queue", "prefill", "decode"}
+    assert la["stages"]["decode"]["count"] == 4
+    assert [r["trace_id"] for r in la["slowest"]] == ["t3", "t2"]
+    assert set(la["per_process"]) == {"p0", "p1"}
+    assert fleet_lib.latency_anatomy([]) is None
+
+
+def test_latency_anatomy_sheds_do_not_skew_latency_pools():
+    """A shed's root-only trace (closed root, zero stage spans, few-ms
+    e2e) must not drag coverage toward 0 and p50 toward 0 during the
+    shed-heavy incident the operator is debugging."""
+    evs = []
+    for i in range(3):
+        evs += _request_tree(f"ok{i}", t0=10.0 * i, dur=1.0)
+    for i in range(5):  # root-only sheds, 1ms each
+        evs.append(_span_ev(f"sh{i}", f"sh{i}-root", "request",
+                            100.0 + i, 100.001 + i,
+                            outcome="shed", hops=0))
+    la = fleet_lib.latency_anatomy(evs)
+    assert la["requests"] == 8 and la["complete"] == 8
+    assert la["e2e_p50_s"] == pytest.approx(1.0)   # served requests only
+    assert la["coverage_median"] == pytest.approx(1.0)
+    assert all(r["outcome"] == "ok" for r in la["slowest"])
+
+
+# -- SLO sentinel -------------------------------------------------------------
+
+
+def test_slo_report_verdict_ladder():
+    """GOOD at burn ≤1×, BURNING above, EXHAUSTED at ≥10× — and the slow
+    tail is judged per request against the target, not via averages."""
+    evs = []
+    for i in range(99):
+        evs += _request_tree(f"g{i}", t0=float(i), dur=0.01, tenant="good")
+    evs += _request_tree("g99", t0=99.0, dur=5.0, tenant="good")  # 1% slow
+    for i in range(10):
+        dur = 5.0 if i < 5 else 0.01                              # 50% slow
+        evs += _request_tree(f"b{i}", t0=200.0 + i, dur=dur, tenant="bad")
+    rep = fleet_lib.slo_report(evs, target_p99_s=1.0, budget=0.01)
+    assert rep["tenants"]["good"]["verdict"] == "GOOD"
+    assert rep["tenants"]["good"]["burn_rate"] == pytest.approx(1.0)
+    assert rep["tenants"]["bad"]["verdict"] == "EXHAUSTED"
+    assert rep["totals"]["verdict"] == "BURNING"  # 6/110 ≈ 5.5% > 1% budget
+    assert rep["totals"]["requests"] == 110
+
+
+def test_slo_report_counts_sheds_errors_and_traceless_fallback():
+    # traced run: errors + router tenant sheds count as violations
+    evs = _request_tree("t1", dur=0.01, tenant="t")
+    evs += _request_tree("t2", dur=0.01, tenant="t", outcome="error")
+    evs.append({"ts": 300.0, "kind": "request", "process": "router",
+                "outcome": "shed", "tenant": "t"})
+    rep = fleet_lib.slo_report(evs, target_p99_s=1.0, budget=0.5)
+    row = rep["tenants"]["t"]
+    assert row["requests"] == 3 and row["shed"] == 1 and row["errors"] == 1
+    assert row["violations"] == 2
+
+    # traced run, BARE-ENGINE sheds: queue-full rejections carry neither
+    # tenant nor trace (no router minted one) — still violations, under
+    # "default"; a replica-side shed inside a traced fleet request
+    # carries `trace` and is skipped (its root span already counted it)
+    evs2 = _request_tree("t9", dur=0.01, tenant="t")
+    evs2.append({"ts": 300.0, "kind": "request", "process": "p0",
+                 "outcome": "shed", "queue_depth": 4})
+    evs2.append({"ts": 301.0, "kind": "request", "process": "p0",
+                 "outcome": "shed", "queue_depth": 4, "trace": "t9"})
+    rep2 = fleet_lib.slo_report(evs2, target_p99_s=1.0, budget=0.5)
+    assert rep2["tenants"]["default"]["shed"] == 1
+    assert rep2["totals"]["requests"] == 2
+
+    # untraced run (no spans): plain request events under one tenant
+    reqs = [{"ts": float(i), "kind": "request", "process": "p0",
+             "outcome": "ok", "latency_s": 0.01} for i in range(9)]
+    reqs.append({"ts": 9.0, "kind": "request", "process": "p0",
+                 "outcome": "ok", "latency_s": 9.0})
+    rep = fleet_lib.slo_report(reqs, target_p99_s=1.0, budget=0.01)
+    assert rep["tenants"].keys() == {"default"}
+    assert rep["totals"]["slow"] == 1
+    assert rep["totals"]["verdict"] == "EXHAUSTED"  # 10% at 1% budget
+    assert fleet_lib.slo_report([], target_p99_s=1.0) is None
+
+
+# -- fleet rollup: failovers + per-tenant shed rate ---------------------------
+
+
+def test_serving_fleet_surfaces_failovers_and_tenant_sheds():
+    evs = [{"ts": 1.0, "kind": "request", "process": "p0", "engine": "m",
+            "outcome": "ok", "latency_s": 0.01},
+           {"ts": 2.0, "kind": "request", "process": "router",
+            "outcome": "shed", "tenant": "greedy"}]
+    evs += _request_tree("t1", tenant="greedy")
+    evs.append(_span_ev("t1", "fo1", "failover", 100.1, 100.1,
+                        parent_id="t1-root", process="router",
+                        from_replica="r0"))
+    fs = fleet_lib.serving_fleet(evs)
+    t = fs["totals"]
+    assert t["failovers"] == 1
+    greedy = t["tenants"]["greedy"]
+    assert greedy["requests"] == 2 and greedy["shed"] == 1
+    assert greedy["shed_rate"] == 0.5
+
+
+# -- dlstatus surfaces --------------------------------------------------------
+
+
+def _write_traced_run(tmp_path):
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=lambda: 0.0,
+                              host=None)
+    evs = []
+    for i, dur in enumerate([0.01, 0.02, 2.0]):
+        evs += _request_tree(f"t{i}", t0=10.0 * i, dur=dur, tenant="t0")
+    w.emit_many("span", [{k: v for k, v in e.items()
+                          if k not in ("ts", "kind", "process")}
+                         for e in evs])
+    w.emit("phase", name="run", edge="begin")
+    w.emit("phase", name="compile", edge="begin")
+    w.emit("phase", name="compile", edge="end", dur_s=0.0)
+    w.close()
+
+
+def test_dlstatus_traces_slo_and_export(tmp_path, capsys):
+    _write_traced_run(tmp_path)
+    rep = status.report(str(tmp_path), traces=True, slo_target=1.0)
+    assert rep["traces"]["requests"] == 3
+    assert rep["slo"]["tenants"]["t0"]["slow"] == 1
+
+    assert status.main([str(tmp_path), "--traces", "--slo", "1.0"]) == 0
+    out = capsys.readouterr().out
+    assert "request traces: 3" in out and "slowest requests:" in out
+    assert "SLO: p99 target 1000.0ms" in out
+    assert "EXHAUSTED" in out  # 1/3 slow at the default 1% budget
+
+    export = tmp_path / "trace.json"
+    assert status.main([str(tmp_path), "--export-trace", str(export)]) == 0
+    capsys.readouterr()
+    data = json.loads(export.read_text())  # loadable trace_event JSON
+    cats = {e.get("cat") for e in data["traceEvents"]
+            if e.get("ph") in ("X", "B")}
+    assert cats == {"serve", "train"}  # both halves of the run present
+
+    assert status.main([str(tmp_path), "--json", "--traces",
+                        "--slo", "1.0"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["traces"]["e2e_p99_s"] == pytest.approx(2.0)
+    assert rec["slo"]["totals"]["verdict"] == "EXHAUSTED"
+
+
+# -- telemetry segment rotation (satellite) -----------------------------------
+
+
+def test_writer_rotates_at_size_cap_and_reader_merges(tmp_path):
+    clock = FakeClock(0.0)
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=clock,
+                              host=None, max_mb=2e-4)  # ~200 bytes
+    for i in range(20):
+        clock.tick(1.0)
+        w.emit("heartbeat", seq=i)
+    w.close()
+    segs = telemetry.event_files(tmp_path)
+    assert len(segs) > 1, segs  # rotation happened
+    assert any(p.endswith("events-p0.jsonl") for p in segs)
+    assert any(p.endswith("events-p0.1.jsonl") for p in segs)
+    evs = telemetry.read_events(tmp_path)
+    assert [e["seq"] for e in evs] == list(range(20))  # merged in order
+
+
+def test_writer_resumes_newest_segment_after_restart(tmp_path):
+    clock = FakeClock(0.0)
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=clock,
+                              host=None, max_mb=2e-4)
+    for i in range(20):
+        clock.tick(1.0)
+        w.emit("heartbeat", seq=i)
+    w.close()
+    n_segs = len(telemetry.event_files(tmp_path))
+    # a restarted process extends its predecessor's rotation sequence
+    w2 = telemetry.EventWriter(tmp_path, process="p0", clock=FakeClock(100.0),
+                               host=None, max_mb=2e-4)
+    w2.emit("heartbeat", seq=20)
+    w2.close()
+    assert len(telemetry.event_files(tmp_path)) == n_segs
+    evs = telemetry.read_events(tmp_path)
+    assert [e["seq"] for e in evs] == list(range(21))
+
+
+def test_writer_survives_failed_rotation_reopen(tmp_path, monkeypatch):
+    """A rotation whose reopen fails (disk full, EMFILE) must degrade to
+    the telemetry warning contract — never leave a closed handle behind
+    for the next emit to die on — and recover once opens succeed again."""
+    import builtins
+
+    clock = FakeClock(0.0)
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=clock,
+                              host=None, max_mb=2e-4)
+    w.emit("heartbeat", seq=0)  # opens segment 0
+
+    real_open = builtins.open
+
+    def failing_open(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(builtins, "open", failing_open)
+    for i in range(1, 12):  # enough to cross the cap → rotation attempt
+        clock.tick(1.0)
+        w.emit("heartbeat", seq=i)  # must warn, never raise
+    monkeypatch.setattr(builtins, "open", real_open)
+    clock.tick(1.0)
+    w.emit("heartbeat", seq=99)  # recovered: lands in a real segment
+    w.close()
+    evs = telemetry.read_events(tmp_path)
+    assert evs[-1]["seq"] == 99
+
+
+def test_writer_unbounded_without_cap(tmp_path, monkeypatch):
+    monkeypatch.delenv(telemetry.MAX_MB_ENV, raising=False)
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=FakeClock(),
+                              host=None)
+    for i in range(50):
+        w.emit("heartbeat", seq=i)
+    w.close()
+    assert len(telemetry.event_files(tmp_path)) == 1
+    # malformed env cap: warn-and-ignore, never break the writer
+    monkeypatch.setenv(telemetry.MAX_MB_ENV, "banana")
+    w = telemetry.EventWriter(tmp_path, process="p1", clock=FakeClock(),
+                              host=None)
+    w.emit("heartbeat", seq=0)
+    w.close()
+    assert w._max_bytes is None
+
+
+# -- heartbeat/span hang localization (satellite) -----------------------------
+
+
+def test_heartbeat_carries_oldest_open_request_span(tmp_path):
+    clock = FakeClock(10.0)
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=clock, host=0)
+    w.note_span(("req", 1), "request")
+    clock.tick(2.0)
+    w.note_span(("req", 2), "request")
+    clock.tick(1.0)
+    w.heartbeat()
+    w.clear_span(("req", 1))
+    clock.tick(1.0)
+    w.heartbeat()
+    w.clear_span(("req", 2))
+    clock.tick(1.0)
+    w.heartbeat()
+    w.close()
+    hbs = [e for e in telemetry.read_events(tmp_path)
+           if e["kind"] == "heartbeat"]
+    # oldest open request wins; its t0 is when THAT request was noted
+    assert hbs[0]["phase"] == "request" and hbs[0]["phase_t0"] == 10.0
+    assert hbs[1]["phase"] == "request" and hbs[1]["phase_t0"] == 12.0
+    assert "phase" not in hbs[2]  # nothing open: plain liveness
+
+
+def test_open_training_phase_wins_over_request_span(tmp_path):
+    clock = FakeClock(0.0)
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=clock, host=0)
+    w.note_span(("req", 1), "request")
+    with w.phase("restore"):
+        clock.tick(1.0)
+        w.heartbeat()
+    w.close()
+    hb = next(e for e in telemetry.read_events(tmp_path)
+              if e["kind"] == "heartbeat")
+    assert hb["phase"] == "restore"
+
+
+def test_fold_host_reads_request_span_dwell():
+    """A wedged serving replica localizes like a wedged restore: the host
+    row's phase comes from the heartbeat's span enrichment, and the dwell
+    anchors on the REQUEST's start (phase_t0), not the heartbeat's ts."""
+    evs = [
+        {"ts": 100.0, "kind": "heartbeat", "process": "p0", "host": 0},
+        {"ts": 110.0, "kind": "heartbeat", "process": "p0", "host": 0,
+         "phase": "request", "phase_t0": 104.5},
+    ]
+    row = fleet_lib.host_table(evs)[0]
+    assert row["phase"] == "request"
+    assert row["phase_since_ts"] == 104.5
+
+    # a later phase-LESS heartbeat clears the position: the request
+    # completed (clear_span), and an idle replica must not read as
+    # "stuck in request" with an hour-old dwell
+    evs.append({"ts": 106.0, "kind": "heartbeat", "process": "p0",
+                "host": 0})
+    row = fleet_lib.host_table(evs)[0]
+    assert row["phase"] is None
+
+
+# -- serve-layer span emission (jit-bearing) ----------------------------------
+
+
+def _mul_forward(params, batch):
+    return {"y": batch["x"] * params["w"]}
+
+
+def test_engine_emits_joined_span_tree(tmp_path):
+    """Engine requests produce queue+infer spans; with an upstream trace
+    context they JOIN it (no second root); without one the engine roots
+    the trace itself."""
+    import jax.numpy as jnp
+
+    from distributeddeeplearningspark_tpu.serve import InferenceEngine
+
+    eng = InferenceEngine(_mul_forward, {"w": jnp.float32(2.0)},
+                          max_batch=4, max_wait_ms=2.0, max_queue=64,
+                          workdir=str(tmp_path), name="mul")
+    with eng:
+        f1 = eng.submit({"x": np.float32(3.0)},
+                        trace={"trace_id": "up1", "parent_id": "root1"})
+        f2 = eng.submit({"x": np.float32(4.0)})
+        assert float(f1.result(30)["y"]) == 6.0
+        assert float(f2.result(30)["y"]) == 8.0
+    telemetry.reset()
+    evs = telemetry.read_events(tmp_path)
+    trees = trace_lib.trace_trees(evs)
+    joined = trees["up1"]
+    # joined: stage spans only, parented under the upstream span — the
+    # root lives in the router's stream (incomplete HERE by design)
+    names = {n["span"]["name"] for n in joined["orphans"]}
+    assert names == {"queue", "infer"}
+    assert all(n["span"]["parent_id"] == "root1" for n in joined["orphans"])
+    rooted = next(t for tid, t in trees.items() if tid != "up1")
+    assert not rooted["incomplete"]
+    assert rooted["root"]["span"]["name"] == "request"
+    assert {c["span"]["name"] for c in rooted["root"]["children"]} == \
+        {"queue", "infer"}
+    # stage sum covers the request (the acceptance shape, engine path)
+    anat = next(r for r in trace_lib.request_anatomy(evs)
+                if not r["incomplete"])
+    assert anat["coverage"] is not None and anat["coverage"] >= 0.95
+
+
+def test_engine_error_batch_emits_error_spans(tmp_path):
+    import jax.numpy as jnp
+
+    from distributeddeeplearningspark_tpu.serve import InferenceEngine
+
+    eng = InferenceEngine(_mul_forward, {"w": jnp.float32(1.0)},
+                          max_batch=4, max_wait_ms=2.0, max_queue=64,
+                          workdir=str(tmp_path), name="mul")
+    with eng:
+        bad = eng.submit({"y": np.float32(1.0)})   # wrong key: forward dies
+        with pytest.raises(Exception):
+            bad.result(30)
+    telemetry.reset()
+    evs = telemetry.read_events(tmp_path)
+    roots = [e for e in trace_lib.spans_of(evs)
+             if e["name"] == "request" and not e.get("parent_id")]
+    assert len(roots) == 1
+    assert roots[0]["attrs"]["outcome"] == "error"
+    assert "error" in roots[0]["attrs"]
+
+
+@pytest.fixture(scope="module")
+def micro_llama():
+    import jax
+    import jax.numpy as jnp
+
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=64,
+                      max_position=64, dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((1, 8), np.int32)},
+                        train=False)["params"]
+    return cfg, params
+
+
+def test_router_generator_full_causal_tree(tmp_path, micro_llama):
+    """The tentpole end to end, in process: router root → place → replica
+    queue/admission/prefill/decode/stream, one tree per request, stage sum
+    ≥95% of the measured end-to-end latency (the acceptance bar)."""
+    from distributeddeeplearningspark_tpu.serve import (
+        ContinuousGenerator,
+        LocalReplica,
+        Router,
+    )
+
+    cfg, params = micro_llama
+    telemetry.reset()
+    gen = ContinuousGenerator(cfg, params, slots=2, max_cache_len=64,
+                              page_size=8, workdir=str(tmp_path),
+                              name="tinyllama", gauge_interval_s=0.2)
+    gen.start()
+    router = Router([LocalReplica("r0", gen)], workdir=str(tmp_path))
+    futs = [router.submit(
+        {"prompt": np.arange(1, 9, dtype=np.int32) + i, "max_new_tokens": 4},
+        op="generate", tenant=f"t{i % 2}") for i in range(4)]
+    for f in futs:
+        f.result(timeout=120)
+    gen.stop()
+    router._tele.close()
+    telemetry.reset()
+
+    evs = telemetry.read_events(tmp_path)
+    anat = [r for r in trace_lib.request_anatomy(evs)]
+    assert len(anat) == 4
+    for r in anat:
+        assert not r["incomplete"], r
+        assert set(r["stages"]) >= {"queue", "admission", "prefill",
+                                    "decode", "stream"}, r
+        assert r["coverage"] >= 0.95, r
+        assert r["outcome"] == "ok" and r["tenant"] in ("t0", "t1")
+    # trees root in the ROUTER's stream; stage spans carry the replica's
+    trees = trace_lib.trace_trees(evs)
+    tree = trees[anat[0]["trace_id"]]
+    assert tree["root"]["span"]["process"] == "router"
+    child_names = {c["span"]["name"] for c in tree["root"]["children"]}
+    assert "place" in child_names and "decode" in child_names
+    # the decode span carries the per-token timeline + first-token latency
+    decode = next(c["span"] for c in tree["root"]["children"]
+                  if c["span"]["name"] == "decode")
+    assert decode["attrs"]["tokens"] == 4
+    assert len(decode["attrs"]["token_ms"]) == 4
+    assert decode["attrs"]["first_token_s"] > 0
+    # prefix/admission evidence rides the admission span
+    admission = next(c["span"] for c in tree["root"]["children"]
+                     if c["span"]["name"] == "admission")
+    assert "prefix_hit" in admission["attrs"]
+    # the SLO sentinel reads the same stream
+    rep = fleet_lib.slo_report(evs, target_p99_s=60.0)
+    assert rep["totals"]["verdict"] == "GOOD"
+    assert set(rep["tenants"]) == {"t0", "t1"}
+
+
+def test_router_failover_span_and_hops(tmp_path):
+    """A replica dying mid-request leaves a failover hop in the trace and
+    hops=1 on the root; the rollup surfaces the count."""
+    from concurrent.futures import Future
+
+    from distributeddeeplearningspark_tpu.serve import Router
+    from distributeddeeplearningspark_tpu.serve.router import (
+        ReplicaDiedError,
+    )
+
+    class _Replica:
+        def __init__(self, name, die=False):
+            self.name = name
+            self.alive = True
+            self.die = die
+            self.submitted = []
+
+        def submit(self, payload, op="infer"):
+            fut = Future()
+            self.submitted.append((payload, fut))
+            if self.die:
+                fut.set_exception(ReplicaDiedError(self.name))
+            return fut
+
+    dying, healthy = _Replica("r0", die=True), _Replica("r1")
+    r = Router([dying, healthy], workdir=str(tmp_path))
+    fut = r.submit({"x": 1}, tenant="t0")
+    # the dying replica's future failed synchronously → the router already
+    # failed over; whichever replica was picked first, the request must
+    # have landed on the healthy one with the SAME trace context
+    assert len(healthy.submitted) == 1
+    tid = healthy.submitted[0][0]["trace"]["trace_id"]
+    if dying.submitted:
+        assert dying.submitted[0][0]["trace"]["trace_id"] == tid
+    healthy.submitted[0][1].set_result({"y": 1})
+    assert fut.result(5) == {"y": 1}
+    r._tele.close()
+
+    evs = telemetry.read_events(tmp_path)
+    spans = trace_lib.spans_of(evs)
+    assert any(s["name"] == "failover" for s in spans)
+    root = next(s for s in spans if s["name"] == "request")
+    assert root["attrs"]["hops"] == 1 and root["attrs"]["outcome"] == "ok"
+    # place spans: one per dispatch attempt, naming the replica
+    places = [s for s in spans if s["name"] == "place"]
+    assert [s["attrs"]["replica"] for s in places][-1] == "r1"
+    assert r.stats()["failovers"] == 1
+    # the rollup surfaces the hop count
+    fs = fleet_lib.serving_fleet(evs + [
+        {"ts": 1.0, "kind": "request", "process": "p0", "outcome": "ok"}])
+    assert fs["totals"]["failovers"] == 1
+
+
+def test_router_replica_shed_roots_outcome_shed(tmp_path):
+    """A replica-side OverloadedError is the typed shed contract, not a
+    failure: the root span must say outcome=shed so the tenant folds
+    (serving_fleet, slo_report) account overload as capacity, not bugs."""
+    from concurrent.futures import Future
+
+    from distributeddeeplearningspark_tpu.serve import Router
+    from distributeddeeplearningspark_tpu.serve.engine import OverloadedError
+
+    class _Replica:
+        name, alive = "r0", True
+
+        def submit(self, payload, op="infer"):
+            fut = Future()
+            fut.set_exception(OverloadedError(4, 4))
+            return fut
+
+    r = Router([_Replica()], workdir=str(tmp_path))
+    with pytest.raises(OverloadedError):
+        r.submit({"x": 1}, tenant="t0").result(5)
+    r._tele.close()
+    evs = telemetry.read_events(tmp_path)
+    root = next(s for s in trace_lib.spans_of(evs)
+                if s["name"] == "request")
+    assert root["attrs"]["outcome"] == "shed"
+    rep = fleet_lib.slo_report(evs, target_p99_s=1.0, budget=0.5)
+    assert rep["tenants"]["t0"]["shed"] == 1
+    assert rep["tenants"]["t0"]["errors"] == 0
+
+
+def test_generator_prefill_error_emits_error_span(tmp_path, micro_llama):
+    """A poisoned prompt that dies in prefill still yields a trace: root
+    outcome=error with queue + admission evidence, never an unclosed
+    stream the reader chokes on."""
+    from distributeddeeplearningspark_tpu.serve import ContinuousGenerator
+
+    cfg, params = micro_llama
+    telemetry.reset()
+    gen = ContinuousGenerator(cfg, params, slots=2, max_cache_len=64,
+                              page_size=8, workdir=str(tmp_path),
+                              name="tinyllama")
+    # poison AFTER submit-side validation: out-of-vocab ids crash the
+    # gather inside the jitted prefill on some paths; more robustly, break
+    # the prefill function itself
+    gen._paged_prefill = _boom
+    gen.start()
+    fut = gen.submit(np.arange(1, 9, dtype=np.int32), 4)
+    with pytest.raises(RuntimeError, match="boom"):
+        fut.result(timeout=30)
+    gen.stop()
+    telemetry.reset()
+    evs = telemetry.read_events(tmp_path)
+    anat = trace_lib.request_anatomy(evs)
+    assert len(anat) == 1
+    assert anat[0]["outcome"] == "error"
+    assert not anat[0]["incomplete"]  # error traces still close cleanly
+    assert "queue" in anat[0]["stages"]
+    # the failing prefill's elapsed time is booked as PREFILL — landing
+    # it under stream/decode would send the anatomy chasing a ghost stage
+    assert "prefill" in anat[0]["stages"]
+    assert "stream" not in anat[0]["stages"]
+    assert "decode" not in anat[0]["stages"]
+
+
+def _boom(*a, **k):
+    raise RuntimeError("boom")

@@ -259,6 +259,97 @@ print("rps=%s ok=%s dropped=0 reloads=%s prefix_hits=%s hit_rate=%s"
   return $rc
 }
 
+# trace smoke (ISSUE 7): the same 2-replica tinyllama fleet under load,
+# twice — once healthy, once with a sleep fault injected into replica 0's
+# decode loop. Every completed request must yield a COMPLETE causal span
+# tree whose stage sum covers >=95% of its end-to-end latency;
+# `dlstatus --export-trace` must emit loadable Chrome trace_event JSON;
+# and `dlstatus --slo` must flip its verdict from GOOD on the healthy run
+# to BURNING/EXHAUSTED on the faulted one at the SAME target.
+run_trace_smoke() {
+  local t0 rc wd wdf out
+  t0=$(date +%s)
+  rc=0
+  wd=$(mktemp -d /tmp/dls_trace_smoke.XXXXXX)
+  wdf=$(mktemp -d /tmp/dls_trace_fault.XXXXXX)
+  python -m distributeddeeplearningspark_tpu.serve.cli \
+      --model tinyllama --replicas 2 --clients 4 --requests-per-client 3 \
+      --tenants 2 --prefix-tokens 32 --suffix-tokens 8 --max-new-tokens 8 \
+      --workdir "$wd" >"$wd/serve.json" 2>"$wd/dlserve.log" || rc=$?
+  if [ "$rc" -eq 0 ]; then
+    python -m distributeddeeplearningspark_tpu.serve.cli \
+        --model tinyllama --replicas 2 --clients 4 --requests-per-client 3 \
+        --tenants 2 --prefix-tokens 32 --suffix-tokens 8 --max-new-tokens 8 \
+        --fault-sleep-ms 1000 --fault-replica 0 \
+        --workdir "$wdf" >"$wdf/serve.json" 2>"$wdf/dlserve.log" || rc=$?
+  fi
+  if [ "$rc" -eq 0 ]; then
+    out=$(WD="$wd" WDF="$wdf" python - <<'PYEOF'
+import json, os, subprocess, sys
+
+from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
+
+wd, wdf = os.environ["WD"], os.environ["WDF"]
+
+def dlstatus(*argv):
+    p = subprocess.run(
+        [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+         *argv], capture_output=True, text=True)
+    assert p.returncode == 0, (argv, p.stderr[-500:])
+    return p
+
+# 1) every request the healthy fleet completed left a complete causal
+#    tree, and its stage sum explains >=95% of the e2e latency
+anat = trace_lib.request_anatomy(telemetry.read_events(wd))
+done = [r for r in anat if r["outcome"] == "ok"]
+assert len(done) >= 12, f"expected 12 completed traced requests: {len(done)}"
+for r in done:
+    assert not r["incomplete"], r
+    assert r["coverage"] is not None and r["coverage"] >= 0.95, (
+        r["trace_id"], r["coverage"], r["stages"])
+
+# 2) --export-trace emits loadable Chrome trace_event JSON
+export = os.path.join(wd, "trace.json")
+dlstatus(wd, "--export-trace", export, "--json")
+data = json.load(open(export))
+spans = [e for e in data["traceEvents"] if e.get("ph") in ("X", "B")]
+assert spans, "export produced no span events"
+
+# 3) the SLO sentinel flips on the injected sleep fault: one target,
+#    derived from the healthy run's own p99, judges both runs
+rep = json.loads(dlstatus(wd, "--json", "--traces").stdout)
+target = max(1.0, 1.5 * rep["traces"]["e2e_p99_s"])
+healthy = json.loads(
+    dlstatus(wd, "--json", "--slo", str(target)).stdout)["slo"]["totals"]
+faulted = json.loads(
+    dlstatus(wdf, "--json", "--slo", str(target)).stdout)["slo"]["totals"]
+assert healthy["verdict"] == "GOOD", healthy
+assert faulted["verdict"] in ("BURNING", "EXHAUSTED"), faulted
+assert faulted["slow"] >= 1, faulted
+
+# 4) the anatomy names the culprit: the faulted replica's decode p99
+#    carries the injected 1s-per-step sleep; the healthy replica's doesn't
+anat_f = json.loads(dlstatus(wdf, "--json", "--traces").stdout)["traces"]
+slow_decode = anat_f["per_process"]["p0"].get("decode", {})
+assert (slow_decode.get("p99_s") or 0) >= 0.5, anat_f["per_process"]
+
+cov = min(r["coverage"] for r in done)
+print(f"requests={len(done)} min_coverage={cov:.3f} "
+      f"export_spans={len(spans)} target_p99={target:.2f}s "
+      f"healthy={healthy['verdict']} faulted={faulted['verdict']} "
+      f"burn={faulted['burn_rate']}x")
+PYEOF
+) || { rc=$?; tail -5 "$wd/dlserve.log" "$wdf/dlserve.log" 2>/dev/null; }
+  else
+    tail -5 "$wd/dlserve.log" "$wdf/dlserve.log" 2>/dev/null
+  fi
+  log trace "${out:-trace smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[trace] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$wd" "$wdf"
+  return $rc
+}
+
 overall=0
 case "${1:-both}" in
   fast) run_tier fast "not slow" || overall=$? ;;
@@ -279,6 +370,10 @@ case "${1:-both}" in
   # serving fleet: 2 replica processes + router + rolling reload + paged
   # KV/prefix cache, zero dropped requests (docs/SERVING.md "Fleet")
   fleet-serve) run_fleet_serve_smoke || overall=$? ;;
+  # request tracing: span-tree coverage >=95% per completed request,
+  # loadable --export-trace JSON, --slo verdict flip on an injected sleep
+  # fault (docs/OBSERVABILITY.md "Tracing a request")
+  trace) run_trace_smoke || overall=$? ;;
   # input pipeline: 2-worker pool beats the serial map on a synthetic JPEG
   # corpus, and telemetry carries the per-worker gauges (docs/PERFORMANCE.md)
   input) run_input_smoke || overall=$? ;;
@@ -286,6 +381,6 @@ case "${1:-both}" in
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|input|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
