@@ -969,6 +969,39 @@ def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
             workers_sweep[str(nw)] = round(_worker_rate(nw), 1)
         full, one = workers_sweep[str(nproc)], workers_sweep["1"]
         rec_tmp.cleanup()
+
+    # Distributed-shuffle scaling curve (ISSUE 8): keys/sec of a
+    # reduce_by_key count at cardinality × workers — serial driver dict vs
+    # the data/exchange.py cross-worker shuffle. Keys are canonical-hash
+    # bucketed on BOTH paths, so the compared work is identical; the curve
+    # is what the VERDICT judges (same caveat as the pool sweep above:
+    # this box's nproc bounds the honest ceiling, and `nproc` rides in
+    # the record).
+    from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+    def _shuffle_rate(cardinality: int, nw: int) -> float:
+        def part(p, nparts=4):
+            def gen():
+                # 2 pairs per key so the reduce does real combining
+                for i in range(p, 2 * cardinality, nparts):
+                    yield (i % cardinality, 1)
+            return gen
+
+        ds = PartitionedDataset([part(p) for p in range(4)])
+        t0 = time.perf_counter()
+        out = ds.reduce_by_key(lambda a, b: a + b, num_workers=nw)
+        seen = sum(1 for i in range(out.num_partitions)
+                   for _ in out.iter_partition(i))
+        assert seen == cardinality, (seen, cardinality)
+        return cardinality / (time.perf_counter() - t0)
+
+    shuffle_sweep: dict = {}
+    for card in (10_000, 200_000):
+        row = {"serial": round(_shuffle_rate(card, 0), 1)}
+        for nw in sweep_counts:
+            row[str(nw)] = round(_shuffle_rate(card, nw), 1)
+        shuffle_sweep[str(card)] = row
+    big = shuffle_sweep[str(200_000)]
     return {
         # keep this key's historical meaning (JPEG-decode path) so the series
         # stays comparable across rounds; the record path reports separately
@@ -985,6 +1018,11 @@ def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
         "workers_speedup_full_vs_1": round(full / one, 2),
         "workers_speedup_full_vs_serial": round(
             full / workers_sweep["serial"], 2),
+        # data/exchange.py shuffle scaling curve: reduce_by_key keys/sec
+        # by cardinality × workers ("serial" = the driver-dict path)
+        "shuffle_keys_per_sec": shuffle_sweep,
+        "shuffle_speedup_full_vs_serial": round(
+            big[str(nproc)] / big["serial"], 2),
         "materialize_images_per_sec": round(n_images / mat_dt, 1),
         "native_kernels": native.available(),
         "image_px": size,

@@ -31,6 +31,7 @@ and composed with operators and functions (:func:`log1p`,
 
 from __future__ import annotations
 
+import itertools
 import zlib
 from typing import Any, Callable, Iterable, Iterator, Mapping, Sequence
 
@@ -459,6 +460,108 @@ class DataFrame:
 _AGG_FNS = ("count", "sum", "mean", "min", "max")
 
 
+def _agg_partial(ch: Chunk, keys: Sequence[str],
+                 spec: Mapping[str, str]) -> list:
+    """One chunk's vectorized group partials: ``[(key_tuple, (count,
+    (col_stats, ...)))]`` with one ``col_stats`` per spec column in spec
+    order — ``None`` for count-only columns, ``(sum, min|None, max|None)``
+    otherwise. Per value column only the stats its fn needs are computed
+    (ufunc.at is a per-element C loop; paying min/max passes for a
+    sum-only spec would undercut the vectorized claim); mean derives from
+    (sum, count). Keys and stats are PYTHON scalars (``.tolist()``), not
+    numpy ones — a 10M-key shuffle pickles every entry, and np.int64
+    pickles ~20x slower and 5x bigger than int. Keys are unique within the
+    returned list (np.unique dedups the chunk). Shared by the serial
+    driver merge and the distributed exchange mappers, so both paths
+    produce identical partials."""
+    n = _chunk_rows(ch)
+    if n == 0:
+        return []
+    key_arrays = [np.asarray(ch[k]) for k in keys]
+    for k, a in zip(keys, key_arrays):
+        if a.dtype == object:
+            # np.unique(axis=0) can't take object arrays and its
+            # TypeError names neither column nor fix — fail clearly
+            raise ValueError(
+                f"groupBy key '{k}' has object dtype (e.g. None "
+                f"among values); fillna()/hash_bucket it to a "
+                f"concrete dtype first")
+        if np.issubdtype(a.dtype, np.floating) and np.isnan(a).any():
+            # tuple(nan) dict keys never compare equal, so NaN
+            # groups would silently split per chunk instead of
+            # merging — the fillna-first flow is the documented fix
+            raise ValueError(
+                f"groupBy key '{k}' contains NaN; fillna() it "
+                f"first (NaN never equals NaN, so NaN groups "
+                f"cannot merge)")
+    stacked = np.stack(key_arrays, axis=1)
+    uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
+    g = uniq.shape[0]
+    cnt = np.bincount(inv, minlength=g).tolist()
+    cols: list = []
+    for c, fn in spec.items():
+        if fn == "count":
+            # bincount already carries the answer; coercing the
+            # column would also crash string-typed count() keys
+            cols.append(None)
+            continue
+        v = np.asarray(ch[c], np.float64)
+        s = np.bincount(inv, weights=v, minlength=g)
+        mn = mx = None
+        if fn == "min":
+            mn = np.full(g, np.inf)
+            np.minimum.at(mn, inv, v)
+        elif fn == "max":
+            mx = np.full(g, -np.inf)
+            np.maximum.at(mx, inv, v)
+        cols.append((s.tolist(),
+                     None if mn is None else mn.tolist(),
+                     None if mx is None else mx.tolist()))
+    # zip-built (C speed): a per-key Python genexpr here was the single
+    # hottest line of a 10M-key shuffle's map phase
+    per_col: list = []
+    for col in cols:
+        if col is None:
+            per_col.append(itertools.repeat(None, g))
+        else:
+            s, mn, mx = col
+            per_col.append(zip(s,
+                               mn if mn is not None
+                               else itertools.repeat(None),
+                               mx if mx is not None
+                               else itertools.repeat(None)))
+    entries = zip(cnt, zip(*per_col))
+    return list(zip(map(tuple, uniq.tolist()), entries))
+
+
+def _merge_agg_entry(a: tuple, b: tuple) -> tuple:
+    """Merge two group partials (commutative — sum/min/max/count), the
+    exchange's combine/merge function for ``groupBy().agg``."""
+    cnt = a[0] + b[0]
+    out: list = []
+    for sa, sb in zip(a[1], b[1]):
+        if sa is None:
+            out.append(None)
+            continue
+        out.append((sa[0] + sb[0],
+                    sa[1] if sb[1] is None else
+                    (sb[1] if sa[1] is None else min(sa[1], sb[1])),
+                    sa[2] if sb[2] is None else
+                    (sb[2] if sa[2] is None else max(sa[2], sb[2]))))
+    return (cnt, tuple(out))
+
+
+def _agg_row_value(fn: str, cnt: int, stats) -> Any:
+    """One output cell from a merged group entry — the ONE formula both
+    paths share (mean = sum/count, so bit-equality follows from the
+    partials being equal)."""
+    if fn == "count":
+        return cnt
+    s, mn, mx = stats
+    return {"sum": s, "mean": s / cnt if cnt else np.nan,
+            "min": mn, "max": mx}[fn]
+
+
 class GroupedData:
     """Result of :meth:`DataFrame.groupBy`; terminal ops produce a
     single-partition DataFrame of one row per group."""
@@ -480,7 +583,8 @@ class GroupedData:
         return out.withColumnRenamed(f"count({self._keys[0]})", "count")
 
     def agg(self, spec: Mapping[str, str], *,
-            max_groups: int | None = None) -> DataFrame:
+            max_groups: int | None = None,
+            num_workers: int | None = None) -> DataFrame:
         """``{"col": "sum"|"mean"|"min"|"max"|"count"}`` → one row per
         distinct key tuple, pyspark-style ``fn(col)`` output names.
 
@@ -488,20 +592,28 @@ class GroupedData:
         scan runs on the output's first iteration, memoized cache()-style
         after that.
 
-        ``max_groups`` (default ``DLS_AGG_MAX_GROUPS`` or 1_000_000): the
-        distinct-key ceiling. Chunk partials merge in a DRIVER-SIDE dict
-        (SURVEY §7: no shuffle service) — fine for the vocab-sized results
-        this plane is documented for (Criteo's 26 categorical
-        vocabularies), but a user-id-like key would silently grow an
-        unbounded dict; past the ceiling the scan refuses loudly with the
-        ``hash_bucket`` remediation instead (VERDICT r5 weak-#7).
+        With workers (``num_workers=`` / ``DLS_DATA_WORKERS``): the scan
+        routes through the distributed exchange (:mod:`~.exchange`) —
+        chunk partials bucket by canonical key hash, per-bucket reducers
+        merge with spill-to-disk under ``DLS_SHUFFLE_MEM_MB`` — so there
+        is NO cardinality ceiling; a 10M-key aggregation completes under a
+        bounded memory budget. Output rows stream bucket-major in
+        canonical key order, one partition per bucket.
+
+        Serial (no workers): chunk partials merge in a DRIVER-SIDE dict —
+        fine for the vocab-sized results this plane is documented for
+        (Criteo's 26 categorical vocabularies) — bounded by ``max_groups``
+        (default ``DLS_AGG_MAX_GROUPS`` or 1_000_000); past the ceiling
+        the scan refuses loudly, naming ``DLS_DATA_WORKERS`` (the exchange)
+        as the first remediation. Rows come in the SAME canonical bucket-
+        major order as the exchange path, so results are byte-identical at
+        any worker count.
         """
         keys, df = self._keys, self._df
-        if max_groups is None:
-            import os
+        from distributeddeeplearningspark_tpu.data import exchange
 
-            max_groups = int(os.environ.get("DLS_AGG_MAX_GROUPS", "")
-                             or 1_000_000)
+        if max_groups is None:
+            max_groups = exchange.max_groups_limit()
         if max_groups < 1:
             raise ValueError(f"max_groups must be >= 1, got {max_groups}")
         bad = {c: f for c, f in spec.items()
@@ -510,62 +622,41 @@ class GroupedData:
             raise ValueError(
                 f"unsupported agg spec {bad or spec!r}; columns="
                 f"{df.columns}, fns={_AGG_FNS}")
+        names = keys + [f"{f}({c})" for c, f in spec.items()]
+        spec = dict(spec)
+        n_out = df._chunks.num_partitions
 
-        # per-chunk vectorized partials: per value column, only the stats
-        # its fn needs (ufunc.at is a per-element C loop — paying min/max
-        # passes for a sum-only spec would undercut the vectorized claim);
-        # mean is derived from (sum, count)
-        def partial(ch: Chunk) -> dict:
-            n = _chunk_rows(ch)
-            if n == 0:
-                return {}
-            key_arrays = [np.asarray(ch[k]) for k in keys]
-            for k, a in zip(keys, key_arrays):
-                if a.dtype == object:
-                    # np.unique(axis=0) can't take object arrays and its
-                    # TypeError names neither column nor fix — fail clearly
-                    raise ValueError(
-                        f"groupBy key '{k}' has object dtype (e.g. None "
-                        f"among values); fillna()/hash_bucket it to a "
-                        f"concrete dtype first")
-                if np.issubdtype(a.dtype, np.floating) and np.isnan(a).any():
-                    # tuple(nan) dict keys never compare equal, so NaN
-                    # groups would silently split per chunk instead of
-                    # merging — the fillna-first flow is the documented fix
-                    raise ValueError(
-                        f"groupBy key '{k}' contains NaN; fillna() it "
-                        f"first (NaN never equals NaN, so NaN groups "
-                        f"cannot merge)")
-            stacked = np.stack(key_arrays, axis=1)
-            uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
-            g = uniq.shape[0]
-            cnt = np.bincount(inv, minlength=g)
-            out: dict = {}
-            for c, fn in spec.items():
-                if fn == "count":
-                    # bincount already carries the answer; coercing the
-                    # column would also crash string-typed count() keys
-                    out[c] = None
-                    continue
-                v = np.asarray(ch[c], np.float64)
-                s = np.bincount(inv, weights=v, minlength=g)
-                mn = mx = None
-                if fn == "min":
-                    mn = np.full(g, np.inf)
-                    np.minimum.at(mn, inv, v)
-                elif fn == "max":
-                    mx = np.full(g, -np.inf)
-                    np.maximum.at(mx, inv, v)
-                out[c] = (s, mn, mx)
-            return {tuple(uniq[i]): (int(cnt[i]),
-                                     {c: (None if out[c] is None else
-                                          (out[c][0][i],
-                                           None if out[c][1] is None
-                                           else out[c][1][i],
-                                           None if out[c][2] is None
-                                           else out[c][2][i]))
-                                      for c in spec})
-                    for i in range(g)}
+        nw = exchange.resolve_shuffle_workers(num_workers)
+        if nw:
+            ex_spec = exchange._Spec(
+                pre=lambda ch: _agg_partial(ch, keys, spec),
+                combine=_merge_agg_entry)
+            recs = exchange._lazy_exchange_dataset(
+                df._chunks._parts, num_workers=nw, n_out=n_out,
+                spec=ex_spec, label="groupBy.agg")
+
+            def to_chunks(it: Iterable) -> Iterator[Chunk]:
+                buf: list[tuple] = []
+
+                def emit(buf: list[tuple]) -> Chunk:
+                    ch: Chunk = {
+                        k: np.asarray([key[i] for key, _ in buf])
+                        for i, k in enumerate(keys)}
+                    for ci, (c, f) in enumerate(spec.items()):
+                        ch[f"{f}({c})"] = np.asarray(
+                            [_agg_row_value(f, cnt, per_col[ci])
+                             for _, (cnt, per_col) in buf])
+                    return ch
+
+                for rec in it:
+                    buf.append(rec)
+                    if len(buf) >= DEFAULT_CHUNK_ROWS:
+                        yield emit(buf)
+                        buf = []
+                if buf:
+                    yield emit(buf)
+
+            return DataFrame(recs.map_partitions(to_chunks), names)
 
         memo: dict = {}
 
@@ -574,7 +665,7 @@ class GroupedData:
                 return memo["chunk"]
             acc: dict = {}
             for ch in df._iter_chunks():
-                for key, (cnt, per_col) in partial(ch).items():
+                for key, (cnt, per_col) in _agg_partial(ch, keys, spec):
                     if key not in acc:
                         if len(acc) >= max_groups:
                             raise ValueError(
@@ -582,45 +673,39 @@ class GroupedData:
                                 f"{max_groups} distinct keys — the partials "
                                 f"merge in a driver-side dict sized for "
                                 f"vocab-scale results, and this key looks "
-                                f"high-cardinality (user-id-like). "
+                                f"high-cardinality (user-id-like). Set "
+                                f"DLS_DATA_WORKERS=N (or pass num_workers=) "
+                                f"to route through the distributed shuffle "
+                                f"exchange, which spills to disk under "
+                                f"DLS_SHUFFLE_MEM_MB and has no ceiling; or "
                                 f"hash_bucket(col({keys[0]!r}), num_buckets) "
-                                f"the key first to bound the result, or "
+                                f"the key first to bound the result; or "
                                 f"raise max_groups= / DLS_AGG_MAX_GROUPS if "
                                 f"the grouped result genuinely fits the "
                                 f"driver")
-                        acc[key] = [cnt, dict(per_col)]
+                        acc[key] = (cnt, per_col)
                     else:
-                        acc[key][0] += cnt
-                        for c, stats in per_col.items():
-                            if stats is None:  # count-only column
-                                continue
-                            s, mn, mx = stats
-                            s0, mn0, mx0 = acc[key][1][c]
-                            acc[key][1][c] = (
-                                s0 + s,
-                                mn0 if mn is None else min(mn0, mn),
-                                mx0 if mx is None else max(mx0, mx))
-            rows_keys = list(acc.keys())
+                        acc[key] = _merge_agg_entry(acc[key],
+                                                    (cnt, per_col))
+            # canonical bucket-major, key_bytes-ordered rows — the exact
+            # layout the exchange path streams, so 0 workers == N workers
+            keyed = []
+            for k in acc:
+                kb = exchange.key_bytes(k)
+                keyed.append(((exchange.bucket_of(kb, n_out), kb), k))
+            keyed.sort(key=lambda t: t[0])
+            rows_keys = [k for _, k in keyed]
             chunk: Chunk = {
                 k: np.asarray([rk[i] for rk in rows_keys])
                 for i, k in enumerate(keys)
             }
-            for c, f in spec.items():
-                if f == "count":
-                    vals = [acc[rk][0] for rk in rows_keys]
-                else:
-                    vals = [
-                        {"sum": s, "mean": s / cnt_ if cnt_ else np.nan,
-                         "min": mn, "max": mx}[f]
-                        for rk in rows_keys
-                        for cnt_, (s, mn, mx) in [(acc[rk][0],
-                                                   acc[rk][1][c])]
-                    ]
-                chunk[f"{f}({c})"] = np.asarray(vals)
+            for ci, (c, f) in enumerate(spec.items()):
+                chunk[f"{f}({c})"] = np.asarray(
+                    [_agg_row_value(f, acc[rk][0], acc[rk][1][ci])
+                     for rk in rows_keys])
             memo["chunk"] = chunk
             return chunk
 
-        names = keys + [f"{f}({c})" for c, f in spec.items()]
         return DataFrame(
             PartitionedDataset.from_generators(
                 [lambda: iter([result_chunk()])]),

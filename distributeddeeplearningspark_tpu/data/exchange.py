@@ -1,0 +1,914 @@
+"""Cross-worker hash-partitioned shuffle — the wide-transformation engine.
+
+PR 5 drew the honest line of the narrow engine: every wide transformation
+(``reduce_by_key``, ``group_by_key``, ``groupBy().agg``) merged its partials
+in ONE driver-side dict, and the ``max_groups`` ceiling *refused*
+high-cardinality workloads (user-id-like keys) rather than run them out of
+memory. This module breaks that ceiling with the classic map/reduce-over-
+partitions shape (DrJAX, PAPERS.md 2403.07128) built on the process-pool
+machinery ``data/workers.py`` already proved:
+
+- **Map side.** ``M`` forked mapper processes walk the source partitions
+  (partition ``p`` → mapper ``p % M``; when ``M > P`` mappers split a
+  partition by element-residue classes, the WorkerPool discipline) and
+  combine locally into a bounded dict. When the dict outgrows its share of
+  ``DLS_SHUFFLE_MEM_MB`` it *flushes*: entries bucket by canonical key hash
+  (:func:`key_bytes` — blake2b over the pickled key, NOT Python's
+  per-process-seeded ``hash``) and each bucket's payload ships to its
+  owning reducer through the mapper's shared-memory arena
+  (:class:`~.workers._Arena`, the same first-fit out-of-order reclaim),
+  falling back to pickled queue transport when the arena is full — counted,
+  never stalled, exactly the batch-plane discipline.
+- **Exchange.** Barrier-free: one queue per reducer, payloads stream as
+  flushes happen, reducers merge incrementally while mappers still run.
+  A mapper that raises forwards its traceback; one that *dies* (SIGKILL,
+  OOM) is caught by the driver's liveness poll — either way the caller gets
+  a typed :class:`~.workers.WorkerCrashed` within a bounded wait and every
+  child, shm segment, and spill file is torn down. A dead mapper is a
+  supervisor-visible CRASH, not a hang.
+- **Reduce side.** ``R`` reducer processes own buckets ``b % R == r`` and
+  merge arriving partials into per-bucket dicts under their share of the
+  memory budget; past it they **spill**: items sorted by :func:`key_bytes`
+  stream to a run file, and finalization k-way-merges the sorted runs
+  (``heapq.merge``) combining adjacent equal keys — a 10M-key aggregation
+  completes under a budget the old ceiling refused at. Final output streams
+  to one file per bucket; the returned dataset's partitions re-read those
+  files, so nothing is ever fully materialized driver-side.
+
+**Determinism.** Output order is canonical: bucket-major, :func:`key_bytes`
+order within each bucket — data-derived, so results are byte-identical at
+ANY worker count (the serial fallbacks in ``rdd.py``/``data/dataframe.py``
+emit the same canonical order, which also makes them reproducible across
+runs — the old ``hash(k) % n`` bucketing moved with ``PYTHONHASHSEED``).
+Value-combine order is NOT fixed across worker counts (partials merge as
+they arrive), so reduce functions must be commutative + associative —
+Spark's own ``reduceByKey`` contract; results are bit-identical when the
+combine is exact (int sums, min/max, counts; float sums are exact while
+magnitudes stay within 2^53). ``group_by_key`` value lists ARE exactly
+ordered: values travel tagged with their (partition, index) position and
+sort back to encounter order at emit.
+
+**Telemetry.** The driver wraps the run in ``shuffle-map`` / ``shuffle-
+merge`` phase spans (lowered into the PR 7 span model like any phase) and
+mirrors reducer spills plus a final summary as ``shuffle`` gauge events —
+``dlstatus`` renders them as the shuffle block (bytes moved, spill count,
+per-bucket skew, slowest-bucket verdict).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import multiprocessing as mp
+import os
+import pickle
+import queue as queue_lib
+import shutil
+import tempfile
+import time
+import traceback
+import uuid
+import warnings
+import weakref
+from multiprocessing import shared_memory
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.data.workers import (
+    _POLL_S, _Arena, _align, WorkerCrashed, fork_available,
+    resolve_num_workers)
+
+#: env knob: total shuffle memory budget (MB) split over mapper arenas,
+#: mapper combine dicts, and reducer merge dicts. Past their share, mappers
+#: flush early and reducers spill to disk — the budget bounds resident
+#: bytes, it never refuses a workload.
+MEM_MB_ENV = "DLS_SHUFFLE_MEM_MB"
+_DEFAULT_MEM_MB = 256
+#: env knob: where spill runs and bucket output files live (default: a
+#: fresh tempdir per shuffle, removed when the result is garbage-collected
+#: or the exchange fails).
+SPILL_DIR_ENV = "DLS_SHUFFLE_SPILL_DIR"
+#: env knob shared with data/dataframe.py: the serial-path distinct-key /
+#: materialization ceiling (the exchange path has no ceiling — that is the
+#: point of it).
+MAX_GROUPS_ENV = "DLS_AGG_MAX_GROUPS"
+_DEFAULT_MAX_GROUPS = 1_000_000
+
+_PICKLE_PROTO = 4
+#: per-reducer metadata queue bound: flush payloads in flight beyond the
+#: arenas (backpressure's item-count half, as in workers.py).
+_QUEUE_AHEAD = 16
+#: how long a mapper waits for arena space before the pickle fallback.
+_ALLOC_WAIT_S = 0.25
+_MIN_ARENA = 1 << 20
+_MIN_CAP = 1 << 18
+
+
+def max_groups_limit(explicit: int | None = None) -> int:
+    """The serial-path cardinality ceiling: explicit value, else
+    ``DLS_AGG_MAX_GROUPS``, else 1M (PR 5's default)."""
+    if explicit is not None:
+        return int(explicit)
+    try:
+        return int(os.environ.get(MAX_GROUPS_ENV, "") or _DEFAULT_MAX_GROUPS)
+    except ValueError:
+        return _DEFAULT_MAX_GROUPS
+
+
+def resolve_shuffle_workers(num_workers: int | None) -> int:
+    """Worker count for the exchange: explicit value wins, ``None`` reads
+    ``DLS_DATA_WORKERS`` (the pool the shuffle rides on). 0 — or a platform
+    without ``fork`` — means the serial driver-side path."""
+    nw = resolve_num_workers(num_workers)
+    if nw > 0 and not fork_available():  # pragma: no cover - platform
+        warnings.warn("shuffle workers requested but the 'fork' start "
+                      "method is unavailable; using the serial path")
+        return 0
+    return nw
+
+
+def mem_budget_bytes(explicit_mb: float | None = None) -> int:
+    if explicit_mb is None:
+        try:
+            explicit_mb = float(
+                os.environ.get(MEM_MB_ENV, "") or _DEFAULT_MEM_MB)
+        except ValueError:
+            explicit_mb = _DEFAULT_MEM_MB
+    return max(4 << 20, int(explicit_mb * (1 << 20)))
+
+
+def key_bytes(key: Any) -> bytes:
+    """Canonical sortable identity of a shuffle key: an 8-byte blake2b
+    digest of the pickled key, followed by the pickle itself (the digest
+    buckets and sorts; the tail breaks the astronomically-rare collision
+    deterministically). Stable across processes and runs — unlike
+    ``hash()``, which moves with ``PYTHONHASHSEED``. Keys that compare
+    equal but pickle differently (``1`` vs ``np.int64(1)``) are DIFFERENT
+    shuffle keys; keep key types canonical (the DataFrame plane already
+    does)."""
+    kb = pickle.dumps(key, protocol=_PICKLE_PROTO)
+    return hashlib.blake2b(kb, digest_size=8).digest() + kb
+
+
+def bucket_of(kb: bytes, n_out: int) -> int:
+    """Owning bucket of a key's :func:`key_bytes` — shared with the serial
+    fallbacks so both paths land every key in the same output partition."""
+    return int.from_bytes(kb[:8], "big") % n_out
+
+
+def _approx_nbytes(v: Any) -> int:
+    """Cheap upper-ish estimate of an object's resident bytes for the
+    flush/spill accounting. Precision is not the point — a stable,
+    monotone estimate is (over-estimating just flushes earlier). The hot
+    loops call this SAMPLED (every 64th item, :class:`_ByteMeter`): at 10M
+    pairs, two recursive walks per pair were the map phase's single
+    largest cost."""
+    if isinstance(v, np.ndarray):
+        return v.nbytes + 128
+    if isinstance(v, (bytes, str)):
+        return len(v) + 64
+    if isinstance(v, (list, tuple)):
+        return 64 + 16 * len(v) + sum(
+            _approx_nbytes(x) for x in v[:8]) * max(1, len(v) // 8 if len(v) > 8 else 1)
+    if isinstance(v, dict):
+        return 64 + sum(_approx_nbytes(x) + 32 for x in v.values())
+    return 64
+
+
+class _ByteMeter:
+    """Sampled byte accounting for the mapper/reducer stores: every 64th
+    ``add`` re-measures the item with :func:`_approx_nbytes` and the
+    in-between items are charged the rolling estimate. ``value`` tracks
+    the store's resident bytes well enough to bound memory (the budget's
+    contract), at 1/64th the walk cost."""
+
+    __slots__ = ("value", "_est", "_n")
+
+    def __init__(self):
+        self.value = 0.0
+        self._est = 192.0
+        self._n = 0
+
+    def add(self, item: Any, overhead: int = 0) -> None:
+        self._n += 1
+        if self._n & 0x3F == 1:
+            self._est = float(_approx_nbytes(item))
+        self.value += self._est + overhead
+
+    def reset(self) -> None:
+        self.value = 0.0
+
+
+# ---------------------------------------------------------------------------
+# operation specs
+# ---------------------------------------------------------------------------
+
+
+class _Spec:
+    """How one wide operation maps onto the exchange.
+
+    ``pre(elem)``: iterable of (key, value) pairs for one source element
+    (None = the element already is the pair). ``seed(v)``: first value →
+    accumulator. ``combine(acc, v)``: fold one more value in (map side).
+    ``merge(a, b)``: fold two accumulators (reduce side). ``final(key,
+    acc)``: the emitted record. ``tag_values``: wrap each value as
+    ``(part, idx, v)`` before seeding so ``final`` can restore encounter
+    order (group_by_key).
+    """
+
+    __slots__ = ("pre", "seed", "combine", "merge", "final", "tag_values")
+
+    def __init__(self, *, pre=None, seed=None, combine=None, merge=None,
+                 final=None, tag_values=False):
+        self.pre = pre
+        self.seed = seed if seed is not None else (lambda v: v)
+        self.combine = combine
+        self.merge = merge if merge is not None else combine
+        self.final = final if final is not None else (lambda k, a: (k, a))
+        self.tag_values = tag_values
+
+
+def _reduce_spec(f: Callable[[Any, Any], Any]) -> _Spec:
+    return _Spec(combine=f)
+
+
+def _group_spec() -> _Spec:
+    def final(k, acc):
+        acc.sort(key=lambda t: (t[0], t[1]))
+        return (k, [v for _, _, v in acc])
+
+    return _Spec(seed=lambda tv: [tv],
+                 combine=lambda acc, tv: (acc.append(tv) or acc),
+                 merge=lambda a, b: (a.extend(b) or a),
+                 final=final, tag_values=True)
+
+
+def _distinct_spec() -> _Spec:
+    return _Spec(pre=lambda x: ((x, None),),
+                 combine=lambda acc, v: acc,
+                 final=lambda k, a: k)
+
+
+# ---------------------------------------------------------------------------
+# mapper / reducer process bodies (fork-inherited closures, no jax)
+# ---------------------------------------------------------------------------
+
+
+def _drain_frees(ring: _Arena, free_q) -> None:
+    try:
+        while True:
+            ring.free(free_q.get_nowait())
+    except queue_lib.Empty:
+        pass
+
+
+def _mapper_loop(mid: int, parts, assignment, spec: _Spec, n_out: int,
+                 shm, out_qs, free_q, ctrl_q, stop_evt, cap_bytes: int,
+                 sort_route=None) -> None:
+    """Child body: walk assigned (partition, slot, k) slices, combine into a
+    bounded dict, flush bucketed payloads through the arena/queues."""
+    os.environ["DLS_NATIVE_THREADS"] = "1"  # same capping rationale as workers
+    ring = _Arena(shm.size)
+    buf = shm.buf
+    alloc_id = 0
+    R = len(out_qs)
+    stats = {"elems": 0, "pairs": 0, "bytes_moved": 0, "overflow": 0,
+             "flushes": 0, "busy_s": 0.0}
+    store: dict = {}
+    meter = _ByteMeter()
+
+    def put(q, rec) -> bool:
+        while not stop_evt.is_set():
+            try:
+                q.put(rec, timeout=_POLL_S)
+                return True
+            except queue_lib.Full:
+                continue
+        return False
+
+    def alloc(need: int) -> int | None:
+        deadline = time.perf_counter() + _ALLOC_WAIT_S
+        while True:
+            _drain_frees(ring, free_q)
+            off = ring.try_alloc(alloc_id, need)
+            if off is not None or need > ring.size:
+                return off
+            if stop_evt.is_set() or time.perf_counter() > deadline:
+                return None
+            try:
+                ring.free(free_q.get(timeout=_POLL_S))
+            except queue_lib.Empty:
+                pass
+
+    def ship(bucket: int, payload: bytes) -> bool:
+        nonlocal alloc_id
+        stats["bytes_moved"] += len(payload)
+        off = alloc(_align(len(payload)))
+        if off is None:
+            stats["overflow"] += 1
+            return put(out_qs[bucket % R], ("pkl", mid, bucket, payload))
+        buf[off:off + len(payload)] = payload
+        ok = put(out_qs[bucket % R],
+                 ("shm", mid, bucket, alloc_id, off, len(payload)))
+        alloc_id += 1
+        return ok
+
+    def flush() -> bool:
+        if not store:
+            return True
+        stats["flushes"] += 1
+        buckets: dict[int, list] = {}
+        for key, acc in store.items():
+            kb = key_bytes(key)
+            buckets.setdefault(bucket_of(kb, n_out), []).append(
+                (kb, key, acc))
+        store.clear()
+        meter.reset()
+        for b in sorted(buckets):
+            if not ship(b, pickle.dumps(buckets[b], protocol=_PICKLE_PROTO)):
+                return False
+        return True
+
+    try:
+        for part_idx, slot, k in assignment:
+            t0 = time.perf_counter()
+            for j, elem in enumerate(parts[part_idx]()):
+                if k > 1 and j % k != slot:
+                    continue
+                if stop_evt.is_set():
+                    return
+                stats["elems"] += 1
+                if sort_route is not None:
+                    # sort mode: no combine — route each element straight
+                    # to its range bucket, tagged with (key, part, idx)
+                    kv = sort_route[0](elem)
+                    b = sort_route[1](kv)
+                    store.setdefault(b, []).append((kv, part_idx, j, elem))
+                    meter.add(elem, 64)
+                    stats["pairs"] += 1
+                    if meter.value >= cap_bytes:
+                        stats["flushes"] += 1
+                        for bb in sorted(store):
+                            if not ship(bb, pickle.dumps(
+                                    store[bb], protocol=_PICKLE_PROTO)):
+                                return
+                        store.clear()
+                        meter.reset()
+                    continue
+                pairs = spec.pre(elem) if spec.pre is not None else (elem,)
+                for key, v in pairs:
+                    stats["pairs"] += 1
+                    if spec.tag_values:
+                        v = (part_idx, j, v)
+                    if key in store:
+                        store[key] = spec.combine(store[key], v)
+                        meter.add(v)
+                    else:
+                        store[key] = spec.seed(v)
+                        meter.add(v, 120)
+                    if meter.value >= cap_bytes:
+                        if not flush():
+                            return
+            # flush at every partition boundary: mapper state never spans
+            # partitions, so flush points depend only on the partition's
+            # own content and the cap
+            if sort_route is not None:
+                for bb in sorted(store):
+                    if not ship(bb, pickle.dumps(store[bb],
+                                                 protocol=_PICKLE_PROTO)):
+                        return
+                store.clear()
+                meter.reset()
+            elif not flush():
+                return
+            stats["busy_s"] += time.perf_counter() - t0
+        for q in out_qs:
+            if not put(q, ("done", mid, None)):
+                return
+        put(ctrl_q, ("mapper-done", mid, stats))
+    except BaseException:  # noqa: BLE001 — forward ANY failure, typed
+        put(ctrl_q, ("err", ("mapper", mid), traceback.format_exc()))
+
+
+def _spill_path(spill_dir: str, rid: int, bucket: int, n: int) -> str:
+    return os.path.join(spill_dir, f"r{rid}-b{bucket}-run{n}.pkl")
+
+
+def out_path(spill_dir: str, bucket: int) -> str:
+    return os.path.join(spill_dir, f"out-b{bucket}.pkl")
+
+
+def _write_run(path: str, items: list) -> int:
+    """One sorted spill run: a raw pickle stream, re-read with repeated
+    loads. Returns bytes written."""
+    with open(path, "wb") as f:
+        p = pickle.Pickler(f, protocol=_PICKLE_PROTO)
+        for it in items:
+            p.dump(it)
+        return f.tell()
+
+
+def _iter_run(path: str) -> Iterator:
+    with open(path, "rb") as f:
+        up = pickle.Unpickler(f)
+        while True:
+            try:
+                yield up.load()
+            except EOFError:
+                return
+
+
+def _reducer_loop(rid: int, M: int, R: int, n_out: int, spec: _Spec | None,
+                  in_q, free_qs, shm_names, ctrl_q, stop_evt,
+                  cap_bytes: int, spill_dir: str, sort_spec=None) -> None:
+    """Child body: merge arriving bucket payloads under a byte budget,
+    spill sorted runs past it, k-way-merge runs into one output file per
+    owned bucket."""
+    os.environ["DLS_NATIVE_THREADS"] = "1"
+    shms: dict[int, shared_memory.SharedMemory] = {}
+    # keyed mode: bucket -> {key: [kb, acc]}; sort mode: bucket -> [entry]
+    stores: dict[int, Any] = {}
+    runs: dict[int, list[str]] = {}
+    meter = _ByteMeter()
+    done = set()
+    stats = {"spills": 0, "spill_bytes": 0, "bucket_rows": {}, "merge_s": 0.0}
+
+    def notify(msg) -> None:
+        try:
+            ctrl_q.put(msg, timeout=_POLL_S)
+        except queue_lib.Full:
+            pass
+
+    def payload_of(rec) -> bytes:
+        kind, mid = rec[0], rec[1]
+        if kind == "pkl":
+            return rec[3]
+        _, _, _bucket, alloc_id, off, size = rec
+        if mid not in shms:
+            shms[mid] = shared_memory.SharedMemory(name=shm_names[mid])
+        data = bytes(shms[mid].buf[off:off + size])
+        try:  # copy taken — release the mapper's arena slot immediately
+            free_qs[mid].put_nowait(alloc_id)
+        except Exception:  # noqa: BLE001 — mapper may be gone at teardown
+            pass
+        return data
+
+    def spill_largest() -> None:
+        if not stores:
+            return
+        bucket = max(stores, key=lambda b: len(stores[b]))
+        if sort_spec is not None:
+            items = sorted(stores.pop(bucket), key=sort_spec[0],
+                           reverse=sort_spec[1])
+        else:
+            items = sorted(
+                ((e[0], key, e[1]) for key, e in stores.pop(bucket).items()),
+                key=lambda t: t[0])
+        path = _spill_path(spill_dir, rid, bucket,
+                           len(runs.setdefault(bucket, [])))
+        nbytes = _write_run(path, items)
+        runs[bucket].append(path)
+        stats["spills"] += 1
+        stats["spill_bytes"] += nbytes
+        # rebase surviving buckets at the meter's OWN rolling per-item
+        # estimate — a flat constant here would under-charge fat values
+        # (group lists) and let residency creep past the budget share
+        meter.value = (sum(len(s) for s in stores.values())
+                       * (meter._est + 100))
+        notify(("spill", rid, bucket, len(items), nbytes))
+
+    def merge_bucket(bucket: int) -> None:
+        """Stream the bucket's runs + memory into its final output file."""
+        t0 = time.perf_counter()
+        rows = 0
+        streams = [_iter_run(p) for p in runs.get(bucket, [])]
+        if sort_spec is not None:
+            mem = sorted(stores.pop(bucket, []), key=sort_spec[0],
+                         reverse=sort_spec[1])
+            merged = heapq.merge(*streams, mem, key=sort_spec[0],
+                                 reverse=sort_spec[1])
+            with open(out_path(spill_dir, bucket), "wb") as f:
+                p = pickle.Pickler(f, protocol=_PICKLE_PROTO)
+                for _kv, _part, _j, elem in merged:
+                    p.dump(elem)
+                    rows += 1
+        else:
+            mem = sorted(
+                ((e[0], key, e[1])
+                 for key, e in stores.pop(bucket, {}).items()),
+                key=lambda t: t[0])
+            merged = heapq.merge(*streams, mem, key=lambda t: t[0])
+            with open(out_path(spill_dir, bucket), "wb") as f:
+                p = pickle.Pickler(f, protocol=_PICKLE_PROTO)
+                cur_kb = cur_key = cur_acc = None
+                for kb, key, acc in merged:
+                    if cur_kb is not None and kb == cur_kb:
+                        cur_acc = spec.merge(cur_acc, acc)
+                        continue
+                    if cur_kb is not None:
+                        p.dump(spec.final(cur_key, cur_acc))
+                        rows += 1
+                    cur_kb, cur_key, cur_acc = kb, key, acc
+                if cur_kb is not None:
+                    p.dump(spec.final(cur_key, cur_acc))
+                    rows += 1
+        for p_ in runs.pop(bucket, []):
+            try:
+                os.remove(p_)
+            except OSError:
+                pass
+        stats["bucket_rows"][bucket] = rows
+        stats["merge_s"] += time.perf_counter() - t0
+
+    try:
+        while len(done) < M:
+            if stop_evt.is_set():
+                return
+            try:
+                rec = in_q.get(timeout=_POLL_S)
+            except queue_lib.Empty:
+                continue
+            if rec[0] == "done":
+                done.add(rec[1])
+                continue
+            bucket = rec[2]
+            items = pickle.loads(payload_of(rec))
+            if sort_spec is not None:
+                lst = stores.setdefault(bucket, [])
+                lst.extend(items)
+                for e in items:
+                    meter.add(e[3], 64)
+            else:
+                st = stores.setdefault(bucket, {})
+                for kb, key, acc in items:
+                    ent = st.get(key)
+                    if ent is None:
+                        st[key] = [kb, acc]
+                        meter.add(acc, len(kb) + 100)
+                    else:
+                        ent[1] = spec.merge(ent[1], acc)
+                        meter.add(acc)
+            while meter.value >= cap_bytes and stores:
+                spill_largest()
+        for bucket in range(rid, n_out, R):
+            if stop_evt.is_set():
+                return
+            merge_bucket(bucket)
+        notify(("reducer-done", rid, stats))
+    except BaseException:  # noqa: BLE001
+        notify(("err", ("reducer", rid), traceback.format_exc()))
+    finally:
+        for s in shms.values():
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+class ShuffleResult:
+    """Per-bucket output files + the stats the telemetry summary carried.
+    Holds the spill directory alive; it is removed when this object (and
+    every dataset partition referencing it) is garbage-collected."""
+
+    def __init__(self, spill_dir: str, n_out: int, stats: dict,
+                 keep_dir: bool):
+        self.spill_dir = spill_dir
+        self.n_out = n_out
+        self.stats = stats
+        self._fin = (weakref.finalize(self, _rm_dir, spill_dir)
+                     if not keep_dir else None)
+
+    def iter_bucket(self, bucket: int) -> Iterator:
+        # generator METHOD on purpose: the running frame holds ``self``, so
+        # the spill directory cannot be finalized out from under a consumer
+        # whose dataset reference was dropped mid-iteration
+        path = out_path(self.spill_dir, bucket)
+        if os.path.exists(path):
+            yield from _iter_run(path)
+
+
+def _rm_dir(path: str) -> None:
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def _assignments(P: int, M: int) -> list[list[tuple[int, int, int]]]:
+    """Mapper → [(partition, slot, k)]: whole partitions round-robin onto
+    mappers while ``M <= P``; past that, the mappers co-assigned to one
+    partition split it by element residue (slot of k) — the WorkerPool
+    discipline, so a single-partition source still scales."""
+    if M <= P:
+        whole: list[list[tuple[int, int, int]]] = [[] for _ in range(M)]
+        for p in range(P):
+            whole[p % M].append((p, 0, 1))
+        return whole
+    per_part: list[list[int]] = [[] for _ in range(P)]
+    for m in range(M):
+        per_part[m % P].append(m)
+    out: list[list[tuple[int, int, int]]] = [[] for _ in range(M)]
+    for p, ms in enumerate(per_part):
+        for slot, m in enumerate(ms):
+            out[m].append((p, slot, len(ms)))
+    return out
+
+
+def run_exchange(parts: Sequence[Callable[[], Any]], *, num_workers: int,
+                 n_out: int, spec: _Spec | None, label: str,
+                 sort_route=None, sort_spec=None,
+                 mem_mb: float | None = None) -> ShuffleResult:
+    """Execute one shuffle: spawn mappers + reducers, stream the exchange,
+    return the per-bucket output. Raises :class:`WorkerCrashed` (cleaning
+    up every child, shm segment, and spill file) when any child raises or
+    dies."""
+    P = len(parts)
+    M = max(1, int(num_workers))
+    R = max(1, min(M, n_out))
+    budget = mem_budget_bytes(mem_mb)
+    arena_bytes = max(_MIN_ARENA, budget // (4 * M))
+    map_cap = max(_MIN_CAP, budget // (4 * M))
+    red_cap = max(_MIN_CAP, budget // (2 * R))
+    base = os.environ.get(SPILL_DIR_ENV) or None
+    if base:
+        os.makedirs(base, exist_ok=True)
+    spill_dir = tempfile.mkdtemp(prefix="dlsx-", dir=base)
+    ctx = mp.get_context("fork")
+    stop = ctx.Event()
+    ctrl_q = ctx.Queue()
+    out_qs = [ctx.Queue(maxsize=_QUEUE_AHEAD) for _ in range(R)]
+    free_qs = [ctx.Queue() for _ in range(M)]
+    shms = [shared_memory.SharedMemory(
+        create=True, size=arena_bytes,
+        name=f"dlsx-{os.getpid()}-{uuid.uuid4().hex[:8]}-m{m}")
+        for m in range(M)]
+    shm_names = [s.name for s in shms]
+    assign = _assignments(P, M)
+    mappers = [ctx.Process(
+        target=_mapper_loop, daemon=True, name=f"dlsx-map-{m}",
+        args=(m, list(parts), assign[m], spec, n_out, shms[m], out_qs,
+              free_qs[m], ctrl_q, stop, map_cap, sort_route))
+        for m in range(M)]
+    reducers = [ctx.Process(
+        target=_reducer_loop, daemon=True, name=f"dlsx-red-{r}",
+        args=(r, M, R, n_out, spec, out_qs[r], free_qs, shm_names, ctrl_q,
+              stop, red_cap, spill_dir, sort_spec))
+        for r in range(R)]
+    procs = mappers + reducers
+    with warnings.catch_warnings():
+        # children run pure numpy/pickle, never JAX — same rationale as
+        # WorkerPool's fork-under-JAX warning filter
+        warnings.filterwarnings(
+            "ignore", message=r".*os\.fork\(\) was called.*",
+            category=RuntimeWarning)
+        for p in procs:
+            p.start()
+    finalizer = weakref.finalize(
+        run_exchange, _exchange_cleanup, stop, list(procs), list(shms))
+
+    t_start = time.perf_counter()
+    map_done: dict[int, dict] = {}
+    red_done: dict[int, dict] = {}
+    spills = 0
+    spill_bytes = 0
+    map_end: float | None = None
+    telemetry.emit("phase", name="shuffle-map", edge="begin", op=label)
+    try:
+        # wait for BOTH roles: a reducer can observe the out_q "done"
+        # sentinels and finish before the mapper's ctrl "mapper-done"
+        # lands (two queues, two feeder threads — no cross-queue order);
+        # exiting on reducers alone would drop that mapper's stats and
+        # leave the shuffle-map phase open
+        while len(red_done) < R or len(map_done) < M:
+            try:
+                msg = ctrl_q.get(timeout=_POLL_S)
+            except queue_lib.Empty:
+                for i, p in enumerate(procs):
+                    is_map = i < M
+                    wid = i if is_map else i - M
+                    finished = (wid in map_done) if is_map else (wid in red_done)
+                    if not finished and not p.is_alive():
+                        # drain race: its last message may be in flight
+                        try:
+                            msg = ctrl_q.get(timeout=_POLL_S)
+                            break
+                        except queue_lib.Empty:
+                            pass
+                        role = "mapper" if is_map else "reducer"
+                        raise WorkerCrashed(
+                            f"shuffle {role} {wid} died (exit code "
+                            f"{p.exitcode}) mid-exchange — killed (OOM/"
+                            f"SIGKILL) or crashed in native code",
+                            worker=wid, exitcode=p.exitcode)
+                else:
+                    continue
+            kind = msg[0]
+            if kind == "err":
+                role, wid = msg[1]
+                raise WorkerCrashed(
+                    f"shuffle {role} {wid} raised:\n{msg[2]}", worker=wid)
+            if kind == "mapper-done":
+                map_done[msg[1]] = msg[2]
+                if len(map_done) == M and map_end is None:
+                    map_end = time.perf_counter()
+                    telemetry.emit("phase", name="shuffle-map", edge="end",
+                                   dur_s=map_end - t_start, op=label)
+                    telemetry.emit("phase", name="shuffle-merge",
+                                   edge="begin", op=label)
+            elif kind == "reducer-done":
+                red_done[msg[1]] = msg[2]
+            elif kind == "spill":
+                spills += 1
+                spill_bytes += msg[4]
+                telemetry.emit("shuffle", edge="spill", op=label,
+                               reducer=msg[1], bucket=msg[2], rows=msg[3],
+                               bytes=msg[4])
+        merge_s = time.perf_counter() - (map_end or t_start)
+        telemetry.emit("phase", name="shuffle-merge", edge="end",
+                       dur_s=merge_s, op=label)
+    except BaseException:
+        # failed exchange: nothing must leak — children, shm, spill files.
+        # End whichever phase is OPEN (map, or merge once map ended) so a
+        # crashed shuffle never pins a stale open phase onto every later
+        # heartbeat's hang localization
+        stop.set()
+        telemetry.emit(
+            "phase", edge="end", op=label, aborted=True,
+            name="shuffle-map" if map_end is None else "shuffle-merge")
+        _exchange_cleanup(stop, procs, shms)
+        finalizer.detach()
+        _rm_dir(spill_dir)
+        raise
+    finalizer.detach()
+    _exchange_cleanup(stop, procs, shms)
+
+    bucket_rows: dict[int, int] = {}
+    for st in red_done.values():
+        bucket_rows.update(st["bucket_rows"])
+    rows_list = [bucket_rows.get(b, 0) for b in range(n_out)]
+    stats = {
+        "op": label,
+        "workers": M,
+        "reducers": R,
+        "buckets": n_out,
+        "elems_in": sum(st["elems"] for st in map_done.values()),
+        "pairs_in": sum(st["pairs"] for st in map_done.values()),
+        "rows_out": sum(rows_list),
+        "bytes_moved": sum(st["bytes_moved"] for st in map_done.values()),
+        "overflow": sum(st["overflow"] for st in map_done.values()),
+        "spills": spills,
+        "spill_bytes": spill_bytes,
+        "map_s": round((map_end or t_start) - t_start, 3),
+        "merge_s": round(time.perf_counter() - (map_end or t_start), 3),
+        "bucket_rows": rows_list,
+        "mem_budget_mb": round(budget / (1 << 20), 1),
+    }
+    telemetry.emit("shuffle", edge="done", **stats)
+    return ShuffleResult(spill_dir, n_out, stats, keep_dir=False)
+
+
+def _exchange_cleanup(stop, procs, shms) -> None:
+    """Idempotent teardown (finalize/atexit-safe): stop, reap, unlink."""
+    stop.set()
+    for p in procs:
+        p.join(timeout=1.0)
+    for p in procs:
+        if p.is_alive():
+            p.terminate()
+            p.join(timeout=1.0)
+    for s in shms:
+        try:
+            s.unlink()
+        except FileNotFoundError:
+            pass
+        try:
+            s.close()
+        except BufferError:  # pragma: no cover - defensive
+            s._buf = None
+            s._mmap = None
+
+
+# ---------------------------------------------------------------------------
+# dataset-level entry points (used by rdd.py / data/dataframe.py)
+# ---------------------------------------------------------------------------
+
+
+def _lazy_exchange_dataset(parts, *, num_workers: int, n_out: int,
+                           spec: _Spec | None, label: str,
+                           prepare=None, sort_spec=None):
+    """A PartitionedDataset whose partitions stream the exchange's bucket
+    files; the exchange itself runs once, on first iteration (the lazy +
+    memoized contract every wide op in rdd.py keeps). ``prepare`` (also
+    deferred to first iteration) returns the ``sort_route`` pair for sort
+    mode — it may walk the source (boundary sampling)."""
+    from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+    memo: dict = {}
+
+    def result() -> ShuffleResult:
+        if "r" not in memo:
+            memo["r"] = run_exchange(
+                parts, num_workers=num_workers, n_out=n_out, spec=spec,
+                label=label,
+                sort_route=prepare() if prepare is not None else None,
+                sort_spec=sort_spec)
+        return memo["r"]
+
+    def make(bucket: int):
+        return lambda: result().iter_bucket(bucket)
+
+    return PartitionedDataset([make(b) for b in range(n_out)])
+
+
+def reduce_by_key(dataset, f, n_out: int, num_workers: int):
+    return _lazy_exchange_dataset(
+        dataset._parts, num_workers=num_workers, n_out=n_out,
+        spec=_reduce_spec(f), label="reduce_by_key")
+
+
+def group_by_key(dataset, n_out: int, num_workers: int):
+    return _lazy_exchange_dataset(
+        dataset._parts, num_workers=num_workers, n_out=n_out,
+        spec=_group_spec(), label="group_by_key")
+
+
+def distinct(dataset, num_workers: int):
+    return _lazy_exchange_dataset(
+        dataset._parts, num_workers=num_workers,
+        n_out=dataset.num_partitions, spec=_distinct_spec(),
+        label="distinct")
+
+
+def _sample_boundaries(parts, key_fn, n_out: int) -> list:
+    """Range-partition boundaries for sort_by: a deterministic stride-
+    thinned sample of the key stream (every s-th key, s doubling once the
+    sample would exceed 8192 entries), quantiled into ``n_out - 1`` cut
+    points. One serial pre-pass over the source — cheap next to the sort
+    itself, and data-derived, so boundaries are identical at any worker
+    count."""
+    sample: list = []
+    stride, phase = 1, 0
+    for p in parts:
+        for x in p():
+            if phase % stride == 0:
+                sample.append(key_fn(x))
+                if len(sample) >= 8192:
+                    sample = sample[::2]
+                    stride *= 2
+            phase += 1
+    if not sample:
+        return []
+    sample.sort()
+    return [sample[(i + 1) * len(sample) // n_out]
+            for i in range(n_out - 1)]
+
+
+def sort_by(dataset, key_fn, *, ascending: bool, n_out: int,
+            num_workers: int):
+    """Range-partitioned external sort: sample boundaries, route elements
+    to range buckets, external-sort each bucket by ``(key, position)`` so
+    equal keys keep encounter order — the same total order the serial
+    stable sort emits (partition boundaries fall on sample quantiles
+    rather than exact equal splits)."""
+    import bisect
+
+    parts = dataset._parts
+
+    def prepare():
+        boundaries = ([] if n_out == 1
+                      else _sample_boundaries(parts, key_fn, n_out))
+
+        def route(kv) -> int:
+            b = bisect.bisect_right(boundaries, kv)
+            return (n_out - 1 - b) if not ascending else b
+
+        return (key_fn, route)
+
+    if ascending:
+        sort_key = lambda e: (e[0], (e[1], e[2]))  # noqa: E731
+    else:
+        # reverse=True flips both: key DESC, (-part, -idx) DESC = pos ASC,
+        # matching the serial stable sort's equal-key encounter order
+        sort_key = lambda e: (e[0], (-e[1], -e[2]))  # noqa: E731
+    return _lazy_exchange_dataset(
+        dataset._parts, num_workers=num_workers, n_out=n_out, spec=None,
+        label="sort_by", prepare=prepare,
+        sort_spec=(sort_key, not ascending))
+
+
+def serial_refusal(op: str, limit: int, what: str = "distinct keys") -> str:
+    """The serial-path loud failure, with remediations in priority order:
+    the exchange first (the fix that scales), then key bounding, then the
+    ceiling knob."""
+    return (
+        f"{op} exceeded max_groups={limit} {what} on the serial driver-side "
+        f"path. Set DLS_DATA_WORKERS=N (or pass num_workers=) to route "
+        f"through the distributed shuffle exchange (data/exchange.py), "
+        f"which spills to disk under DLS_SHUFFLE_MEM_MB instead of growing "
+        f"a driver dict; or hash_bucket/pre-bucket the key to bound the "
+        f"result; or raise {MAX_GROUPS_ENV} if the result genuinely fits "
+        f"the driver.")

@@ -52,7 +52,19 @@ def process_shard_range(num_shards: int) -> tuple[int, int] | None:
 
 def stack_examples(examples: list[dict[str, Any]]) -> dict[str, np.ndarray]:
     keys = examples[0].keys()
-    return {k: np.stack([np.asarray(e[k]) for e in examples]) for k in keys}
+    try:
+        return {k: np.stack([np.asarray(e[k]) for e in examples])
+                for k in keys}
+    except KeyError as e:
+        # an ETL stream that mis-joins features (e.g. a DLRM pipeline
+        # unioning positive/negative example sources with different
+        # fields) fails here with a bare KeyError that names neither the
+        # batch nor the fix — diagnose the schema drift instead
+        schemas = {tuple(sorted(ex.keys())) for ex in examples}
+        raise ValueError(
+            f"batch examples disagree on their keys (missing {e}); "
+            f"schemas in this batch: {sorted(schemas)} — every example "
+            f"dict in a stream must carry the same fields") from e
 
 
 def _round_robin(iters: list[Iterator]) -> Iterator:

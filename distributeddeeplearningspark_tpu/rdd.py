@@ -12,9 +12,20 @@ Python thunks producing iterables on the *host*; the device never sees an
 batches from partitions and lays them onto the mesh with batch sharding
 (one partition ≙ one data shard, matching Spark's partition↔task pairing).
 
-No lineage/shuffle engine is rebuilt (SURVEY.md §7 "What NOT to build"):
-transformations compose thunks; wide operations the contract needs
-(``treeAggregate``) run on the driver.
+Wide operations have TWO execution paths since PR 8:
+
+- **Serial (default)**: per-partition combine, then a driver-side dict —
+  the honest narrow-engine stance (SURVEY.md §7 "What NOT to build"),
+  bounded by the ``max_groups`` cardinality ceiling (``DLS_AGG_MAX_GROUPS``,
+  default 1M) which refuses user-id-like keys loudly instead of growing an
+  unbounded dict.
+- **Distributed exchange**: when workers are available (``num_workers=`` or
+  ``DLS_DATA_WORKERS``), ``reduce_by_key``/``group_by_key``/``distinct``/
+  ``sort_by`` route through :mod:`~.data.exchange` — a cross-worker
+  hash-partitioned shuffle with spill-to-disk reduce, no ceiling at all.
+  Output is canonical (bucket by :func:`~.data.exchange.key_bytes`, that
+  order within buckets) on BOTH paths, so results are byte-identical at
+  any worker count for exact commutative combines.
 
 Both pyspark camelCase and pythonic snake_case spellings are provided.
 """
@@ -230,21 +241,38 @@ class PartitionedDataset:
 
         return self.map_partitions_with_index(samp)
 
-    def distinct(self) -> "PartitionedDataset":
-        """Spark ``distinct`` (hashable elements). Honest narrow-engine
-        semantics: per-partition dedup plus a driver-side cross-partition
-        pass on first iteration — there is deliberately no shuffle service
-        (SURVEY §7 'what NOT to build'), so the cross-partition set lives on
-        the driver; output keeps first-occurrence order and collapses to
-        partition 0, like a Spark ``distinct().coalesce(1)``."""
+    def distinct(self, *, num_workers: int | None = None
+                 ) -> "PartitionedDataset":
+        """Spark ``distinct`` (hashable elements).
+
+        With workers (``num_workers=`` / ``DLS_DATA_WORKERS``): the
+        distributed exchange dedups per bucket with spill-to-disk — no
+        cardinality ceiling; output is hash-partitioned over the input's
+        partition count in canonical ``key_bytes`` order.
+
+        Serial: per-partition dedup plus a driver-side cross-partition set
+        on first iteration; output keeps first-occurrence order and
+        collapses to partition 0, like ``distinct().coalesce(1)``. The set
+        is bounded by the ``max_groups`` ceiling — past it the scan refuses
+        loudly (a user-id-like stream would otherwise grow an unbounded
+        driver set, the same bug class ``max_groups`` guards in agg)."""
         self._require_finite("distinct")
+        from distributeddeeplearningspark_tpu.data import exchange
+
+        nw = exchange.resolve_shuffle_workers(num_workers)
+        if nw:
+            return exchange.distinct(self, nw)
         parts = self._parts
+        limit = exchange.max_groups_limit()
 
         def gen() -> Iterator[Any]:
             seen: set = set()
             for p in parts:
                 for x in p():
                     if x not in seen:
+                        if len(seen) >= limit:
+                            raise ValueError(exchange.serial_refusal(
+                                "distinct()", limit, "distinct elements"))
                         seen.add(x)
                         yield x
 
@@ -288,12 +316,15 @@ class PartitionedDataset:
         self, op: str, num_partitions: int | None,
         build: Callable[[], dict],
     ) -> "PartitionedDataset":
-        """Shared scaffolding for the byKey ops: validate, ``build()`` the
-        full key→value dict ONCE (memoized, cache() semantics — else each
-        output partition would re-walk the input), bucket it ONCE by
-        ``hash(key) % n_out`` (a per-partition filter would rescan the
-        whole dict n_out times), and serve bucket ``i`` as partition
-        ``i``. Keys keep first-occurrence order within their bucket."""
+        """Serial-path scaffolding for the byKey ops: validate, ``build()``
+        the full key→value dict ONCE (memoized, cache() semantics — else
+        each output partition would re-walk the input), bucket it ONCE by
+        the exchange's canonical :func:`~.data.exchange.key_bytes` hash
+        (deterministic across processes AND runs — ``hash()`` moves with
+        ``PYTHONHASHSEED``) sorted by that key within each bucket, and
+        serve bucket ``i`` as partition ``i``. This is byte-for-byte the
+        layout the distributed exchange emits, so a run is reproducible at
+        any worker count."""
         self._require_finite(op)
         if num_partitions is not None and num_partitions < 1:
             raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
@@ -302,10 +333,14 @@ class PartitionedDataset:
 
         def buckets() -> list:
             if "b" not in memo:
+                from distributeddeeplearningspark_tpu.data import exchange
+
                 b: list = [[] for _ in range(n_out)]
                 for k, v in build().items():
-                    b[hash(k) % n_out].append((k, v))
-                memo["b"] = b
+                    kb = exchange.key_bytes(k)
+                    b[exchange.bucket_of(kb, n_out)].append((kb, k, v))
+                memo["b"] = [[(k, v) for _kb, k, v in sorted(
+                    bi, key=lambda t: t[0])] for bi in b]
             return memo["b"]
 
         def make(idx: int) -> PartitionFn:
@@ -314,17 +349,36 @@ class PartitionedDataset:
         return PartitionedDataset([make(i) for i in range(n_out)])
 
     def reduce_by_key(self, f: Callable[[Any, Any], Any],
-                      num_partitions: int | None = None) -> "PartitionedDataset":
-        """Spark ``reduceByKey`` over (key, value) pairs. Same honest
-        narrow-engine semantics as :meth:`distinct`: values combine
-        per-partition first (Spark's map-side combine — the part that
-        matters for data volume), then the per-partition partials merge in
-        a driver-side dict instead of a shuffle service (SURVEY §7 'what
-        NOT to build'). Output is hash-partitioned over ``num_partitions``
-        (default: the input's count) so downstream stages keep their
-        parallelism.
+                      num_partitions: int | None = None, *,
+                      num_workers: int | None = None) -> "PartitionedDataset":
+        """Spark ``reduceByKey`` over (key, value) pairs. ``f`` must be
+        commutative + associative (Spark's own contract).
+
+        With workers (``num_workers=`` / ``DLS_DATA_WORKERS``): routed
+        through the distributed exchange (:mod:`~.data.exchange`) — mappers
+        combine per partition slice, bucketed partials stream to per-bucket
+        reducers that spill to disk under ``DLS_SHUFFLE_MEM_MB``. No
+        cardinality ceiling.
+
+        Serial: values combine per-partition first (Spark's map-side
+        combine), then the per-partition partials merge in a driver-side
+        dict, refusing past the ``max_groups`` ceiling
+        (``DLS_AGG_MAX_GROUPS``) with the exchange as the first
+        remediation. Output is hash-partitioned over ``num_partitions``
+        (default: the input's count) in canonical key order — identical on
+        both paths.
         """
+        self._require_finite("reduce_by_key")
+        from distributeddeeplearningspark_tpu.data import exchange
+
+        if num_partitions is not None and num_partitions < 1:
+            raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
+        nw = exchange.resolve_shuffle_workers(num_workers)
+        if nw:
+            return exchange.reduce_by_key(
+                self, f, num_partitions or len(self._parts), nw)
         parts = self._parts
+        limit = exchange.max_groups_limit()
 
         def merged() -> dict:
             acc: dict = {}
@@ -334,27 +388,46 @@ class PartitionedDataset:
                 for k, v in p():
                     local[k] = f(local[k], v) if k in local else v
                 for k, v in local.items():
+                    if k not in acc and len(acc) >= limit:
+                        raise ValueError(exchange.serial_refusal(
+                            "reduce_by_key()", limit))
                     acc[k] = f(acc[k], v) if k in acc else v
             return acc
 
         return self._hash_partitioned_by_key(
             "reduce_by_key", num_partitions, merged)
 
-    def group_by_key(self, num_partitions: int | None = None) -> "PartitionedDataset":
+    def group_by_key(self, num_partitions: int | None = None, *,
+                     num_workers: int | None = None) -> "PartitionedDataset":
         """Spark ``groupByKey``: (key, [values...]) with values in
-        partition-major encounter order. Same driver-side merge caveat as
-        :meth:`reduce_by_key` — and the same Spark guidance applies:
-        prefer ``reduce_by_key`` when the downstream op is a fold, since
-        grouping materializes every value list. Direct dict-of-lists
-        build (appends), NOT reduce_by_key(list concat) — that fold
-        copies the accumulated prefix per element, O(m²) on a hot key.
+        partition-major encounter order (on BOTH paths: the exchange tags
+        each value with its source position and sorts lists back at emit).
+        The Spark guidance applies: prefer ``reduce_by_key`` when the
+        downstream op is a fold, since grouping materializes every value
+        list. Serial build is a direct dict-of-lists (appends), NOT
+        reduce_by_key(list concat) — that fold copies the accumulated
+        prefix per element, O(m²) on a hot key — and refuses past the
+        ``max_groups`` distinct-key ceiling.
         """
+        self._require_finite("group_by_key")
+        from distributeddeeplearningspark_tpu.data import exchange
+
+        if num_partitions is not None and num_partitions < 1:
+            raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
+        nw = exchange.resolve_shuffle_workers(num_workers)
+        if nw:
+            return exchange.group_by_key(
+                self, num_partitions or len(self._parts), nw)
         parts = self._parts
+        limit = exchange.max_groups_limit()
 
         def grouped() -> dict:
             acc: dict = {}
             for p in parts:
                 for k, v in p():
+                    if k not in acc and len(acc) >= limit:
+                        raise ValueError(exchange.serial_refusal(
+                            "group_by_key()", limit))
                     acc.setdefault(k, []).append(v)
             return acc
 
@@ -362,23 +435,48 @@ class PartitionedDataset:
             "group_by_key", num_partitions, grouped)
 
     def sort_by(self, key: Callable[[Any], Any], *, ascending: bool = True,
-                num_partitions: int | None = None) -> "PartitionedDataset":
+                num_partitions: int | None = None,
+                num_workers: int | None = None) -> "PartitionedDataset":
         """Spark ``sortBy``: totally ordered output, range-partitioned so
         partition i's elements all precede partition i+1's (the property
-        Spark's sort guarantees). Driver-side sort — no shuffle engine —
-        sized for driver-scale data like metric tables and vocab builds.
+        Spark's sort guarantees; descending reverses it).
+
+        With workers: a range-partitioned external sort through the
+        exchange — boundaries from a deterministic sample pass, per-bucket
+        spill-to-disk sorted runs + k-way merge, so the sort never
+        materializes driver-side. The concatenated stream is identical to
+        the serial sort (equal keys keep encounter order); partition
+        BOUNDARIES fall on sample quantiles rather than exact equal splits.
+
+        Serial: driver-side sort, sized for driver-scale data like metric
+        tables and vocab builds — refuses past the ``max_groups`` ceiling
+        (here a total-element bound: a sort materializes everything).
         """
         self._require_finite("sort_by")
+        from distributeddeeplearningspark_tpu.data import exchange
+
         if num_partitions is not None and num_partitions < 1:
             raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
-        parts = self._parts
         n_out = num_partitions or len(self._parts)
+        nw = exchange.resolve_shuffle_workers(num_workers)
+        if nw:
+            return exchange.sort_by(self, key, ascending=ascending,
+                                    n_out=n_out, num_workers=nw)
+        parts = self._parts
+        limit = exchange.max_groups_limit()
         memo: dict = {}  # sort once (cache() semantics), see reduce_by_key
 
         def sorted_all() -> list:
             if "data" not in memo:
-                memo["data"] = sorted((x for p in parts for x in p()),
-                                      key=key, reverse=not ascending)
+                data: list = []
+                for p in parts:
+                    for x in p():
+                        if len(data) >= limit:
+                            raise ValueError(exchange.serial_refusal(
+                                "sort_by()", limit, "materialized elements"))
+                        data.append(x)
+                data.sort(key=key, reverse=not ascending)
+                memo["data"] = data
             return memo["data"]
 
         def make(idx: int) -> PartitionFn:

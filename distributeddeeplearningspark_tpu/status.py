@@ -161,6 +161,53 @@ def input_workers_from(events: list[dict]) -> dict | None:
     return None
 
 
+def shuffle_from(events: list[dict]) -> dict | None:
+    """Fold ``shuffle`` events (:mod:`..data.exchange`) into the shuffle
+    block, or None when the run never shuffled. Totals sum every exchange
+    in the stream; ``last`` keeps the newest summary whole (its per-bucket
+    row counts are what skew is judged from)."""
+    done = [e for e in events
+            if e.get("kind") == "shuffle" and e.get("edge") == "done"]
+    spill_events = sum(e.get("kind") == "shuffle"
+                       and e.get("edge") == "spill" for e in events)
+    if not done:
+        return None
+    last = done[-1]
+    rows = [int(r) for r in (last.get("bucket_rows") or [])]
+    mean_rows = (sum(rows) / len(rows)) if rows else 0.0
+    max_rows = max(rows) if rows else 0
+    skew = (max_rows / mean_rows) if mean_rows > 0 else None
+    if skew is None:
+        verdict = "no rows"
+    elif skew < 2.0:
+        verdict = f"balanced (max/mean {skew:.2f}x)"
+    else:
+        verdict = (f"SKEWED — bucket {rows.index(max_rows)} holds "
+                   f"{skew:.1f}x the mean; pre-bucket or salt the hot key")
+    return {
+        "ops": len(done),
+        "pairs_in": sum(int(e.get("pairs_in", 0) or 0) for e in done),
+        "rows_out": sum(int(e.get("rows_out", 0) or 0) for e in done),
+        "bytes_moved": sum(int(e.get("bytes_moved", 0) or 0) for e in done),
+        "spills": sum(int(e.get("spills", 0) or 0) for e in done),
+        "spill_events": spill_events,
+        "overflow": sum(int(e.get("overflow", 0) or 0) for e in done),
+        "last": {
+            "op": last.get("op"),
+            "workers": last.get("workers"),
+            "buckets": last.get("buckets"),
+            "map_s": last.get("map_s"),
+            "merge_s": last.get("merge_s"),
+            "spills": last.get("spills"),
+            "mem_budget_mb": last.get("mem_budget_mb"),
+            "bucket_rows_max": max_rows,
+            "bucket_rows_mean": round(mean_rows, 1),
+            "skew": round(skew, 3) if skew is not None else None,
+            "verdict": verdict,
+        },
+    }
+
+
 def report(workdir: str, *, now: float | None = None,
            hosts: bool = False, fleet_serve: bool = False,
            traces: bool = False, slo_target: float | None = None,
@@ -207,6 +254,7 @@ def report(workdir: str, *, now: float | None = None,
             if last_hb is not None else None),
         "goodput": telemetry.goodput(events),
         "input_workers": input_workers_from(events),
+        "shuffle": shuffle_from(events),
         "serving": serving_from(events),
         "attempts": attempts_from(events),
         "recovery_events": [e for e in events if e.get("kind") == "recovery"],
@@ -421,6 +469,26 @@ def render(rep: dict) -> str:
                f"DLS_DATA_WORKER_RING_MB)" if iw.get("worker_overflow")
                else ""))
         lines.append(f"  verdict: {verdict}")
+    sh = rep.get("shuffle")
+    if sh:
+        last = sh["last"]
+        lines.append("")
+        lines.append(
+            f"shuffle: {sh['ops']} op(s)  pairs={sh['pairs_in']}  "
+            f"rows out={sh['rows_out']}  "
+            f"moved={sh['bytes_moved'] / 1e6:.1f}MB  "
+            f"spills={sh['spills']}"
+            + (f"  OVERFLOW={sh['overflow']} (raise DLS_SHUFFLE_MEM_MB)"
+               if sh.get("overflow") else ""))
+        lines.append(
+            f"  last op {last['op']}: workers={last['workers']} "
+            f"buckets={last['buckets']} map={_fmt_s(last['map_s'])} "
+            f"merge={_fmt_s(last['merge_s'])} spills={last['spills']}"
+            + (f" budget={last['mem_budget_mb']}MB"
+               if last.get("mem_budget_mb") is not None else ""))
+        lines.append(
+            f"  bucket rows max={last['bucket_rows_max']} "
+            f"mean={last['bucket_rows_mean']}  verdict: {last['verdict']}")
     sv = rep.get("serving")
     if sv:
         lines.append("")

@@ -42,6 +42,17 @@ present):
   occupancy, prefix-cache hit rate, active slots, queue depth. The
   newest one per process is a replica's "now" in ``dlstatus
   --fleet-serve`` (:func:`.fleet.serving_fleet`).
+- ``shuffle`` — one distributed-exchange gauge (:mod:`..data.exchange`):
+  ``edge="spill"`` marks one reducer spill (``reducer``/``bucket``/
+  ``rows``/``bytes``), ``edge="done"`` the whole-shuffle summary
+  (``op``, ``workers``, ``buckets``, ``pairs_in``, ``rows_out``,
+  ``bytes_moved``, ``spills``, ``overflow``, ``map_s``, ``merge_s``,
+  ``bucket_rows``). The shuffle's map/merge wall-clock additionally lands
+  as ``shuffle-map``/``shuffle-merge`` ``phase`` spans (informational —
+  not goodput overhead: a shuffle IS the productive work of an ETL step),
+  which lower into the span model like any phase. ``dlstatus`` renders
+  the newest summaries as the shuffle block (bytes moved, spill count,
+  per-bucket skew, slowest-bucket verdict).
 - ``span`` — one closed span of a request-level distributed trace
   (:mod:`.trace`): ``trace_id``/``span_id``/``parent_id``/``name``/
   ``t0``/``t1`` + free-form ``attrs``. Spans are buffered per request and

@@ -1,0 +1,236 @@
+"""DLRM feature ETL through the distributed shuffle exchange (ISSUE 8).
+
+The first end-to-end "ETL → training feed" scenario in the repo: a raw
+click log (string-token categorical slots, zipf-distributed — user-id-like
+cardinality) is turned into trainable DLRM examples WITHOUT ever
+materializing a driver-side dict of the raw token space:
+
+1. **Vocab build** — ``flat_map`` every row into ``((slot, token), 1)``
+   pairs, ``reduce_by_key`` the counts through the cross-worker exchange
+   (``--data-workers`` / ``DLS_DATA_WORKERS``; spills to disk under
+   ``DLS_SHUFFLE_MEM_MB``), then keep the top ``--vocab`` tokens per slot
+   by (count, token) — most frequent token gets id 1, id 0 is OOV. The
+   count table the driver touches is already reduced to distinct tokens;
+   only the top-V slice per slot is kept.
+2. **Negative sampling** — each positive row yields ``1 + --neg-per-pos``
+   examples: the clicked row (label 1) and K copies whose item slot is
+   re-drawn from the learned item-frequency vocab (label 0), the standard
+   implicit-feedback recipe. Deterministic per row index, so the example
+   stream is reproducible at any worker count.
+3. **Training feed** — the example RDD streams through
+   ``data/feed.host_batches`` into ``Trainer.fit`` on a DLRM model
+   (``--steps 0`` skips training and just measures the assembled-batch
+   rate).
+
+Run it (CPU works)::
+
+    python examples/dlrm_features.py --rows 100000 --data-workers 2
+    DLS_TELEMETRY_DIR=/tmp/dlrm_run python examples/dlrm_features.py \
+        --rows 200000 --data-workers 4 --steps 20
+    # then: dlstatus /tmp/dlrm_run  → shuffle block (bytes moved, spills,
+    # per-bucket skew)
+
+The summary line is JSON: vocab/ETL wall-clock, shuffle stats (when
+telemetry is on), feed examples/sec, and the train summary.
+"""
+
+import argparse
+import json
+import logging
+import os
+import time
+
+import numpy as np
+
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+
+def synth_clicklog(rows: int, *, num_slots: int, num_dense: int,
+                   num_partitions: int, seed: int) -> PartitionedDataset:
+    """Raw click log: per row, ``num_slots`` STRING tokens (zipf-ish — a
+    long tail of rare tokens, the shape that makes driver-side vocab
+    dicts blow up), ``num_dense`` floats, and a click label correlated
+    with the head tokens so the model has signal to learn."""
+
+    def make(pidx: int):
+        def gen():
+            rng = np.random.default_rng(seed * 997 + pidx)
+            n = rows // num_partitions
+            for i in range(n):
+                toks = rng.zipf(1.3, size=num_slots) - 1
+                dense = rng.exponential(2.0, num_dense).astype(np.float32)
+                # head tokens click more — learnable signal, zipf tail noise
+                score = float(np.mean(1.0 / (1.0 + toks))) * 3.0 - 1.0
+                label = np.float32(rng.random() < 1 / (1 + np.exp(-score)))
+                yield {
+                    "tokens": [f"s{j}:t{t}" for j, t in enumerate(toks)],
+                    "dense": dense,
+                    "label": label,
+                }
+
+        return gen
+
+    return PartitionedDataset([make(p) for p in range(num_partitions)])
+
+
+def build_vocabs(log: PartitionedDataset, *, num_slots: int, top_v: int,
+                 num_workers: int | None) -> tuple[list[dict], list[list]]:
+    """Per-slot token→id maps from exchange-reduced counts.
+
+    The ``reduce_by_key`` runs through the distributed exchange when
+    workers are available — raw-token cardinality never touches a driver
+    dict. The driver only walks the REDUCED count stream, keeping a
+    bounded top-``top_v`` heap per slot. Returns (vocabs, item_pools):
+    ``vocabs[j][token] -> id`` (1-based; 0 = OOV) and the per-slot token
+    list in id order (the negative-sampling pool)."""
+    import heapq
+
+    counts = log.flat_map(
+        lambda r: [((j, t), 1) for j, t in enumerate(r["tokens"])]
+    ).reduce_by_key(lambda a, b: a + b, num_workers=num_workers)
+    heaps: list[list] = [[] for _ in range(num_slots)]
+    for (slot, token), cnt in (
+            x for i in range(counts.num_partitions)
+            for x in counts.iter_partition(i)):
+        h = heaps[slot]
+        # (count, token) orders ties deterministically; heap keeps top-V
+        item = (cnt, token)
+        if len(h) < top_v:
+            heapq.heappush(h, item)
+        elif item > h[0]:
+            heapq.heapreplace(h, item)
+    vocabs, pools = [], []
+    for h in heaps:
+        ranked = [t for _, t in sorted(h, reverse=True)]
+        vocabs.append({t: i + 1 for i, t in enumerate(ranked)})
+        pools.append(ranked)
+    return vocabs, pools
+
+
+def featurize(log: PartitionedDataset, vocabs: list[dict],
+              pools: list[list], *, item_slot: int, neg_per_pos: int,
+              seed: int) -> PartitionedDataset:
+    """Raw rows → DLRM examples with negative sampling.
+
+    Each clicked row emits itself (label 1) plus ``neg_per_pos`` copies
+    whose ``item_slot`` token is re-drawn uniformly from that slot's
+    vocab pool (label 0). The draw is seeded per (partition, row), so the
+    stream is deterministic and worker-count independent."""
+    pool_ids = np.arange(1, len(pools[item_slot]) + 1, dtype=np.int32)
+
+    def expand(pidx: int, it):
+        rng = np.random.default_rng(seed * 31 + pidx)
+        for row in it:
+            sparse = np.asarray(
+                [vocabs[j].get(t, 0) for j, t in enumerate(row["tokens"])],
+                np.int32)
+            yield {"dense": row["dense"], "sparse": sparse,
+                   "label": np.float32(row["label"])}
+            for _ in range(neg_per_pos):
+                neg = sparse.copy()
+                neg[item_slot] = rng.choice(pool_ids)
+                yield {"dense": row["dense"], "sparse": neg,
+                       "label": np.float32(0.0)}
+
+    return log.map_partitions_with_index(expand)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=100_000,
+                   help="raw click-log rows before negative sampling")
+    p.add_argument("--slots", type=int, default=8,
+                   help="categorical feature slots (slot 0 = item)")
+    p.add_argument("--dense", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=1000,
+                   help="top-V tokens kept per slot (id 0 = OOV)")
+    p.add_argument("--neg-per-pos", type=int, default=1)
+    p.add_argument("--partitions", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--steps", type=int, default=10,
+                   help="DLRM train steps on the assembled feed (0 = "
+                        "measure the feed only)")
+    p.add_argument("--data-workers", type=int, default=None,
+                   help="exchange/shuffle worker processes "
+                        "(default: DLS_DATA_WORKERS)")
+    p.add_argument("--feed-batches", type=int, default=20,
+                   help="batches timed for the feed-rate measurement")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    wd = os.environ.get("DLS_TELEMETRY_DIR")
+    if wd:
+        # bind the writer BEFORE the ETL: the vocab shuffle runs long
+        # before Trainer.fit would configure telemetry, and its
+        # phase/shuffle events are the dlstatus shuffle block's source
+        from distributeddeeplearningspark_tpu import telemetry
+
+        telemetry.configure(wd)
+
+    log = synth_clicklog(
+        args.rows, num_slots=args.slots, num_dense=args.dense,
+        num_partitions=args.partitions, seed=args.seed).cache()
+
+    t0 = time.perf_counter()
+    vocabs, pools = build_vocabs(
+        log, num_slots=args.slots, top_v=args.vocab,
+        num_workers=args.data_workers)
+    vocab_s = time.perf_counter() - t0
+
+    examples = featurize(
+        log, vocabs, pools, item_slot=0, neg_per_pos=args.neg_per_pos,
+        seed=args.seed)
+
+    # feed rate: the ETL output streaming through the SAME assembly the
+    # trainer consumes (data/feed.py)
+    from distributeddeeplearningspark_tpu.data.feed import host_batches
+
+    feed = host_batches(examples.repeat(), args.batch_size)
+    first = next(feed)  # includes the warmup/lazy-open cost
+    assert set(first) == {"dense", "sparse", "label"}
+    t0 = time.perf_counter()
+    seen = 0
+    for _ in range(args.feed_batches):
+        seen += len(next(feed)["label"])
+    feed_rate = seen / (time.perf_counter() - t0)
+    feed.close()
+
+    train_summary = None
+    if args.steps > 0:
+        from distributeddeeplearningspark_tpu import Session, Trainer
+        from distributeddeeplearningspark_tpu.models.dlrm import (
+            DLRM, dlrm_rules)
+        from distributeddeeplearningspark_tpu.train import losses, optim
+
+        spark = (Session.builder.master("auto")
+                 .appName("dlrm-features").getOrCreate())
+        model = DLRM(vocab_sizes=(args.vocab + 1,) * args.slots,
+                     embed_dim=16, bottom_mlp=(64, 16), top_mlp=(64, 1))
+        trainer = Trainer(spark, model, losses.binary_xent,
+                          optim.adamw(1e-3, weight_decay=0.0),
+                          rules=dlrm_rules())
+        _, train_summary = trainer.fit(
+            examples.repeat(), batch_size=args.batch_size,
+            steps=args.steps, log_every=max(1, args.steps // 4))
+        spark.stop()
+
+    shuffle_stats = None
+    if wd:
+        from distributeddeeplearningspark_tpu import status, telemetry
+
+        telemetry.reset()  # flush + release before reading back
+        shuffle_stats = status.shuffle_from(telemetry.read_events(wd))
+    print(json.dumps({
+        "rows": args.rows,
+        "vocab_sizes": [len(v) for v in vocabs],
+        "vocab_build_s": round(vocab_s, 2),
+        "data_workers": args.data_workers,
+        "examples_per_sec": round(feed_rate, 1),
+        "neg_per_pos": args.neg_per_pos,
+        "shuffle": shuffle_stats and shuffle_stats["last"],
+        "train_summary": train_summary,
+    }, default=str))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,412 @@
+"""Distributed shuffle exchange (data/exchange.py — ISSUE 8).
+
+The contracts under test:
+
+- determinism: `reduce_by_key`/`group_by_key`/`sort_by`/`groupBy().agg`
+  output is byte-identical at num_workers 0/1/4 (canonical key_bytes
+  bucketing + ordering on both paths), and identical again when the
+  reducers are forced through the spill-to-disk path by a tiny
+  ``DLS_SHUFFLE_MEM_MB``;
+- failure: a mapper that raises forwards its traceback, a SIGKILLed one
+  surfaces a typed WorkerCrashed within a bounded wait, and either way no
+  child process, shm segment, or spill file survives;
+- serial ceilings: without workers every wide op refuses loudly past
+  ``max_groups``, naming ``DLS_DATA_WORKERS`` (the exchange) as the first
+  remediation;
+- telemetry: a shuffle leaves ``shuffle-map``/``shuffle-merge`` phase
+  spans plus ``shuffle`` spill/done gauges, and ``dlstatus`` renders them
+  as the shuffle block.
+"""
+
+import multiprocessing as mp
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu.data import exchange
+from distributeddeeplearningspark_tpu.data.dataframe import DataFrame
+from distributeddeeplearningspark_tpu.data.workers import WorkerCrashed, fork_available
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+pytestmark = pytest.mark.skipif(
+    not fork_available(), reason="exchange needs the fork start method")
+
+
+@pytest.fixture(autouse=True)
+def _spill_here(tmp_path, monkeypatch):
+    """Pin spill dirs under tmp_path so leak assertions see everything."""
+    spill_root = tmp_path / "spill"
+    spill_root.mkdir()
+    monkeypatch.setenv(exchange.SPILL_DIR_ENV, str(spill_root))
+    monkeypatch.delenv("DLS_DATA_WORKERS", raising=False)
+    monkeypatch.delenv(exchange.MEM_MB_ENV, raising=False)
+    yield spill_root
+
+
+def _assert_no_leaks(spill_root):
+    """No dlsx child, shm segment, or spill directory survives."""
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if not [p for p in mp.active_children()
+                if p.name.startswith("dlsx-")]:
+            break
+        time.sleep(0.05)
+    assert not [p for p in mp.active_children()
+                if p.name.startswith("dlsx-")]
+    if os.path.isdir("/dev/shm"):
+        mine = [f for f in os.listdir("/dev/shm")
+                if f.startswith(f"dlsx-{os.getpid()}-")]
+        assert not mine, mine
+    import gc
+
+    gc.collect()  # ShuffleResult finalizers remove kept spill dirs
+    left = [str(p) for d in spill_root.iterdir() for p in d.iterdir()]
+    assert not left, left
+
+
+def _pairs_ds(n=2000, kmod=97, nparts=4):
+    data = [((i * 2654435761) % kmod, i % 13) for i in range(n)]
+    chunks = [data[i::nparts] for i in range(nparts)]
+    return PartitionedDataset.from_generators(
+        [(lambda c=c: iter(c)) for c in chunks])
+
+
+def _collect_parts(ds):
+    return [list(ds.iter_partition(i)) for i in range(ds.num_partitions)]
+
+
+# ---------------------------------------------------------------------------
+# canonical key identity
+# ---------------------------------------------------------------------------
+
+def test_key_bytes_is_canonical_and_sortable():
+    kbs = [exchange.key_bytes(k) for k in range(100)]
+    assert len(set(kbs)) == 100
+    assert exchange.key_bytes(7) == exchange.key_bytes(7)
+    # tuple/str/int all hash; buckets stay in range
+    for k in (1, "a", (2, "b"), 3.5):
+        assert 0 <= exchange.bucket_of(exchange.key_bytes(k), 7) < 7
+
+
+def test_resolve_shuffle_workers_env(monkeypatch):
+    assert exchange.resolve_shuffle_workers(3) == 3
+    assert exchange.resolve_shuffle_workers(0) == 0
+    monkeypatch.setenv("DLS_DATA_WORKERS", "2")
+    assert exchange.resolve_shuffle_workers(None) == 2
+
+
+def test_mem_budget_env(monkeypatch):
+    monkeypatch.setenv(exchange.MEM_MB_ENV, "8")
+    assert exchange.mem_budget_bytes() == 8 << 20
+    assert exchange.mem_budget_bytes(16) == 16 << 20
+    # floor: never less than 4MB even for absurd settings
+    assert exchange.mem_budget_bytes(0.001) == 4 << 20
+
+
+# ---------------------------------------------------------------------------
+# determinism: 0/1/4 workers byte-identical
+# ---------------------------------------------------------------------------
+
+def test_reduce_by_key_identical_across_worker_counts(_spill_here):
+    ref = _collect_parts(
+        _pairs_ds().reduce_by_key(lambda a, b: a + b, num_workers=0))
+    assert sum(len(p) for p in ref) == 97
+    for nw in (1, 4):
+        got = _collect_parts(
+            _pairs_ds().reduce_by_key(lambda a, b: a + b, num_workers=nw))
+        assert got == ref, f"num_workers={nw} diverged"
+    _assert_no_leaks(_spill_here)
+
+
+def test_group_by_key_value_order_identical(_spill_here):
+    ref = _collect_parts(_pairs_ds().group_by_key(num_workers=0))
+    for nw in (1, 4):
+        got = _collect_parts(_pairs_ds().group_by_key(num_workers=nw))
+        assert got == ref
+    _assert_no_leaks(_spill_here)
+
+
+def test_sort_by_identical_both_directions(_spill_here):
+    for ascending in (True, False):
+        ref = list(_pairs_ds().sort_by(
+            lambda kv: kv[0], ascending=ascending, num_workers=0).collect())
+        for nw in (1, 4):
+            got = list(_pairs_ds().sort_by(
+                lambda kv: kv[0], ascending=ascending,
+                num_workers=nw).collect())
+            assert got == ref, (ascending, nw)
+    _assert_no_leaks(_spill_here)
+
+
+def test_sort_by_exchange_is_range_partitioned(_spill_here):
+    out = _pairs_ds(n=4000).sort_by(lambda kv: kv[0], num_workers=2)
+    parts = _collect_parts(out)
+    last = None
+    for p in parts:
+        keys = [k for k, _ in p]
+        assert keys == sorted(keys)
+        if p and last is not None:
+            assert last <= p[0][0]
+        if p:
+            last = p[-1][0]
+
+
+def test_distinct_exchange_dedups(_spill_here):
+    ds = _pairs_ds(n=3000).map(lambda kv: kv[0])
+    serial = set(ds.distinct(num_workers=0).collect())
+    for nw in (1, 4):
+        got = list(_pairs_ds(n=3000).map(lambda kv: kv[0])
+                   .distinct(num_workers=nw).collect())
+        assert len(got) == len(set(got)) == len(serial)
+        assert set(got) == serial
+    # exchange path is itself deterministic run-to-run
+    a = list(_pairs_ds(n=3000).map(lambda kv: kv[0])
+             .distinct(num_workers=2).collect())
+    b = list(_pairs_ds(n=3000).map(lambda kv: kv[0])
+             .distinct(num_workers=2).collect())
+    assert a == b
+    _assert_no_leaks(_spill_here)
+
+
+def _agg_df(n=6000, kmod=151, nparts=3):
+    # integer-valued float64 values: their sums are EXACT below 2^53, so
+    # they commute/associate bitwise and byte-identity across worker
+    # counts is the honest claim (rdd.py docstring: float sums of
+    # arbitrary reals reorder under the exchange like they do in Spark)
+    rng = np.random.default_rng(7)
+    k = (np.arange(n) * 2654435761) % kmod
+    v = rng.integers(-1000, 1000, size=n).astype(np.float64)
+    chunks = []
+    for i in range(nparts):
+        sl = slice(i * n // nparts, (i + 1) * n // nparts)
+        chunks.append({"k": k[sl].copy(), "v": v[sl].copy()})
+    ds = PartitionedDataset.from_generators(
+        [(lambda c=c: iter([c])) for c in chunks])
+    return DataFrame(ds, ["k", "v"])
+
+
+def _agg_bytes(df) -> bytes:
+    """Whole-result bytes, column-major over the CONCATENATED row stream
+    (chunk boundaries are layout, not content: the serial path emits one
+    chunk, the exchange re-chunks per bucket at DEFAULT_CHUNK_ROWS)."""
+    chunks = [ch for p in range(df._chunks.num_partitions)
+              for ch in df._chunks.iter_partition(p)]
+    assert chunks, "empty result"
+    return b"".join(
+        np.ascontiguousarray(
+            np.concatenate([np.atleast_1d(ch[c]) for ch in chunks])).tobytes()
+        for c in sorted(chunks[0]))
+
+
+def test_groupby_agg_identical_across_worker_counts(_spill_here):
+    spec = {"v": "sum", "k": "count"}
+    ref = _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=0))
+    for nw in (1, 4):
+        got = _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=nw))
+        assert got == ref, f"num_workers={nw} diverged"
+    _assert_no_leaks(_spill_here)
+
+
+def test_groupby_agg_min_max_mean_parity(_spill_here):
+    spec = {"v": "min", "k": "count"}
+    assert _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=2)) == \
+        _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=0))
+    spec = {"v": "max"}
+    assert _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=2)) == \
+        _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=0))
+    # mean = sum/count from identical partials → bit-identical too
+    spec = {"v": "mean"}
+    assert _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=2)) == \
+        _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=0))
+
+
+# ---------------------------------------------------------------------------
+# spill path
+# ---------------------------------------------------------------------------
+
+def test_spill_path_equals_in_memory(_spill_here, monkeypatch):
+    """A tiny DLS_SHUFFLE_MEM_MB forces reducer spills; the merged output
+    must equal the all-in-memory result byte for byte."""
+    big = _collect_parts(
+        _pairs_ds(n=60_000, kmod=59999).reduce_by_key(
+            lambda a, b: a + b, num_workers=2))
+    monkeypatch.setenv(exchange.MEM_MB_ENV, "4")  # floor budget → spills
+    stats = {}
+    orig = exchange.run_exchange
+
+    def spy(*a, **kw):
+        r = orig(*a, **kw)
+        stats.update(r.stats)
+        return r
+
+    monkeypatch.setattr(exchange, "run_exchange", spy)
+    small = _collect_parts(
+        _pairs_ds(n=60_000, kmod=59999).reduce_by_key(
+            lambda a, b: a + b, num_workers=2))
+    assert stats["spills"] >= 1, stats
+    assert small == big
+    _assert_no_leaks(_spill_here)
+
+
+# ---------------------------------------------------------------------------
+# failure propagation + cleanup
+# ---------------------------------------------------------------------------
+
+def test_mapper_exception_is_typed_with_traceback(_spill_here):
+    def boom(a, b):
+        if a + b > 50:
+            raise ValueError("poisoned combine")
+        return a + b
+
+    out = _pairs_ds().reduce_by_key(boom, num_workers=2)
+    t0 = time.monotonic()
+    with pytest.raises(WorkerCrashed) as ei:
+        _collect_parts(out)
+    assert time.monotonic() - t0 < 30.0
+    assert "poisoned combine" in str(ei.value)
+    _assert_no_leaks(_spill_here)
+
+
+def test_mapper_sigkill_surfaces_worker_crashed(_spill_here):
+    """A mapper killed mid-exchange (OOM stand-in) is detected by the
+    liveness poll within a bounded wait — a CRASH, not a hang — and the
+    failed exchange tears down every child, shm segment, and spill file."""
+    def die_at(kv):
+        k, v = kv
+        if k == 5:
+            os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(0.0005)
+        return ((k, v),)
+
+    ds = _pairs_ds(n=2000).map(lambda kv: kv)
+    out = exchange._lazy_exchange_dataset(
+        ds._parts, num_workers=2, n_out=4,
+        spec=exchange._Spec(pre=die_at, combine=lambda a, b: a + b),
+        label="sigkill-drill")
+    t0 = time.monotonic()
+    with pytest.raises(WorkerCrashed) as ei:
+        _collect_parts(out)
+    assert time.monotonic() - t0 < 30.0
+    assert "died" in str(ei.value)
+    assert ei.value.exitcode == -signal.SIGKILL
+    _assert_no_leaks(_spill_here)
+
+
+# ---------------------------------------------------------------------------
+# serial ceilings
+# ---------------------------------------------------------------------------
+
+def test_serial_refusals_name_the_exchange(monkeypatch):
+    monkeypatch.setenv(exchange.MAX_GROUPS_ENV, "10")
+    ds = _pairs_ds(n=500, kmod=97)
+    for make in (
+        lambda: ds.reduce_by_key(lambda a, b: a + b, num_workers=0),
+        lambda: ds.group_by_key(num_workers=0),
+        lambda: ds.map(lambda kv: kv[0]).distinct(num_workers=0),
+        lambda: ds.sort_by(lambda kv: kv[0], num_workers=0),
+    ):
+        with pytest.raises(ValueError, match="DLS_DATA_WORKERS"):
+            make().collect()
+
+
+def test_agg_serial_refusal_names_workers_first(monkeypatch):
+    monkeypatch.delenv("DLS_DATA_WORKERS", raising=False)
+    df = _agg_df(n=2000, kmod=500)
+    with pytest.raises(ValueError) as ei:
+        _agg_bytes(df.groupBy("k").agg({"v": "sum"}, max_groups=10))
+    msg = str(ei.value)
+    assert "DLS_DATA_WORKERS" in msg and "hash_bucket" in msg
+    assert msg.index("DLS_DATA_WORKERS") < msg.index("hash_bucket")
+
+
+def test_exchange_has_no_ceiling(monkeypatch, _spill_here):
+    """The exact workload the serial path refuses completes through the
+    exchange under the same (tiny) ceiling — the ceiling is serial-only."""
+    monkeypatch.setenv(exchange.MAX_GROUPS_ENV, "10")
+    out = _collect_parts(
+        _pairs_ds(n=500, kmod=97).reduce_by_key(
+            lambda a, b: a + b, num_workers=2))
+    assert sum(len(p) for p in out) == 97
+
+
+# ---------------------------------------------------------------------------
+# telemetry + dlstatus
+# ---------------------------------------------------------------------------
+
+def test_shuffle_telemetry_and_dlstatus_block(tmp_path, monkeypatch,
+                                              _spill_here):
+    from distributeddeeplearningspark_tpu import status, telemetry
+
+    wd = tmp_path / "tele"
+    monkeypatch.setenv(exchange.MEM_MB_ENV, "4")
+    telemetry.configure(wd)
+    try:
+        _collect_parts(
+            _pairs_ds(n=60_000, kmod=59999).reduce_by_key(
+                lambda a, b: a + b, num_workers=2))
+    finally:
+        telemetry.reset()
+    events = telemetry.read_events(wd)
+    phases = [(e["name"], e.get("edge")) for e in events
+              if e.get("kind") == "phase"]
+    assert ("shuffle-map", "begin") in phases
+    assert ("shuffle-map", "end") in phases
+    assert ("shuffle-merge", "end") in phases
+    done = [e for e in events
+            if e.get("kind") == "shuffle" and e.get("edge") == "done"]
+    assert len(done) == 1
+    d = done[0]
+    assert d["op"] == "reduce_by_key" and d["workers"] == 2
+    assert d["pairs_in"] == 60_000 and d["rows_out"] > 30_000
+    assert d["spills"] >= 1 and len(d["bucket_rows"]) == d["buckets"]
+    spill_evts = [e for e in events
+                  if e.get("kind") == "shuffle" and e.get("edge") == "spill"]
+    assert len(spill_evts) >= 1
+    assert all("bucket" in e and "bytes" in e for e in spill_evts)
+
+    rep = status.report(str(wd))
+    sh = rep["shuffle"]
+    assert sh and sh["ops"] == 1 and sh["spills"] >= 1
+    assert sh["last"]["op"] == "reduce_by_key"
+    assert sh["last"]["verdict"].startswith("balanced")
+    rendered = status.render(rep)
+    assert "shuffle: 1 op(s)" in rendered
+    assert "reduce_by_key" in rendered
+
+
+def test_shuffle_skew_verdict_names_hot_bucket():
+    from distributeddeeplearningspark_tpu import status
+
+    events = [{"kind": "shuffle", "edge": "done", "op": "reduce_by_key",
+               "workers": 2, "buckets": 4, "pairs_in": 100, "rows_out": 40,
+               "bytes_moved": 1000, "spills": 0, "overflow": 0,
+               "map_s": 0.1, "merge_s": 0.1, "mem_budget_mb": 64,
+               "bucket_rows": [37, 1, 1, 1]}]
+    sh = status.shuffle_from(events)
+    assert sh["last"]["skew"] > 2
+    assert sh["last"]["verdict"].startswith("SKEWED")
+    assert "bucket 0" in sh["last"]["verdict"]
+
+
+def test_lazy_exchange_runs_once(_spill_here):
+    """The exchange is lazy (nothing runs at call time) and memoized
+    (N output partitions trigger ONE shuffle)."""
+    calls = []
+    orig = exchange.run_exchange
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    exchange.run_exchange = spy
+    try:
+        out = _pairs_ds().reduce_by_key(lambda a, b: a + b, num_workers=2)
+        assert calls == []  # lazy
+        _collect_parts(out)
+        _collect_parts(out)
+        assert len(calls) == 1  # memoized across partitions AND re-reads
+    finally:
+        exchange.run_exchange = orig

@@ -114,10 +114,17 @@ def test_reduce_by_key_combines_across_partitions():
     out = ds.reduce_by_key(lambda x, y: x + y)
     assert out.num_partitions == 3
     assert dict(out.collect()) == {"a": 4, "b": 7, "c": 4}
-    # every pair lands in the partition its key hashes to
+    # every pair lands in the partition its CANONICAL key hash owns (PR 8:
+    # exchange.key_bytes, stable across runs — hash() moves with
+    # PYTHONHASHSEED), in key_bytes order within the partition
+    from distributeddeeplearningspark_tpu.data import exchange
+
     for i in range(out.num_partitions):
-        for k, _ in out.iter_partition(i):
-            assert hash(k) % 3 == i
+        part = list(out.iter_partition(i))
+        for k, _ in part:
+            assert exchange.bucket_of(exchange.key_bytes(k), 3) == i
+        kbs = [exchange.key_bytes(k) for k, _ in part]
+        assert kbs == sorted(kbs)
     # num_partitions override + the infinite guard
     assert dict(ds.reduce_by_key(lambda x, y: x + y,
                                  num_partitions=1).collect()) == {

@@ -350,12 +350,112 @@ PYEOF
   return $rc
 }
 
+# shuffle smoke (ISSUE 8): a 10M-key groupBy().agg — the workload the
+# serial max_groups ceiling REFUSES (asserted first) — completes through
+# the 2-worker exchange under a DLS_SHUFFLE_MEM_MB budget, with the exact
+# expected result (vectorized content check + key-set check + canonical-
+# order spot checks, blake2b checksum logged for cross-round comparison),
+# >=1 reducer spill in telemetry, and the dlstatus shuffle block schema.
+run_shuffle_smoke() {
+  local t0 rc wd out
+  t0=$(date +%s)
+  rc=0
+  wd=$(mktemp -d /tmp/dls_shuffle_smoke.XXXXXX)
+  out=$( (WD="$wd" DLS_SHUFFLE_MEM_MB=64 python - <<'PYEOF'
+import hashlib, os, sys
+import numpy as np
+
+from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.data import exchange
+from distributeddeeplearningspark_tpu.data.dataframe import DataFrame
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+N, NCHUNK, DUP = 10_000_000, 20, 100_000
+rows = N // NCHUNK
+
+def chunk(i):
+    if i == NCHUNK:  # duplicate chunk: keys 0..DUP reappear, so the
+        k = np.arange(DUP, dtype=np.int64)  # reducers really combine
+    else:           # across partitions at scale, not just concatenate
+        k = np.arange(i * rows, (i + 1) * rows, dtype=np.int64)
+    return {"k": k, "v": (k % 97).astype(np.float64)}
+
+def df():
+    ds = PartitionedDataset.from_generators(
+        [(lambda i=i: iter([chunk(i)])) for i in range(NCHUNK + 1)])
+    return DataFrame(ds, ["k", "v"])
+
+# 1) the old ceiling refuses this workload on the serial path
+try:
+    g = df().groupBy("k").agg({"v": "sum", "k": "count"}, num_workers=0)
+    next(iter(g._chunks.iter_partition(0)))
+    sys.exit("serial path did not refuse a 10M-key agg")
+except ValueError as e:
+    assert "max_groups" in str(e) and "DLS_DATA_WORKERS" in str(e), str(e)
+
+# 2) the same workload completes through the 2-worker exchange under the
+#    64MB budget; verify the full result content + canonical order
+telemetry.configure(os.environ["WD"])
+g = df().groupBy("k").agg({"v": "sum", "k": "count"}, num_workers=2)
+h = hashlib.blake2b(digest_size=16)
+keys, nrows = [], 0
+for p in range(g._chunks.num_partitions):
+    prev_kb = None
+    for ch in g._chunks.iter_partition(p):
+        k, s, c = ch["k"], ch["sum(v)"], ch["count(k)"]
+        expect_c = 1 + (k < DUP)
+        assert np.array_equal(c, expect_c), "bad counts"
+        assert np.array_equal(s, expect_c * (k % 97).astype(np.float64)), \
+            "bad sums"
+        for i in range(0, len(k), 4096):  # canonical-order spot checks
+            kb = exchange.key_bytes((int(k[i]),))
+            assert prev_kb is None or kb > prev_kb, "not in key_bytes order"
+            prev_kb = kb
+        h.update(np.ascontiguousarray(k).tobytes())
+        h.update(np.ascontiguousarray(s).tobytes())
+        keys.append(k)
+        nrows += len(k)
+assert nrows == N, nrows
+allk = np.sort(np.concatenate(keys))
+assert np.array_equal(allk, np.arange(N, dtype=np.int64)), "key set wrong"
+telemetry.reset()
+
+# 3) telemetry carries >=1 spill and the dlstatus shuffle block schema
+from distributeddeeplearningspark_tpu import status
+
+events = telemetry.read_events(os.environ["WD"])
+spills = [e for e in events
+          if e.get("kind") == "shuffle" and e.get("edge") == "spill"]
+assert spills, "no spill events under a 64MB budget at 10M keys"
+rep = status.report(os.environ["WD"])
+sh = rep["shuffle"]
+assert sh is not None, "dlstatus found no shuffle block"
+for key in ("ops", "pairs_in", "rows_out", "bytes_moved", "spills",
+            "spill_events", "overflow", "last"):
+    assert key in sh, key
+for key in ("op", "workers", "buckets", "map_s", "merge_s", "spills",
+            "mem_budget_mb", "bucket_rows_max", "bucket_rows_mean",
+            "skew", "verdict"):
+    assert key in sh["last"], key
+assert sh["last"]["op"] == "groupBy.agg" and sh["pairs_in"] == N + DUP
+print(f"keys=10M budget=64MB spills={sh['spills']} "
+      f"moved={sh['bytes_moved'] / 1e6:.0f}MB skew={sh['last']['skew']} "
+      f"checksum={h.hexdigest()}")
+PYEOF
+) ) || rc=$?
+  log shuffle "${out:-shuffle smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[shuffle] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$wd"
+  return $rc
+}
+
 overall=0
 case "${1:-both}" in
   fast) run_tier fast "not slow" || overall=$? ;;
   slow) run_tier slow "slow" || overall=$? ;;
   both) run_tier fast "not slow" || overall=$?
-        run_tier slow "slow" || overall=$? ;;
+        run_tier slow "slow" || overall=$?
+        run_shuffle_smoke || overall=$? ;;
   # the recovery drills (kill-mid-finalize, poisoned restore, hang, NaN
   # spike) end-to-end — slow-marked, so the fast tier never pays for gangs
   chaos) run_tier chaos "slow or not slow" tests/test_chaos.py || overall=$? ;;
@@ -377,10 +477,14 @@ case "${1:-both}" in
   # input pipeline: 2-worker pool beats the serial map on a synthetic JPEG
   # corpus, and telemetry carries the per-worker gauges (docs/PERFORMANCE.md)
   input) run_input_smoke || overall=$? ;;
+  # distributed shuffle: 10M-key groupBy.agg the serial ceiling refuses
+  # completes via the 2-worker exchange under DLS_SHUFFLE_MEM_MB, exact
+  # result + >=1 spill + dlstatus shuffle block (docs/PERFORMANCE.md)
+  shuffle) run_shuffle_smoke || overall=$? ;;
   # the executable pod-day scripts, logged with the same audit trail
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
