@@ -169,29 +169,53 @@ def _host_conditions() -> dict:
 
 
 def _train_setup(model, batch, loss_fn, *, tx=None, rules=None, trainable=None):
-    """Shared: mesh, sharded state, jitted step, global batch, flops."""
+    """Shared: mesh, sharded state, ledgered jitted step, global batch, flops.
+
+    The step is wrapped in the compile ledger (telemetry/anatomy.py) and
+    ``prepare``d: the FLOPs cost analysis and the warmup executable are ONE
+    compile (the old path compiled a throwaway twin), and the arm's record
+    gains the ledger fields — ``compile_s`` / ``recompile_count`` — via
+    :func:`_ledger_fields`.
+    """
     import optax
 
     from distributeddeeplearningspark_tpu.data.feed import put_global
-    from distributeddeeplearningspark_tpu.metrics import compiled_flops_per_step
     from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
     from distributeddeeplearningspark_tpu.parallel.sharding import REPLICATED
+    from distributeddeeplearningspark_tpu.telemetry import anatomy as anatomy_lib
     from distributeddeeplearningspark_tpu.train import step as step_lib
 
     mesh = MeshSpec(data=-1).build()
     tx = tx or optax.sgd(0.01, momentum=0.9)
     state, shardings = step_lib.init_state(
         model, tx, batch, mesh, rules if rules is not None else REPLICATED)
-    train_step = step_lib.jit_train_step(
-        step_lib.make_train_step(
-            model.apply, tx, loss_fn, mutable_keys=tuple(state.mutable.keys()),
-            trainable=trainable,
+    train_step = anatomy_lib.instrument(
+        step_lib.jit_train_step(
+            step_lib.make_train_step(
+                model.apply, tx, loss_fn,
+                mutable_keys=tuple(state.mutable.keys()),
+                trainable=trainable,
+            ),
+            mesh, shardings,
         ),
-        mesh, shardings,
+        name="bench-train_step",
     )
     gbatch = put_global(batch, mesh)
-    flops = compiled_flops_per_step(train_step.lower(state, gbatch).compile())
-    return mesh, state, train_step, gbatch, flops
+    train_step.prepare(state, gbatch)
+    return mesh, state, train_step, gbatch, train_step.flops_per_step
+
+
+def _ledger_fields(step) -> dict:
+    """The per-arm compile-ledger rollup (tools/perf_guard.py folds these
+    across rounds): total compile seconds and the flagged-recompile count —
+    0 is the steady-state contract a recompile storm breaks."""
+    summary = getattr(step, "compile_summary", None)
+    if summary is None:
+        return {}
+    s = summary()
+    return {"compile_s": s["total_compile_s"],
+            "recompile_count": s["flagged_recompiles"],
+            "compiles": s["compiles"]}
 
 
 def _routes_to_flash(*, b: int, s: int, h: int, d: int, masked: bool) -> bool:
@@ -262,6 +286,7 @@ def bench_resnet(iters: int, batch_size: int = 256,
     rec = {
         "images_per_sec_per_chip": round(batch_size / step_time / n_chips, 2),
         **_timing_fields(times, iters),
+        **_ledger_fields(step),
         "mfu": round(mfu, 4),
         "batch_size": batch_size,
         "image_px": 224,
@@ -365,6 +390,7 @@ def bench_bert(iters: int, batch_size: int = 32, seq: int = 512,
     rec = {
         "tokens_per_sec_per_chip": round(tokens / step_time / n_chips, 1),
         **_timing_fields(times, iters),
+        **_ledger_fields(step),
         "mfu": round(mfu, 4),
         "batch_size": batch_size,
         "seq_len": seq,
@@ -654,6 +680,7 @@ def bench_llama(iters: int, batch_size: int | None = None, seq: int = 2048,
     rec = {
         "tokens_per_sec_per_chip": round(batch_size * seq / step_time / n_chips, 1),
         **_timing_fields(times, iters),
+        **_ledger_fields(step),
         "mfu_model": round(mfu_model, 4),
         "mfu_convention": ("frozen-base model FLOPs: 4P fwd+dx, dW for "
                            "LoRA only, +attn matmuls — NOT comparable to "
@@ -824,16 +851,21 @@ def bench_dlrm(iters: int, batch_size: int = 8192,
     mesh = MeshSpec(data=-1).build()
     state, shardings = step_lib.init_state(
         model, tx, batch, mesh, dlrm_rules(), sparse_embed=specs)
-    step = step_lib.jit_train_step(
-        embed.make_sparse_embed_train_step(
-            model.apply, tx, losses.binary_xent, specs),
-        mesh, shardings)
+    from distributeddeeplearningspark_tpu.telemetry import anatomy as anatomy_lib
+
+    step = anatomy_lib.instrument(
+        step_lib.jit_train_step(
+            embed.make_sparse_embed_train_step(
+                model.apply, tx, losses.binary_xent, specs),
+            mesh, shardings),
+        name="bench-train_step")
     gbatch = put_global(batch, mesh)
     n_chips = mesh.devices.size
     step_time, times, _ = bench_steps(step, state, gbatch, iters=iters)
     rec = {
         "examples_per_sec_per_chip": round(batch_size / step_time / n_chips, 1),
         **_timing_fields(times, iters),
+        **_ledger_fields(step),
         "mfu": 0.0,  # gather-bound; MFU is not the meaningful axis here
         "batch_size": batch_size,
         "embedding_rows": sum(vocabs),
@@ -1030,6 +1062,16 @@ def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
         "batch_size": batch_size,
         "n_images": n_images,
         "jpeg_quality": 90,
+        # the compile-ledger fields every device arm records — null here,
+        # explicitly: a host-only round compiles no device step, and an
+        # absent key would read as "not instrumented yet" to the
+        # perf_guard sentinel rather than "nothing to measure"
+        "compile_s": None,
+        "recompile_count": None,
+        "mfu": None,
+        "anatomy_reason": ("host-only input-pipeline workload: no device "
+                           "step compiled, so compile ledger and MFU do "
+                           "not apply"),
         **_host_conditions(),
     }
 
@@ -1806,8 +1848,11 @@ def main(argv=None) -> int:
     else:
         emit("bench_failed", 0.0, "none", 0.0, extra)
         return 0
-    mfu = (r.get("mfu", r.get("mfu_model",
-                              r.get("mfu_hlo_scan_opaque", 0.0)))
+    # `or`-chained, not .get-defaulted: the input_pipeline arm now records
+    # an EXPLICIT "mfu": None (host arm, with a reason), which must fall
+    # through to 0.0 here, not reach the round() below as None
+    mfu = ((r.get("mfu") or r.get("mfu_model")
+            or r.get("mfu_hlo_scan_opaque") or 0.0)
            if backend == "tpu" else 0.0)
     if any("timing_suspect" in res for res in results.values()):
         # a physically impossible measurement must not masquerade as a

@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import logging
 import math
+import os
 import time
 from typing import Any
 
@@ -33,7 +34,32 @@ PEAK_FLOPS: dict[str, float] = {
 }
 
 
+def env_peak_flops_override() -> float | None:
+    """The validated ``DLS_PEAK_FLOPS`` env override, or None — the ONE
+    parse shared by :func:`device_peak_flops` and the anatomy layer's
+    labeled resolution (:func:`..telemetry.anatomy.resolve_peak_flops`)."""
+    raw = os.environ.get("DLS_PEAK_FLOPS")
+    if raw:
+        try:
+            v = float(raw)
+        except ValueError:
+            logger.warning("ignoring malformed DLS_PEAK_FLOPS=%r", raw)
+            return None
+        if v > 0:
+            return v
+    return None
+
+
 def device_peak_flops(device: jax.Device | None = None) -> float | None:
+    """Per-chip peak FLOPs/s for the MFU denominator.
+
+    ``DLS_PEAK_FLOPS`` overrides the spec table — calibrate a CPU drill,
+    price a derated clock, or pin a projection's denominator explicitly
+    (:mod:`.telemetry.anatomy` resolves the same order and adds a labeled
+    nominal CPU fallback for the anatomy gauges)."""
+    v = env_peak_flops_override()
+    if v is not None:
+        return v
     d = device if device is not None else jax.devices()[0]
     return PEAK_FLOPS.get(getattr(d, "device_kind", ""), None)
 

@@ -40,6 +40,7 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.telemetry import anatomy as anatomy_lib
 from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.serve")
@@ -173,7 +174,16 @@ class InferenceEngine:
             # engine's own compile set
             return forward(params, batch)
 
-        self._forward = jax.jit(_engine_forward)
+        # the compile ledger (telemetry/anatomy.py) owns this forward's
+        # lower→compile path: every bucket compile — warmup's precompiles
+        # included — emits a `compile` phase span plus a cost-analyzed
+        # `compile` event, so goodput and the request traces account
+        # warmup/bucket-miss seconds instead of silently misattributing
+        # them; any compile beyond the pinned bucket ladder flags as a
+        # recompile in `dlstatus --anatomy`
+        self._forward = anatomy_lib.instrument(
+            jax.jit(_engine_forward), name=f"serve-{name}",
+            expected_signatures=len(self.batch_sizes))
         self._params = params
         self.params_version: int | str = 0
         self._queue: list[_Request] = []

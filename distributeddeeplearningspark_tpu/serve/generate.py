@@ -57,6 +57,7 @@ from distributeddeeplearningspark_tpu.serve.engine import (
     OverloadedError,
 )
 from distributeddeeplearningspark_tpu.serve.kv import PagedKVArena, PrefixCache
+from distributeddeeplearningspark_tpu.telemetry import anatomy as anatomy_lib
 from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.serve")
@@ -270,9 +271,18 @@ class ContinuousGenerator:
 
             return jax.tree.map(ins, cache, row)
 
-        self._prefill = jax.jit(prefill, static_argnames=())
-        self._step = jax.jit(step)
-        self._insert = jax.jit(insert)
+        # compile-ledgered (telemetry/anatomy.py): warmup and bucket-miss
+        # compiles emit `compile` phase spans + cost-analyzed events, so a
+        # replica's startup seconds stop misattributing to serving time;
+        # prefill's pinned compile set is the prompt-bucket ladder, the
+        # single-token step and the row insert compile exactly once
+        self._prefill = anatomy_lib.instrument(
+            jax.jit(prefill, static_argnames=()), name="decode-prefill",
+            expected_signatures=len(self.prompt_buckets))
+        self._step = anatomy_lib.instrument(
+            jax.jit(step), name="decode-step")
+        self._insert = anatomy_lib.instrument(
+            jax.jit(insert), name="decode-insert")
 
         # cache structure from an abstract eval (free)
         def abstract_cache(batch, cache_len):
@@ -442,8 +452,13 @@ class ContinuousGenerator:
             tok = sample(logits[jnp.arange(1), true_end - start - 1], key)
             return scatter(pool, cache, row_tables), tok
 
-        self._paged_step = jax.jit(paged_step)
-        self._paged_prefill = jax.jit(paged_prefill)
+        # same ledger discipline as the dense twins: step compiles once,
+        # prefill's pinned set is the (page-aligned) prompt-bucket ladder
+        self._paged_step = anatomy_lib.instrument(
+            jax.jit(paged_step), name="decode-step")
+        self._paged_prefill = anatomy_lib.instrument(
+            jax.jit(paged_prefill), name="decode-prefill",
+            expected_signatures=len(self.prompt_buckets))
 
     @property
     def paged(self) -> bool:

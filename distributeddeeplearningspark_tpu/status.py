@@ -16,6 +16,9 @@ was productive, and what ate the rest".
     dlstatus <workdir> --fleet-serve  # + per-replica serving table
     dlstatus <workdir> --traces       # + request latency anatomy (trace fold)
     dlstatus <workdir> --slo 0.25     # + SLO sentinel: p99 target, burn rate
+    dlstatus <workdir> --anatomy      # + compile ledger, device/host/input
+                                      #   split, MFU, memory watermarks
+    dlstatus <workdir> --watch        # live-follow: re-render on an interval
     dlstatus <workdir> --export-trace out.json  # Chrome/Perfetto trace_event
 
 A workdir that served traffic (:mod:`..serve` — ``request`` events in the
@@ -47,6 +50,7 @@ import sys
 import time
 
 from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.telemetry import anatomy as anatomy_lib
 from distributeddeeplearningspark_tpu.telemetry import fleet as fleet_lib
 
 #: goodput components rendered in the breakdown table, in display order.
@@ -211,15 +215,18 @@ def shuffle_from(events: list[dict]) -> dict | None:
 def report(workdir: str, *, now: float | None = None,
            hosts: bool = False, fleet_serve: bool = False,
            traces: bool = False, slo_target: float | None = None,
-           slo_budget: float = 0.01,
+           slo_budget: float = 0.01, anatomy: bool = False,
            events: list[dict] | None = None) -> dict:
     """The full run report as a plain dict (what ``--json`` prints).
     ``hosts=True`` adds the ``fleet`` key (per-host table, skew, verdicts);
     ``fleet_serve=True`` adds ``fleet_serve`` (per-replica serving table);
     ``traces=True`` adds ``traces`` (the per-stage latency anatomy);
     ``slo_target`` (p99 seconds) adds ``slo`` (per-tenant burn rates and
-    GOOD/BURNING/EXHAUSTED verdicts against ``slo_budget``); ``events``
-    skips the stream read when the caller already holds it."""
+    GOOD/BURNING/EXHAUSTED verdicts against ``slo_budget``);
+    ``anatomy=True`` adds ``anatomy`` (compile ledger, device/host/input
+    split, MFU, memory watermarks — :func:`..telemetry.anatomy
+    .anatomy_report`); ``events`` skips the stream read when the caller
+    already holds it."""
     if events is None:
         events = telemetry.read_events(workdir)
     heartbeats = [e for e in events if e.get("kind") == "heartbeat"]
@@ -242,6 +249,8 @@ def report(workdir: str, *, now: float | None = None,
         **({"slo": fleet_lib.slo_report(events, target_p99_s=slo_target,
                                         budget=slo_budget)}
            if slo_target is not None else {}),
+        **({"anatomy": anatomy_lib.anatomy_report(events)}
+           if anatomy else {}),
         "workdir": workdir,
         "event_files": telemetry.event_files(workdir),
         "num_events": len(events),
@@ -395,6 +404,87 @@ def render_traces(tr: dict) -> list[str]:
     return lines
 
 
+def _fmt_bytes(v: float | None) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0 or unit == "GiB":
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024.0
+    return "-"
+
+
+def render_anatomy(an: dict) -> list[str]:
+    """The ``--anatomy`` section: device/host/input split, MFU, compile
+    ledger + recompile verdict, memory watermarks."""
+    lines: list[str] = []
+    st = an.get("steps")
+    if st:
+        lines.append(
+            f"device anatomy: {st['laps']} lap(s) / {st['steps']} step(s), "
+            f"lap wall {st['wall_s']:.2f}s")
+        fr = st["fractions"]
+
+        def pct(k):
+            f = fr.get(k)
+            return f"{100.0 * f:5.1f}%" if f is not None else "     -"
+
+        lines.append(
+            f"  device       {st['device_s']:10.2f}s  {pct('device')}  "
+            f"(dispatch {st['device_dispatch_s']:.2f}s + drain "
+            f"{st['device_drain_s']:.2f}s)")
+        lines.append(f"  host         {st['host_s']:10.2f}s  {pct('host')}")
+        lines.append(
+            f"  input-wait   {st['input_wait_s']:10.2f}s  "
+            f"{pct('input_wait')}")
+        lines.append(
+            f"  compile      {st['compile_s']:10.2f}s  {pct('compile')}  "
+            f"(in-lap)")
+        if an["verdicts"].get("bound"):
+            lines.append(f"  verdict: {an['verdicts']['bound']}")
+    mfu = an.get("mfu")
+    if mfu and mfu.get("mfu") is not None:
+        lines.append(
+            f"  MFU {100.0 * mfu['mfu']:.3f}%"
+            + (f" (last lap {100.0 * mfu['mfu_last_lap']:.3f}%)"
+               if mfu.get("mfu_last_lap") is not None else "")
+            + (f" — {mfu['flops_per_step']:.2e} flops/step"
+               if mfu.get("flops_per_step") else "")
+            + f" over {mfu.get('num_chips') or 1} chip(s), peak "
+              f"{mfu['peak_flops_per_chip']:.2e}/chip "
+              f"[{mfu.get('peak_source')}]")
+    cl = an.get("compile_ledger")
+    if cl and cl["compiles"]:
+        lines.append(
+            f"compile ledger: {cl['compiles']} compile(s), "
+            f"{cl['distinct_signatures']} signature(s), "
+            f"{cl['total_compile_s']:.2f}s total — "
+            f"{an['verdicts']['recompile']}")
+        for fn, row in sorted(cl["by_fn"].items()):
+            lines.append(
+                f"  {fn:<16} {row['compiles']:>3} compile(s)  "
+                f"{row['signatures']:>3} sig(s)  {row['compile_s']:8.2f}s"
+                + (f"  flops={row['flops']:.2e}" if row.get("flops") else "")
+                + (f"  RECOMPILES={row['flagged_recompiles']}"
+                   if row["flagged_recompiles"] else ""))
+    mem = an.get("memory")
+    if mem:
+        if mem["source"] == "memory_stats":
+            lines.append(
+                f"memory (memory_stats): in use "
+                f"{_fmt_bytes(mem.get('bytes_in_use_max'))}  peak "
+                f"{_fmt_bytes(mem.get('peak_bytes_in_use_max'))}  limit "
+                f"{_fmt_bytes(mem.get('bytes_limit_min'))}  headroom "
+                f"{_fmt_bytes(mem.get('headroom_bytes'))}")
+        else:
+            lines.append(
+                f"memory (live-buffers): "
+                f"{_fmt_bytes(mem.get('live_bytes'))} in live arrays "
+                f"(backend exposes no allocator stats)")
+    return lines
+
+
 def render_slo(s: dict) -> list[str]:
     """The ``--slo`` section: per-tenant burn rate and verdict."""
     lines: list[str] = []
@@ -439,6 +529,9 @@ def render(rep: dict) -> str:
     if rep.get("slo"):
         lines.append("")
         lines.extend(render_slo(rep["slo"]))
+    if rep.get("anatomy"):
+        lines.append("")
+        lines.extend(render_anatomy(rep["anatomy"]))
     lines.append("")
     lines.append("goodput breakdown")
     wall = g["wall_s"] or float("inf")
@@ -568,17 +661,45 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--slo-budget", type=float, default=0.01,
                     help="violation fraction the SLO tolerates "
                          "(default 0.01 = 99%% of requests in target)")
+    ap.add_argument("--anatomy", action="store_true",
+                    help="device-side anatomy: compile ledger + recompile "
+                         "verdict, device/host/input lap split, MFU, "
+                         "memory watermarks")
     ap.add_argument("--export-trace", metavar="OUT.json", default=None,
                     help="write the run's spans (serve requests + train "
                          "phases) as Chrome/Perfetto trace_event JSON")
+    ap.add_argument("--watch", action="store_true",
+                    help="live-follow mode: re-read the JSONL stream and "
+                         "re-render every --interval seconds (works on an "
+                         "in-progress run; ctrl-C to stop)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--watch refresh period in seconds (default 2)")
+    ap.add_argument("--watch-count", type=int, default=0,
+                    help="--watch: stop after N renders (0 = until ctrl-C; "
+                         "mainly for tests/scripts)")
     args = ap.parse_args(argv)
+    if args.watch and args.export_trace:
+        ap.error("--watch and --export-trace are mutually exclusive "
+                 "(export reads one finished stream)")
+
+    def build(events: list[dict]) -> dict:
+        return report(args.workdir, hosts=args.hosts,
+                      fleet_serve=args.fleet_serve, traces=args.traces,
+                      slo_target=args.slo, slo_budget=args.slo_budget,
+                      anatomy=args.anatomy, events=events)
+
+    def emit_one(rep: dict) -> None:
+        if args.json:
+            print(json.dumps(_json_safe(rep), default=str))
+        else:
+            print(render(rep))
+
+    if args.watch:
+        return _watch(args, build, emit_one)
     # ONE stream read shared between the report and the exporter — a
     # rotation-capped long-lived fleet's segments are a real parse cost
     events = telemetry.read_events(args.workdir)
-    rep = report(args.workdir, hosts=args.hosts,
-                 fleet_serve=args.fleet_serve, traces=args.traces,
-                 slo_target=args.slo, slo_budget=args.slo_budget,
-                 events=events)
+    rep = build(events)
     if not rep["num_events"]:
         print(f"dlstatus: no telemetry events under {args.workdir} "
               f"(looked in {telemetry.telemetry_dir(args.workdir)})",
@@ -596,11 +717,57 @@ def main(argv: list[str] | None = None) -> int:
         print(f"dlstatus: wrote {n} span(s) to {args.export_trace} "
               f"(open in ui.perfetto.dev or chrome://tracing)",
               file=sys.stderr)
-    if args.json:
-        print(json.dumps(_json_safe(rep), default=str))
-    else:
-        print(render(rep))
+    emit_one(rep)
     return 0
+
+
+def _watch(args, build, emit_one) -> int:
+    """``--watch``: tail the stream, re-render on an interval.
+
+    A pure re-read per tick — the reader's ``events-*.jsonl`` glob already
+    follows segment rotation and newly appearing process files, and a
+    torn mid-append tail line is skipped exactly as in one-shot mode, so
+    following an in-progress run needs no writer cooperation. Human mode
+    clears the screen between renders on a TTY (a separator line
+    otherwise); ``--json`` emits one report line per tick, streamable
+    into ``jq``."""
+    renders = 0
+    try:
+        while True:
+            events = telemetry.read_events(args.workdir)
+            if not args.json:
+                if sys.stdout.isatty():
+                    print("\x1b[2J\x1b[H", end="")
+                elif renders:
+                    print("\n" + "=" * 72)
+                print(f"dlstatus --watch {args.workdir}  "
+                      f"(refresh {args.interval:g}s, render "
+                      f"{renders + 1}"
+                      + (f"/{args.watch_count}" if args.watch_count else "")
+                      + ", ctrl-C to stop)")
+            if events:
+                emit_one(build(events))
+            elif args.json:
+                print(json.dumps({"workdir": args.workdir,
+                                  "num_events": 0}))
+            else:
+                print(f"  no telemetry events yet under {args.workdir} "
+                      f"(waiting)")
+            renders += 1
+            if args.watch_count and renders >= args.watch_count:
+                return 0
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+    except BrokenPipeError:
+        # the downstream pager/head closed: a follow mode's normal exit.
+        # Point fd 1 at devnull before returning — the interpreter's
+        # shutdown flush of the buffered stdout would otherwise re-raise
+        # and turn the clean rc 0 into exit 120 + "Exception ignored"
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":

@@ -53,6 +53,24 @@ present):
   which lower into the span model like any phase. ``dlstatus`` renders
   the newest summaries as the shuffle block (bytes moved, spill count,
   per-bucket skew, slowest-bucket verdict).
+- ``compile`` — one executable built by the compile ledger
+  (:mod:`.anatomy`): ``fn`` (the instrumented callable), ``sig`` /
+  ``sig_hash`` (shape/dtype signature), ``compile_s``, ``flops`` /
+  ``bytes_accessed`` (XLA cost analysis), ``argument_bytes`` /
+  ``output_bytes`` / ``temp_bytes`` (memory analysis), and ``recompile``
+  — True when the signature compiled more than once or the distinct-
+  signature count exceeded the wrapper's pinned expectation (1 for a
+  train step, the bucket ladder for the serve forwards). Every compile
+  additionally spans a ``compile`` *phase* so goodput accounts the
+  stall. ``dlstatus --anatomy`` renders the ledger and its recompile
+  verdict.
+- ``memory`` — a device-memory watermark sample (:mod:`.anatomy`), one
+  per metrics lap: ``bytes_in_use_max`` / ``peak_bytes_in_use_max`` /
+  ``bytes_limit_min`` / ``headroom_bytes`` from jax device
+  ``memory_stats()`` where the backend exposes them
+  (``source="memory_stats"``), or the live-buffer byte total
+  (``source="live-buffers"``, CPU fallback). The Chrome exporter draws
+  these as a counter track.
 - ``span`` — one closed span of a request-level distributed trace
   (:mod:`.trace`): ``trace_id``/``span_id``/``parent_id``/``name``/
   ``t0``/``t1`` + free-form ``attrs``. Spans are buffered per request and

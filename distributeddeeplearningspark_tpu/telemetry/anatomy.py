@@ -1,0 +1,751 @@
+"""Device-side performance observatory: compile ledger, step anatomy, MFU.
+
+Every observability layer so far watches the host side — goodput wall-clock
+(:mod:`..telemetry`), fleet skew (:mod:`.fleet`), request spans
+(:mod:`.trace`). Nothing watched the device/compiler dimension: a silent
+recompile storm, shrinking HBM headroom, or a 15% step-time regression was
+invisible until a human reread BENCH files. This module closes that gap
+with three instruments that all land on the same JSONL bus:
+
+- **Compile ledger** (:func:`instrument` / :class:`InstrumentedFunction`):
+  a wrapper around a jitted callable that owns the lower→compile path via
+  AOT dispatch. Every executable it builds emits one ``compile`` event —
+  shape/dtype signature, compile seconds, ``cost_analysis()`` FLOPs and
+  bytes accessed, ``memory_analysis()`` buffer sizes — wrapped in a
+  ``compile`` *phase* span so goodput accounts the stall. Recompile
+  detection generalizes the serve engine's pinned ``compiled_batch_shapes``
+  discipline: a signature compiling more than once, or the distinct-
+  signature count exceeding the wrapper's ``expected_signatures`` (1 for a
+  shape-stable train step; the bucket-ladder size for the serve forwards),
+  flags the event ``recompile=True``.
+- **Step anatomy** (:class:`StepAnatomy`): splits each training lap's
+  wall-clock into *device* (timed dispatch on the compiled executable +
+  the lap-boundary drain the host blocks on), *compile* (in-lap ledger
+  compiles), *input-wait* (the starvation probe's number), and *host* (the
+  measured residual: python bookkeeping, transfers, checkpoint/eval work).
+  Per-lap **MFU** is computed from the ledger's analytical FLOPs over a
+  per-backend peak-FLOPs table (``DLS_PEAK_FLOPS`` override; a labeled
+  nominal figure on CPU so host drills still get a finite, comparable
+  number). The gauges ride each ``step_metrics`` record.
+- **HBM watermarks** (:func:`memory_watermarks`): jax device memory stats
+  (``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``) where the
+  backend exposes them, live-buffer byte totals as the CPU fallback —
+  emitted as ``memory`` events per metrics lap, the headroom trendline
+  ``dlstatus --anatomy`` renders and the Chrome exporter draws as a
+  counter track.
+
+The reader side (:func:`anatomy_report`) is a pure jax-free fold over the
+event stream, like every other ``dlstatus`` section — jax imports in this
+module are all function-local so the CLI never pays (or requires) a
+backend. ``tools/perf_guard.py`` folds the same fields across BENCH
+records into the cross-run regression sentinel.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Iterable
+
+from distributeddeeplearningspark_tpu import telemetry as telemetry_lib
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.telemetry.anatomy")
+
+#: Env override for the per-chip peak FLOPs/s the MFU denominator uses —
+#: wins over the spec-sheet table (calibrate CPU drills, price a derated
+#: clock, or pin a projection's denominator explicitly).
+PEAK_FLOPS_ENV = "DLS_PEAK_FLOPS"
+
+#: Nominal per-core peak for the CPU backend (order-of-magnitude: ~8 f32
+#: lanes × 2 FMA flops × ~1.25 GHz). CPU MFU exists so host-side drills and
+#: CI produce a finite, run-to-run comparable number — the ``peak_source``
+#: label says it is nominal, and DLS_PEAK_FLOPS calibrates it.
+CPU_NOMINAL_PEAK_PER_CORE = 2.0e10
+
+_SIG_LEAVES_SHOWN = 4  # leaves spelled out in the human-readable signature
+
+#: newest compile events kept verbatim in the ``--anatomy`` report — a
+#: recompile storm emits one per step, and the report must stay renderable
+#: mid-incident (totals/rollups always cover everything).
+MAX_LEDGER_EVENTS_REPORTED = 50
+
+
+def resolve_peak_flops() -> tuple[float | None, str]:
+    """(peak FLOPs/s per chip, source label) for the MFU denominator.
+
+    Resolution order: ``DLS_PEAK_FLOPS`` env → the bf16 spec table in
+    :mod:`..metrics` by device kind → a labeled nominal figure on CPU →
+    ``(None, "unknown-device")``.
+    """
+    from distributeddeeplearningspark_tpu.metrics import (
+        env_peak_flops_override,
+    )
+
+    v = env_peak_flops_override()
+    if v is not None:
+        return v, PEAK_FLOPS_ENV
+    import jax
+
+    from distributeddeeplearningspark_tpu.metrics import PEAK_FLOPS
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "")
+    peak = PEAK_FLOPS.get(kind)
+    if peak:
+        return peak, f"spec table ({kind})"
+    if d.platform == "cpu":
+        cores = os.cpu_count() or 1
+        return (cores * CPU_NOMINAL_PEAK_PER_CORE,
+                f"nominal-cpu ({cores} cores; set {PEAK_FLOPS_ENV} to "
+                f"calibrate)")
+    return None, f"unknown-device ({kind or d.platform})"
+
+
+def _leaf_sig(x: Any) -> tuple[tuple[int, ...], str]:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        import numpy as np
+
+        a = np.asarray(x)
+        shape, dtype = a.shape, a.dtype
+    return tuple(int(s) for s in shape), str(dtype)
+
+
+_DTYPE_SHORT = {"float32": "f32", "float16": "f16", "bfloat16": "bf16",
+                "float64": "f64", "int32": "i32", "int64": "i64",
+                "int8": "i8", "uint8": "u8", "bool": "b1"}
+
+
+def _human_sig(leaf_sigs: list[tuple[tuple[int, ...], str]]) -> str:
+    parts = [f"{_DTYPE_SHORT.get(dt, dt)}[{','.join(map(str, sh))}]"
+             for sh, dt in leaf_sigs[:_SIG_LEAVES_SHOWN]]
+    extra = len(leaf_sigs) - _SIG_LEAVES_SHOWN
+    return " ".join(parts) + (f" …+{extra} leaves" if extra > 0 else "")
+
+
+class InstrumentedFunction:
+    """Compile-ledger wrapper around a jitted callable (AOT dispatch).
+
+    Owns the lower→compile path the wrapped ``jax.jit`` would otherwise
+    hide: calls are dispatched on explicitly compiled executables keyed by
+    the arguments' (structure, shape, dtype, sharding) signature, so every
+    compile is an *observed event* — timed, cost-analyzed, emitted to
+    telemetry (a ``compile`` event + a ``compile`` phase span for goodput)
+    — instead of an anonymous first-call stall. Same-signature calls hit
+    the executable dict; the compiled program set is exactly
+    ``_cache_size()`` (the serve engine's ``compiled_batch_shapes`` pin).
+
+    ``expected_signatures`` is the recompile contract: 1 for a shape-stable
+    train step, the bucket-ladder length for a serve forward. A signature
+    compiling twice, or the distinct count exceeding the expectation, flags
+    the event ``recompile=True`` — the ``dlstatus --anatomy`` verdict and
+    ``bench.py``'s ``recompile_count`` read that flag.
+
+    Backends (or call shapes) where AOT lowering or dispatch fails degrade
+    to calling the wrapped jit directly, with compiles still *detected*
+    (jit-cache growth) and timed, minus the cost analysis — the ledger is
+    then best-effort rather than absent (``aot: false`` on its events).
+    """
+
+    def __init__(self, jitted: Callable, *, name: str,
+                 expected_signatures: int = 1, clock=time.perf_counter):
+        self._jitted = jitted
+        self.name = name
+        self.expected_signatures = max(1, int(expected_signatures))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._compiled: dict[Any, Any] = {}     # dispatch key → executable
+        self._sig_compiles: dict[str, int] = {}  # sig_hash → compile count
+        self.records: list[dict[str, Any]] = []  # ledger, oldest first
+        self._anatomy: "StepAnatomy | None" = None
+        self._aot = True
+        #: newest executable's analytical FLOPs per call (global, XLA cost
+        #: analysis — same convention/caveats as
+        #: :func:`..metrics.compiled_flops_per_step`)
+        self.flops_per_step: float | None = None
+        self.bytes_per_step: float | None = None
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach_anatomy(self, anatomy: "StepAnatomy | None") -> None:
+        """Route per-call dispatch/compile timings into a lap anatomy."""
+        self._anatomy = anatomy
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def _cache_size(self) -> int:
+        """Compiled-executable count (AOT dict and/or inner jit cache)."""
+        inner = 0
+        try:
+            inner = int(self._jitted._cache_size())
+        except Exception:  # jit cache introspection is best-effort
+            pass
+        return max(len(self._compiled), inner)
+
+    # -- signature ------------------------------------------------------------
+
+    def _dispatch_key(self, args: tuple) -> tuple:
+        """The per-call executable-dict key: (treedef, shape/dtype sigs,
+        shardings). This runs on EVERY dispatch — the serving decode step
+        pays it per token — so it is tuple-building only; the expensive
+        rendering (str(treedef), blake2b, the human signature) happens
+        once per compile in :meth:`_reported_sig`.
+
+        The key includes per-leaf shardings (an AOT executable is
+        layout-committed); the *reported* signature is shape/dtype only —
+        a sharding flap recompiling the same shapes is exactly the event
+        the ledger exists to expose, so both compiles share one sig hash
+        and the second one flags."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        sigs = tuple(_leaf_sig(x) for x in leaves)
+        shardings = []
+        for x in leaves:
+            s = getattr(x, "sharding", None)
+            try:
+                hash(s)
+            except TypeError:
+                s = str(s)
+            shardings.append(s)
+        return (treedef, sigs, tuple(shardings))
+
+    @staticmethod
+    def _reported_sig(key: tuple) -> tuple[str, str, int]:
+        """(human sig, sig hash, nleaves) for one ledger record — the
+        compile-miss-path half of :meth:`_dispatch_key`."""
+        treedef, sigs = key[0], list(key[1])
+        sig_hash = hashlib.blake2b(
+            repr((str(treedef), sigs)).encode(), digest_size=8).hexdigest()
+        return _human_sig(sigs), sig_hash, len(sigs)
+
+    # -- ledger ---------------------------------------------------------------
+
+    def _record_compile(self, sig: str, sig_hash: str, nleaves: int,
+                        compile_s: float, *, compiled=None) -> dict:
+        flops = bytes_accessed = None
+        mem_fields: dict[str, int] = {}
+        if compiled is not None:
+            try:
+                cost = compiled.cost_analysis()
+                if isinstance(cost, list):  # older jax: per-device list
+                    cost = cost[0] if cost else {}
+                flops = float(cost.get("flops", 0.0)) or None
+                bytes_accessed = float(cost.get("bytes accessed", 0.0)) or None
+            except Exception:  # cost analysis unsupported on some backends
+                pass
+            try:
+                ma = compiled.memory_analysis()
+                mem_fields = {
+                    "argument_bytes": int(ma.argument_size_in_bytes),
+                    "output_bytes": int(ma.output_size_in_bytes),
+                    "temp_bytes": int(ma.temp_size_in_bytes),
+                }
+            except Exception:
+                pass
+        with self._lock:
+            n = self._sig_compiles.get(sig_hash, 0) + 1
+            self._sig_compiles[sig_hash] = n
+            distinct = len(self._sig_compiles)
+            recompile = n > 1 or distinct > self.expected_signatures
+            rec = {
+                "fn": self.name, "sig": sig, "sig_hash": sig_hash,
+                "nleaves": nleaves, "compile_s": round(compile_s, 6),
+                "flops": flops, "bytes_accessed": bytes_accessed,
+                **mem_fields,
+                "sig_compiles": n, "distinct_signatures": distinct,
+                "expected_signatures": self.expected_signatures,
+                "recompile": recompile, "aot": self._aot,
+            }
+            self.records.append(rec)
+            if flops:
+                self.flops_per_step = flops
+            if bytes_accessed:
+                self.bytes_per_step = bytes_accessed
+        if recompile:
+            logger.warning(
+                "%s recompiled (signature %s seen %d time(s), %d distinct "
+                "vs %d expected): %s", self.name, sig_hash, n, distinct,
+                self.expected_signatures, sig)
+        telemetry_lib.emit("compile", **rec)
+        if self._anatomy is not None:
+            self._anatomy.note_compile(compile_s)
+        return rec
+
+    def _compile(self, key: Any, args: tuple):
+        """Lower + compile one signature, inside a ``compile`` phase span
+        (goodput accounts the stall even mid-traffic)."""
+        sig, sig_hash, nleaves = self._reported_sig(key)
+        with telemetry_lib.phase("compile", fn=self.name):
+            t0 = self._clock()
+            try:
+                compiled = self._jitted.lower(*args).compile()
+            except Exception as e:  # noqa: BLE001 — AOT unsupported here:
+                # degrade to plain jit dispatch, permanently for this
+                # wrapper (re-probing every call would re-pay the failure)
+                logger.warning("%s: AOT lower/compile unavailable (%s: %s) "
+                               "— compile ledger degrades to jit-cache "
+                               "detection", self.name, type(e).__name__, e)
+                self._aot = False
+                return None
+            compile_s = self._clock() - t0
+        self._record_compile(sig, sig_hash, nleaves, compile_s,
+                             compiled=compiled)
+        with self._lock:
+            self._compiled[key] = compiled
+        return compiled
+
+    def prepare(self, *args) -> dict | None:
+        """Compile for ``args``' signature without executing (returns the
+        ledger record, or the existing one). Benches and
+        ``Trainer.compiled_cost`` use this so "get the FLOPs" and "warm the
+        executable" are ONE compile, not two."""
+        if not self._aot:
+            return self.records[-1] if self.records else None
+        key = self._dispatch_key(args)
+        with self._lock:
+            have = key in self._compiled
+        if not have:
+            self._compile(key, args)
+        sig_hash = self._reported_sig(key)[1]
+        for rec in reversed(self.records):
+            if rec["sig_hash"] == sig_hash:
+                return rec
+        return None
+
+    # -- dispatch -------------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        if kwargs or not self._aot:
+            return self._fallback_call(args, kwargs)
+        try:
+            key = self._dispatch_key(args)
+            compiled = self._compiled.get(key)
+        except Exception:  # unhashable/exotic args: let jit handle them
+            return self._fallback_call(args, kwargs)
+        if compiled is None:
+            compiled = self._compile(key, args)
+            if compiled is None:  # degraded mid-flight
+                return self._fallback_call(args, kwargs)
+        t0 = self._clock()
+        try:
+            out = compiled(*args)
+        except (TypeError, ValueError) as e:
+            # the typed AOT mismatch errors ("compiled for different
+            # types/shardings") mean our key missed a compile-relevant
+            # property (weak types, committedness): degrade, don't die.
+            # Anything else is a real runtime error — re-raise.
+            if "compiled" not in str(e):
+                raise
+            logger.warning("%s: AOT dispatch rejected a call (%s) — "
+                           "degrading to jit dispatch", self.name, e)
+            self._aot = False
+            return self._fallback_call(args, kwargs)
+        if self._anatomy is not None:
+            self._anatomy.note_dispatch(self._clock() - t0)
+        return out
+
+    def _fallback_call(self, args: tuple, kwargs: dict):
+        """Plain jit dispatch with jit-cache-growth compile detection: the
+        ledger stays populated (signature, timed first call) minus the cost
+        analysis an AOT executable would carry."""
+        pre = None
+        try:
+            pre = int(self._jitted._cache_size())
+        except Exception:
+            pass
+        t0 = self._clock()
+        out = self._jitted(*args, **kwargs)
+        dt = self._clock() - t0
+        grew = False
+        if pre is not None:
+            try:
+                grew = int(self._jitted._cache_size()) > pre
+            except Exception:
+                pass
+        if grew:
+            try:
+                sig, sig_hash, nleaves = self._reported_sig(
+                    self._dispatch_key(args))
+            except Exception:
+                sig, sig_hash, nleaves = "?", "?", 0
+            # the first call's wall-clock IS the compile span (trace +
+            # XLA; the step's own execute is a rounding error next to it).
+            # An end-only phase record reconstructs the interval for
+            # goodput (t0 = ts - dur_s) without a retroactive begin.
+            telemetry_lib.emit("phase", name="compile", edge="end",
+                               dur_s=dt, fn=self.name)
+            self._record_compile(sig, sig_hash, nleaves, dt)
+        elif self._anatomy is not None:
+            self._anatomy.note_dispatch(dt)
+        return out
+
+    # -- summaries ------------------------------------------------------------
+
+    def compile_summary(self) -> dict[str, Any]:
+        """The wrapper-lifetime rollup bench records per arm."""
+        with self._lock:
+            recs = list(self.records)
+        return {
+            "compiles": len(recs),
+            "distinct_signatures": len({r["sig_hash"] for r in recs}),
+            "flagged_recompiles": sum(bool(r["recompile"]) for r in recs),
+            "total_compile_s": round(sum(r["compile_s"] for r in recs), 6),
+            "flops_per_step": self.flops_per_step,
+            "bytes_per_step": self.bytes_per_step,
+            "aot": self._aot,
+        }
+
+
+def instrument(jitted: Callable, *, name: str,
+               expected_signatures: int = 1) -> InstrumentedFunction:
+    """Wrap a jitted callable in the compile ledger (see
+    :class:`InstrumentedFunction`). Idempotent on already-wrapped inputs."""
+    if isinstance(jitted, InstrumentedFunction):
+        return jitted
+    return InstrumentedFunction(jitted, name=name,
+                                expected_signatures=expected_signatures)
+
+
+# -- step anatomy -------------------------------------------------------------
+
+
+class StepAnatomy:
+    """Per-lap wall-clock split: device / host / input-wait / compile.
+
+    The instrumented step reports each dispatch's duration
+    (:meth:`note_dispatch`) and each in-lap compile (:meth:`note_compile`);
+    the trainer wraps the lap-boundary ``device_get`` in :meth:`drain` and
+    closes the lap with :meth:`lap`. Attribution model (async dispatch):
+
+    - ``device_s`` = dispatch + drain — the host time *surrendered to the
+      device*: enqueue cost plus the boundary block where the host stood
+      waiting for the step's results. On an async backend this is the
+      honest wall-clock the device cost the loop (overlapped device work
+      the host never waited on costs nothing, correctly).
+    - ``host_s`` — the measured residual of the lap's own wall: python
+      bookkeeping, host→device transfer, checkpoint/eval work inside the
+      lap.
+    - input-wait stays the starvation probe's number (it rides the same
+      ``step_metrics`` record) and is subtracted from the residual here.
+    - ``compile_in_lap_s`` — ledger compiles that landed inside the lap,
+      kept out of all three buckets (they are their own goodput category).
+
+    The four components tile the lap by construction; the CI smoke checks
+    them against the *independently measured* ``Meter`` lap time (two
+    different clock paths must agree within 5%).
+    """
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._lap_t0 = clock()
+        self._dispatch_s = 0.0
+        self._drain_s = 0.0
+        self._compile_s = 0.0
+        self._dispatches = 0
+
+    def reset(self) -> None:
+        """Restart the current lap's clock and counters — called at the
+        same instant the Meter starts, so the two independently measured
+        walls cover the same window (the CI smoke pins them within 5%)."""
+        with self._lock:
+            self._lap_t0 = self._clock()
+            self._dispatch_s = self._drain_s = self._compile_s = 0.0
+            self._dispatches = 0
+
+    def note_dispatch(self, dt: float) -> None:
+        with self._lock:
+            self._dispatch_s += dt
+            self._dispatches += 1
+
+    def note_compile(self, dt: float) -> None:
+        with self._lock:
+            self._compile_s += dt
+
+    @contextlib.contextmanager
+    def drain(self):
+        """Time the lap-boundary device sync (the metrics ``device_get``)."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._drain_s += self._clock() - t0
+
+    def now(self) -> float:
+        """The anatomy clock (pass to :meth:`lap` as its close timestamp
+        when work — e.g. the starvation-probe snapshot — must run between
+        the true lap boundary and the lap() call)."""
+        return self._clock()
+
+    def lap(self, *, steps: int, input_wait_s: float = 0.0,
+            flops_per_step: float | None = None,
+            num_chips: int = 1, now: float | None = None) -> dict[str, Any]:
+        """Close the current lap; returns the gauge dict the trainer merges
+        into the lap's ``step_metrics`` record. ``now`` pins the lap's
+        close timestamp to the true sync boundary (default: the call)."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            wall = max(0.0, now - self._lap_t0)
+            dispatch, drain = self._dispatch_s, self._drain_s
+            compile_s, dispatches = self._compile_s, self._dispatches
+            self._lap_t0 = now
+            self._dispatch_s = self._drain_s = self._compile_s = 0.0
+            self._dispatches = 0
+        device = dispatch + drain
+        host = max(0.0, wall - device - compile_s - float(input_wait_s or 0.0))
+        rec: dict[str, Any] = {
+            "anatomy_wall_s": round(wall, 6),
+            "device_s": round(device, 6),
+            "device_dispatch_s": round(dispatch, 6),
+            "device_drain_s": round(drain, 6),
+            "host_s": round(host, 6),
+            "compile_in_lap_s": round(compile_s, 6),
+            "device_dispatches": dispatches,
+            "num_chips": int(num_chips),
+        }
+        peak, source = resolve_peak_flops()
+        rec["peak_flops_per_chip"] = peak
+        rec["peak_source"] = source
+        if flops_per_step:
+            rec["flops_per_step"] = float(flops_per_step)
+            if peak and wall > 0 and steps > 0:
+                per_chip = flops_per_step * steps / wall / max(1, num_chips)
+                rec["mfu"] = round(per_chip / peak, 6)
+                if device > 0:
+                    rec["mfu_device"] = round(
+                        flops_per_step * steps / device / max(1, num_chips)
+                        / peak, 6)
+        return rec
+
+
+# -- HBM watermarks -----------------------------------------------------------
+
+
+def memory_watermarks() -> dict[str, Any]:
+    """Device memory gauges for one ``memory`` event.
+
+    Uses each local device's ``memory_stats()`` where the backend exposes
+    it (TPU/GPU: ``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``
+    — aggregated as max in-use / max peak / min limit, the conservative
+    per-chip view); falls back to the live-buffer byte total
+    (``jax.live_arrays()``) on backends without allocator stats (CPU), so
+    the watermark trendline exists everywhere even if its ceiling doesn't.
+    """
+    import jax
+
+    devs = jax.local_devices()
+    in_use: list[int] = []
+    peaks: list[int] = []
+    limits: list[int] = []
+    for d in devs:
+        try:
+            s = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — stats are best-effort gauges
+            s = {}
+        if s.get("bytes_in_use") is not None:
+            in_use.append(int(s["bytes_in_use"]))
+        if s.get("peak_bytes_in_use") is not None:
+            peaks.append(int(s["peak_bytes_in_use"]))
+        if s.get("bytes_limit") is not None:
+            limits.append(int(s["bytes_limit"]))
+    if in_use:
+        rec: dict[str, Any] = {"source": "memory_stats",
+                               "devices": len(devs),
+                               "bytes_in_use_max": max(in_use)}
+        if peaks:
+            rec["peak_bytes_in_use_max"] = max(peaks)
+        if limits:
+            rec["bytes_limit_min"] = min(limits)
+            rec["headroom_bytes"] = min(limits) - max(peaks or in_use)
+        return rec
+    try:
+        live = sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+    except Exception:  # noqa: BLE001
+        live = 0
+    return {"source": "live-buffers", "devices": len(devs),
+            "live_bytes": int(live)}
+
+
+# -- reader (jax-free fold for dlstatus --anatomy) ----------------------------
+
+
+def _steps_fold(laps: list[dict]) -> dict[str, Any]:
+    out = {"laps": len(laps),
+           "steps": sum(int(e.get("steps", 0) or 0) for e in laps)}
+    for key, src in (("wall_s", "anatomy_wall_s"), ("device_s", "device_s"),
+                     ("device_dispatch_s", "device_dispatch_s"),
+                     ("device_drain_s", "device_drain_s"),
+                     ("host_s", "host_s"), ("compile_s", "compile_in_lap_s"),
+                     ("input_wait_s", "input_wait_s")):
+        out[key] = round(sum(float(e.get(src, 0.0) or 0.0) for e in laps), 6)
+    wall = out["wall_s"]
+    covered = (out["device_s"] + out["host_s"] + out["compile_s"]
+               + out["input_wait_s"])
+    out["coverage"] = round(covered / wall, 4) if wall > 0 else None
+    out["fractions"] = {
+        k: (round(out[f"{k}_s"] / wall, 4) if wall > 0 else None)
+        for k in ("device", "host", "compile", "input_wait")}
+    return out
+
+
+def _mfu_fold(laps: list[dict]) -> dict[str, Any]:
+    peak = source = chips = None
+    for e in reversed(laps):
+        if e.get("peak_flops_per_chip"):
+            peak = float(e["peak_flops_per_chip"])
+            source = e.get("peak_source")
+            chips = int(e.get("num_chips", 1) or 1)
+            break
+    flops_laps = [e for e in laps
+                  if e.get("flops_per_step") and e.get("steps")]
+    total_flops = sum(float(e["flops_per_step"]) * int(e["steps"])
+                      for e in flops_laps)
+    total_wall = sum(float(e.get("anatomy_wall_s", 0.0) or 0.0)
+                     for e in flops_laps)
+    mfu = None
+    if peak and chips and total_flops > 0 and total_wall > 0:
+        mfu = round(total_flops / total_wall / chips / peak, 6)
+    last = next((e.get("mfu") for e in reversed(laps)
+                 if e.get("mfu") is not None), None)
+    newest_flops = next((float(e["flops_per_step"]) for e in reversed(laps)
+                         if e.get("flops_per_step")), None)
+    return {"mfu": mfu, "mfu_last_lap": last,
+            "flops_per_step": newest_flops,
+            "peak_flops_per_chip": peak, "peak_source": source,
+            "num_chips": chips}
+
+
+def _memory_fold(mems: list[dict]) -> dict[str, Any] | None:
+    if not mems:
+        return None
+    newest_by_proc: dict[Any, dict] = {}
+    for e in mems:
+        newest_by_proc[e.get("process")] = e
+    rows = list(newest_by_proc.values())
+    stats = [e for e in rows if e.get("source") == "memory_stats"]
+    if stats:
+        in_use = max(int(e.get("bytes_in_use_max", 0) or 0) for e in stats)
+        peaks = [int(e["peak_bytes_in_use_max"]) for e in stats
+                 if e.get("peak_bytes_in_use_max") is not None]
+        limits = [int(e["bytes_limit_min"]) for e in stats
+                  if e.get("bytes_limit_min") is not None]
+        out: dict[str, Any] = {"source": "memory_stats",
+                               "bytes_in_use_max": in_use}
+        if peaks:
+            out["peak_bytes_in_use_max"] = max(peaks)
+        if limits:
+            out["bytes_limit_min"] = min(limits)
+            out["headroom_bytes"] = min(limits) - max(peaks or [in_use])
+        return out
+    live = max(int(e.get("live_bytes", 0) or 0) for e in rows)
+    return {"source": "live-buffers", "live_bytes": live}
+
+
+def anatomy_report(events: Iterable[dict]) -> dict[str, Any] | None:
+    """Fold a stream into the ``dlstatus --anatomy`` report (jax-free).
+
+    None when the run carries no anatomy evidence (no ``compile`` /
+    ``memory`` events and no anatomy-stamped ``step_metrics``)."""
+    events = list(events)
+    compiles = [e for e in events if e.get("kind") == "compile"]
+    laps = [e for e in events if e.get("kind") == "step_metrics"
+            and e.get("anatomy_wall_s") is not None]
+    mems = [e for e in events if e.get("kind") == "memory"]
+    if not (compiles or laps or mems):
+        return None
+
+    flagged = [e for e in compiles if e.get("recompile")]
+    sig_seen: dict[tuple, int] = {}
+    for e in compiles:
+        k = (e.get("fn"), e.get("sig_hash"))
+        sig_seen[k] = sig_seen.get(k, 0) + 1
+    duplicates = sum(1 for n in sig_seen.values() if n > 1)
+    by_fn: dict[str, dict] = {}
+    for e in compiles:
+        fn = str(e.get("fn"))
+        row = by_fn.setdefault(fn, {
+            "compiles": 0, "signatures": set(), "flagged_recompiles": 0,
+            "compile_s": 0.0, "flops": None, "bytes_accessed": None})
+        row["compiles"] += 1
+        row["signatures"].add(e.get("sig_hash"))
+        row["flagged_recompiles"] += bool(e.get("recompile"))
+        row["compile_s"] += float(e.get("compile_s", 0.0) or 0.0)
+        if e.get("flops"):
+            row["flops"] = float(e["flops"])
+        if e.get("bytes_accessed"):
+            row["bytes_accessed"] = float(e["bytes_accessed"])
+    for row in by_fn.values():
+        row["signatures"] = len(row["signatures"])
+        row["compile_s"] = round(row["compile_s"], 6)
+    ledger = {
+        "compiles": len(compiles),
+        "distinct_signatures": len(sig_seen),
+        "flagged_recompiles": len(flagged),
+        "duplicate_signatures": duplicates,
+        "total_compile_s": round(
+            sum(float(e.get("compile_s", 0.0) or 0.0) for e in compiles), 6),
+        "by_fn": by_fn,
+        # newest-N only: a recompile STORM — the very case this report
+        # diagnoses — produces one event per step for hours, and a
+        # --watch tick must not serialize megabytes of them (the by_fn
+        # rollup and the counters above carry the totals)
+        "events": [
+            {k: e.get(k) for k in
+             ("ts", "process", "fn", "sig", "sig_hash", "compile_s",
+              "flops", "bytes_accessed", "recompile", "aot")}
+            for e in compiles[-MAX_LEDGER_EVENTS_REPORTED:]],
+        "events_omitted": max(0, len(compiles) - MAX_LEDGER_EVENTS_REPORTED),
+    }
+
+    per_process: dict[str, dict] = {}
+    for e in laps:
+        per_process.setdefault(str(e.get("process")), []).append(e)
+    steps = _steps_fold(laps) if laps else None
+    mfu = _mfu_fold(laps) if laps else None
+
+    if flagged:
+        worst = flagged[-1]
+        recompile_verdict = (
+            f"RECOMPILES — {len(flagged)} flagged compile(s) (e.g. "
+            f"{worst.get('fn')} {worst.get('sig')}): the compile set is "
+            f"not pinned; expect multi-second stalls mid-run")
+    elif compiles:
+        recompile_verdict = "OK — every signature compiled exactly once"
+        if duplicates:
+            recompile_verdict = (
+                f"OK within each process; {duplicates} signature(s) "
+                f"re-paid across attempts/processes (restarts re-pay jit "
+                f"— see compile_s in goodput)")
+    else:
+        recompile_verdict = "no compiles recorded"
+
+    bound_verdict = None
+    if steps and steps["wall_s"] > 0:
+        fr = steps["fractions"]
+        ranked = sorted(
+            ((fr.get(k) or 0.0), k)
+            for k in ("device", "host", "input_wait", "compile"))
+        top_frac, top = ranked[-1]
+        label = {"device": "device-bound", "host": "host-bound",
+                 "input_wait": "input-bound", "compile": "compile-bound"}[top]
+        bound_verdict = (f"{label} — {100.0 * top_frac:.0f}% of lap "
+                         f"wall-clock in {top.replace('_', '-')}")
+
+    return {
+        "compile_ledger": ledger,
+        "steps": steps,
+        "mfu": mfu,
+        "memory": _memory_fold(mems),
+        "per_process": {p: _steps_fold(ls)
+                        for p, ls in sorted(per_process.items())},
+        "verdicts": {"recompile": recompile_verdict, "bound": bound_verdict},
+    }

@@ -339,9 +339,13 @@ def chrome_trace(events: Iterable[dict]) -> dict[str, Any]:
     train = spans_from_phases(events)
     all_spans = ([("serve", s) for s in serve]
                  + [("train", s) for s in train])
-    if not all_spans:
+    # memory watermark samples (telemetry/anatomy.py) become a counter
+    # track per process — the HBM trendline next to the span timeline
+    mems = [e for e in events if e.get("kind") == "memory"]
+    if not all_spans and not mems:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
-    epoch = min(float(s["t0"]) for _, s in all_spans)
+    epoch = min([float(s["t0"]) for _, s in all_spans]
+                + [float(e["ts"]) for e in mems])
 
     pids: dict[str, int] = {}
     tids: dict[tuple[int, str], int] = {}
@@ -382,4 +386,15 @@ def chrome_trace(events: Iterable[dict]) -> dict[str, Any]:
             trace_events.append({
                 **base, "ph": "X",
                 "dur": max(0.0, float(s["t1"]) - float(s["t0"])) * 1e6})
+    _MEM_GAUGES = ("bytes_in_use_max", "peak_bytes_in_use_max",
+                   "live_bytes")
+    for e in mems:
+        gauges = {k: int(e[k]) for k in _MEM_GAUGES
+                  if e.get(k) is not None}
+        if not gauges:
+            continue
+        trace_events.append({
+            "name": "memory", "cat": "memory", "ph": "C",
+            "pid": pid_of(str(e.get("process") or "?")), "tid": 0,
+            "ts": (float(e["ts"]) - epoch) * 1e6, "args": gauges})
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}

@@ -29,6 +29,7 @@ from distributeddeeplearningspark_tpu.data.prefetch import (
 )
 from distributeddeeplearningspark_tpu import faults
 from distributeddeeplearningspark_tpu import telemetry as telemetry_lib
+from distributeddeeplearningspark_tpu.telemetry import anatomy as anatomy_lib
 from distributeddeeplearningspark_tpu.metrics import (
     Meter,
     MetricLogger,
@@ -179,9 +180,17 @@ class Trainer:
                 accum_steps=self.accum_steps, trainable=self.trainable,
                 guard_nonfinite=self._guard_nonfinite,
             )
-        self._train_step = step_lib.jit_train_step(
-            train, self.mesh, self.state_shardings,
-            seq_sharded=self.context_parallel,
+        # the compile ledger owns the lower→compile path: every executable
+        # this step ever builds becomes a timed, cost-analyzed `compile`
+        # telemetry event, and a second signature through a shape-stable
+        # train step (expected_signatures=1) flags as a recompile
+        # (docs/OBSERVABILITY.md "Device anatomy")
+        self._train_step = anatomy_lib.instrument(
+            step_lib.jit_train_step(
+                train, self.mesh, self.state_shardings,
+                seq_sharded=self.context_parallel,
+            ),
+            name="train_step",
         )
 
     def _apply_fn(self):
@@ -456,6 +465,13 @@ class Trainer:
         # workdir is resolvable — then fit costs nothing extra.
         tele = self._telemetry()
         probe = StarvationProbe() if tele is not None else None
+        # per-lap device/host/input anatomy (docs/OBSERVABILITY.md "Device
+        # anatomy"): the instrumented step reports each dispatch's and
+        # compile's duration into it, the lap-boundary device_get drains
+        # into it, and the closed lap's split rides the step_metrics record
+        anat = anatomy_lib.StepAnatomy() if tele is not None else None
+        if isinstance(self._train_step, anatomy_lib.InstrumentedFunction):
+            self._train_step.attach_anatomy(anat)
 
         def tele_phase(name: str):
             return (tele.phase(name) if tele is not None
@@ -485,6 +501,10 @@ class Trainer:
         )
         flops_pending = measure_flops
         meter.start()
+        if anat is not None:
+            # start the anatomy lap clock at the SAME instant as the meter:
+            # the two walls are measured independently and must agree
+            anat.reset()
 
         lap_start = step_i
         last_metrics: dict[str, float] = {}
@@ -509,7 +529,6 @@ class Trainer:
         # run inherits the previous run's offset (skip beyond state.step IS
         # that drift) so re-checkpointing doesn't quietly drop it.
         rolled_back_batches = max(0, skip - step_i)
-        first_dispatch = True
         try:
             for batch in self._feed(dataset, batch_size, skip_batches=skip,
                                     probe=probe):
@@ -538,13 +557,11 @@ class Trainer:
                 profiler.observe(step_i)
                 with profiling.step_annotation(step_i) if profile is not None \
                         else contextlib.nullcontext():
-                    # the first call traces + XLA-compiles before dispatch
-                    # returns, so timing it IS the compile span (the step's
-                    # own device time is a rounding error next to it)
-                    with (tele_phase("compile") if first_dispatch
-                          else contextlib.nullcontext()):
-                        self.state, metrics = self._train_step(self.state, batch)
-                    first_dispatch = False
+                    # compiles (the first dispatch AND any mid-run shape
+                    # change) are spanned, timed, and cost-analyzed by the
+                    # instrumented step itself (telemetry/anatomy.py), so
+                    # no first-dispatch phase wrap is needed here
+                    self.state, metrics = self._train_step(self.state, batch)
                 metrics = dict(metrics)
                 metrics.pop("weight", None)  # eval-aggregation detail, not a log line
                 step_i += 1
@@ -554,18 +571,47 @@ class Trainer:
                     s = metrics["skipped"]
                     skipped_dev = s if skipped_dev is None else skipped_dev + s
                 if step_i % log_every == 0 or (steps is not None and step_i >= steps):
+                    if (meter.flops_per_step is None
+                            and getattr(self._train_step, "flops_per_step",
+                                        None)):
+                        # the ledger already cost-analyzed the compiled step,
+                        # so MFU comes free — no measure_flops double compile
+                        meter.set_flops(self._train_step.flops_per_step)
                     # device_get blocks until this step's metrics exist, so the
                     # lap boundary is a true device-sync point — timing is honest.
-                    last_metrics = meter.lap(step_i - lap_start, jax.device_get(metrics))
+                    with (anat.drain() if anat is not None
+                          else contextlib.nullcontext()):
+                        fetched = jax.device_get(metrics)
+                    last_metrics = meter.lap(step_i - lap_start, fetched)
                     lap_start = step_i
+                    # close the anatomy lap at the SAME sync point the
+                    # meter lapped at — the log rendering below belongs to
+                    # the next lap on both clocks, or the two walls drift
+                    snap: dict = {}
+                    anat_rec: dict = {}
+                    lap_s, lap_n = meter.last_lap or (0.0, 0)
+                    if tele is not None:
+                        lap_close = anat.now() if anat is not None else None
+                        snap = probe.snapshot() if probe is not None else {}
+                        if anat is not None:
+                            anat_rec = anat.lap(
+                                steps=lap_n,
+                                input_wait_s=float(
+                                    snap.get("input_wait_s", 0.0) or 0.0),
+                                flops_per_step=getattr(
+                                    self._train_step, "flops_per_step",
+                                    None),
+                                num_chips=self.mesh.devices.size,
+                                now=lap_close,
+                            )
                     mlog.log(step_i, {**last_metrics, **meter.summary()})
                     _touch_heartbeat()
                     if tele is not None:
-                        lap_s, lap_n = meter.last_lap or (0.0, 0)
                         tele.step_metrics(
                             step_i, steps=lap_n, lap_s=lap_s,
-                            metrics=last_metrics,
-                            **(probe.snapshot() if probe is not None else {}))
+                            metrics=last_metrics, **snap, **anat_rec)
+                        tele.emit("memory",
+                                  **anatomy_lib.memory_watermarks())
                         tele.heartbeat(step=step_i)
                         if comms_probe:
                             collectives.barrier_probe(self.mesh)
@@ -669,6 +715,10 @@ class Trainer:
             # flush the trace and tensorboard even when a step/sanitizer blows
             # up mid-window — a crashed run's trace is the one you want most
             profiler.stop()
+            if isinstance(self._train_step, anatomy_lib.InstrumentedFunction):
+                # detach so a later fit() on this trainer gets a fresh lap
+                # accumulator, not this run's dangling one
+                self._train_step.attach_anatomy(None)
             if tele is not None:
                 # close the run span on every exit the interpreter survives;
                 # a SIGKILL'd run leaves the stream open-ended, which is the
@@ -805,8 +855,17 @@ class Trainer:
                     yield row_out
 
     def compiled_cost(self, batch: dict[str, Any]) -> float | None:
-        """FLOPs per step from XLA cost analysis (for MFU reporting)."""
+        """FLOPs per step from XLA cost analysis (for MFU reporting).
+
+        Routed through the compile ledger when the train step is
+        instrumented: "get the FLOPs" and "warm the executable" are then
+        ONE compile (the old path lower+compiled a throwaway twin of the
+        program the first dispatch would compile again)."""
         assert self._train_step is not None and self.state is not None
+        if isinstance(self._train_step, anatomy_lib.InstrumentedFunction):
+            self._train_step.prepare(self.state, batch)
+            if self._train_step.flops_per_step is not None:
+                return self._train_step.flops_per_step
         lowered = self._train_step.lower(self.state, batch)
         return compiled_flops_per_step(lowered.compile())
 

@@ -1,0 +1,506 @@
+"""Device-side performance observatory (ISSUE 10): compile ledger,
+step anatomy, MFU arithmetic, memory watermarks, `dlstatus --anatomy`,
+and the tools/perf_guard.py regression sentinel."""
+
+import importlib.util
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu import status, telemetry
+from distributeddeeplearningspark_tpu.telemetry import anatomy
+
+
+def _load_perf_guard():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "perf_guard.py")
+    spec = importlib.util.spec_from_file_location("perf_guard", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def workdir(tmp_path):
+    """Bind the process-global telemetry writer to a temp workdir and
+    always unbind after (the ledger emits through the global writer)."""
+    telemetry.configure(tmp_path)
+    yield str(tmp_path)
+    telemetry.reset()
+
+
+# -- compile ledger -----------------------------------------------------------
+
+
+def test_compile_event_schema_and_phase_span(workdir):
+    fn = anatomy.instrument(jax.jit(lambda x: x * 2 + 1), name="double")
+    out = fn(jnp.ones((4, 4), jnp.float32))
+    assert np.allclose(np.asarray(out), 3.0)
+    events = telemetry.read_events(workdir)
+    comp = [e for e in events if e["kind"] == "compile"]
+    assert len(comp) == 1
+    e = comp[0]
+    assert e["fn"] == "double"
+    assert "f32[4,4]" in e["sig"]
+    assert isinstance(e["sig_hash"], str) and len(e["sig_hash"]) == 16
+    assert e["compile_s"] > 0
+    assert e["flops"] and e["flops"] > 0          # cost analysis rode along
+    assert e["bytes_accessed"] and e["bytes_accessed"] > 0
+    assert e["recompile"] is False and e["aot"] is True
+    assert e["sig_compiles"] == 1 and e["distinct_signatures"] == 1
+    # the compile is ALSO a phase span, so goodput accounts the stall
+    phases = [p for p in events
+              if p["kind"] == "phase" and p.get("name") == "compile"]
+    assert any(p.get("edge") == "begin" for p in phases)
+    assert any(p.get("edge") == "end" for p in phases)
+    assert telemetry.goodput(events)["compile_s"] >= 0.0
+    # same signature again: dict hit, no new compile, same result
+    fn(jnp.ones((4, 4), jnp.float32))
+    comp2 = [e for e in telemetry.read_events(workdir)
+             if e["kind"] == "compile"]
+    assert len(comp2) == 1
+    assert fn._cache_size() == 1
+
+
+def test_second_shape_flags_exactly_one_recompile(workdir):
+    """A shape-stable step (expected_signatures=1) forced through a second
+    shape flags EXACTLY one recompile — the acceptance drill."""
+    fn = anatomy.instrument(jax.jit(lambda x: x + 1), name="step")
+    fn(jnp.ones((8,)))
+    fn(jnp.ones((16,)))          # the forced second shape
+    fn(jnp.ones((16,)))          # reuse: no further compile
+    comp = [e for e in telemetry.read_events(workdir)
+            if e["kind"] == "compile"]
+    assert len(comp) == 2
+    assert [e["recompile"] for e in comp] == [False, True]
+    assert fn.compile_summary()["flagged_recompiles"] == 1
+    rep = anatomy.anatomy_report(telemetry.read_events(workdir))
+    assert rep["compile_ledger"]["flagged_recompiles"] == 1
+    assert rep["verdicts"]["recompile"].startswith("RECOMPILES")
+
+
+def test_expected_signatures_pins_a_bucket_ladder(workdir):
+    """The serve-engine discipline: a pinned ladder of N shapes is clean;
+    shape N+1 flags."""
+    fn = anatomy.instrument(jax.jit(lambda x: x.sum()), name="fwd",
+                            expected_signatures=2)
+    fn(jnp.ones((2,)))
+    fn(jnp.ones((4,)))
+    comp = [e for e in telemetry.read_events(workdir)
+            if e["kind"] == "compile"]
+    assert [e["recompile"] for e in comp] == [False, False]
+    fn(jnp.ones((8,)))           # beyond the pinned ladder
+    comp = [e for e in telemetry.read_events(workdir)
+            if e["kind"] == "compile"]
+    assert [e["recompile"] for e in comp] == [False, False, True]
+
+
+def test_dtype_change_is_a_new_signature(workdir):
+    fn = anatomy.instrument(jax.jit(lambda x: x * 1), name="cast")
+    fn(jnp.ones((4,), jnp.float32))
+    fn(jnp.ones((4,), jnp.int32))
+    comp = [e for e in telemetry.read_events(workdir)
+            if e["kind"] == "compile"]
+    assert len(comp) == 2
+    assert comp[0]["sig_hash"] != comp[1]["sig_hash"]
+
+
+def test_prepare_compiles_once_and_reports_flops(workdir):
+    fn = anatomy.instrument(jax.jit(lambda a, b: a @ b), name="mm")
+    a = jnp.ones((8, 8))
+    rec = fn.prepare(a, a)
+    assert rec["flops"] == pytest.approx(2 * 8 * 8 * 8, rel=0.5)
+    assert fn.flops_per_step == rec["flops"]
+    fn(a, a)  # dispatches on the prepared executable — no second compile
+    comp = [e for e in telemetry.read_events(workdir)
+            if e["kind"] == "compile"]
+    assert len(comp) == 1
+
+
+def test_instrument_is_idempotent_and_exposes_lower():
+    fn = anatomy.instrument(jax.jit(lambda x: x), name="id")
+    assert anatomy.instrument(fn, name="other") is fn
+    lowered = fn.lower(jnp.ones((2,)))
+    assert lowered.compile() is not None
+
+
+def test_donated_state_dispatch(workdir):
+    """The trainer shape: donated arg 0, repeated dispatch on the same
+    executable (the donation chain must survive AOT dispatch)."""
+    step = anatomy.instrument(
+        jax.jit(lambda s, x: (s + x, (s * x).sum()), donate_argnums=(0,)),
+        name="train_step")
+    s = jnp.zeros((16,))
+    x = jnp.ones((16,))
+    for i in range(3):
+        s, m = step(s, x)
+    assert float(s[0]) == 3.0
+    comp = [e for e in telemetry.read_events(workdir)
+            if e["kind"] == "compile"]
+    assert len(comp) == 1 and comp[0]["recompile"] is False
+
+
+# -- step anatomy / MFU arithmetic -------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_step_anatomy_split_and_mfu_arithmetic(monkeypatch):
+    """Hand-computed case: 10 steps of 2e9 FLOPs over a 4-chip mesh in a
+    10s lap with a 1e9 FLOPs/s/chip peak → MFU = 2e9*10/10/4/1e9 = 0.5.
+    The split must tile the lap: device 6s (4 dispatch + 2 drain), compile
+    1s, input 0.5s, host = the 2.5s residual."""
+    monkeypatch.setenv(anatomy.PEAK_FLOPS_ENV, "1e9")
+    clock = FakeClock()
+    anat = anatomy.StepAnatomy(clock=clock)
+    anat.reset()
+    anat.note_compile(1.0)
+    anat.note_dispatch(4.0)
+    clock.t = 8.0
+    with anat.drain():
+        clock.t = 10.0
+    rec = anat.lap(steps=10, input_wait_s=0.5, flops_per_step=2e9,
+                   num_chips=4)
+    assert rec["anatomy_wall_s"] == 10.0
+    assert rec["device_s"] == 6.0
+    assert rec["device_dispatch_s"] == 4.0
+    assert rec["device_drain_s"] == 2.0
+    assert rec["compile_in_lap_s"] == 1.0
+    assert rec["host_s"] == pytest.approx(2.5)
+    assert rec["mfu"] == pytest.approx(0.5)
+    assert rec["mfu_device"] == pytest.approx(2e9 * 10 / 6.0 / 4 / 1e9)
+    assert rec["peak_flops_per_chip"] == 1e9
+    assert rec["peak_source"] == anatomy.PEAK_FLOPS_ENV
+    # lap() reset: a second, empty lap is all host
+    clock.t = 12.0
+    rec2 = anat.lap(steps=0)
+    assert rec2["anatomy_wall_s"] == 2.0
+    assert rec2["device_s"] == 0.0 and rec2["host_s"] == 2.0
+    assert "mfu" not in rec2
+
+
+def test_resolve_peak_flops_order(monkeypatch):
+    monkeypatch.setenv(anatomy.PEAK_FLOPS_ENV, "123.5")
+    peak, source = anatomy.resolve_peak_flops()
+    assert peak == 123.5 and source == anatomy.PEAK_FLOPS_ENV
+    monkeypatch.delenv(anatomy.PEAK_FLOPS_ENV)
+    peak, source = anatomy.resolve_peak_flops()
+    # the suite runs on the CPU backend: the labeled nominal fallback
+    assert peak and peak > 0 and source.startswith("nominal-cpu")
+    monkeypatch.setenv(anatomy.PEAK_FLOPS_ENV, "not-a-number")
+    peak2, _ = anatomy.resolve_peak_flops()
+    assert peak2 == peak  # malformed override ignored, not fatal
+
+
+# -- memory watermarks --------------------------------------------------------
+
+
+def test_memory_watermarks_cpu_fallback():
+    """This backend exposes no allocator stats → the live-buffer path."""
+    keep = jnp.ones((1024,), jnp.float32)  # noqa: F841 — held live
+    rec = anatomy.memory_watermarks()
+    assert rec["source"] == "live-buffers"
+    assert rec["devices"] >= 1
+    assert rec["live_bytes"] >= keep.nbytes
+
+
+def test_memory_fold_prefers_stats_and_computes_headroom():
+    events = [
+        {"ts": 1.0, "kind": "memory", "process": "p0",
+         "source": "memory_stats", "bytes_in_use_max": 100,
+         "peak_bytes_in_use_max": 150, "bytes_limit_min": 1000,
+         "headroom_bytes": 850},
+        {"ts": 2.0, "kind": "memory", "process": "p1",
+         "source": "memory_stats", "bytes_in_use_max": 200,
+         "peak_bytes_in_use_max": 300, "bytes_limit_min": 900,
+         "headroom_bytes": 600},
+        {"ts": 3.0, "kind": "memory", "process": "bench",
+         "source": "live-buffers", "live_bytes": 7},
+    ]
+    rep = anatomy.anatomy_report(events)
+    mem = rep["memory"]
+    assert mem["source"] == "memory_stats"
+    assert mem["bytes_in_use_max"] == 200
+    assert mem["peak_bytes_in_use_max"] == 300
+    assert mem["bytes_limit_min"] == 900
+    assert mem["headroom_bytes"] == 600
+    # live-buffer-only stream falls back
+    rep2 = anatomy.anatomy_report([events[-1]])
+    assert rep2["memory"] == {"source": "live-buffers", "live_bytes": 7}
+
+
+# -- reader fold / dlstatus ---------------------------------------------------
+
+
+def _lap_event(proc, ts, *, steps=10, wall=10.0, device=6.0, dispatch=4.0,
+               drain=2.0, host=2.5, compile_s=1.0, input_wait=0.5,
+               flops=2e9, peak=1e9, chips=4, mfu=0.5):
+    return {"ts": ts, "kind": "step_metrics", "process": proc, "step": steps,
+            "steps": steps, "lap_s": wall, "input_wait_s": input_wait,
+            "anatomy_wall_s": wall, "device_s": device,
+            "device_dispatch_s": dispatch, "device_drain_s": drain,
+            "host_s": host, "compile_in_lap_s": compile_s,
+            "num_chips": chips, "peak_flops_per_chip": peak,
+            "peak_source": "DLS_PEAK_FLOPS", "flops_per_step": flops,
+            "mfu": mfu}
+
+
+def test_anatomy_report_fold_totals_and_verdicts():
+    events = [
+        {"ts": 0.0, "kind": "compile", "process": "p0", "fn": "train_step",
+         "sig": "f32[8]", "sig_hash": "aa", "compile_s": 2.0, "flops": 2e9,
+         "bytes_accessed": 1e6, "recompile": False, "aot": True},
+        _lap_event("p0", 10.0),
+        _lap_event("p0", 20.0),
+    ]
+    rep = anatomy.anatomy_report(events)
+    st = rep["steps"]
+    assert st["laps"] == 2 and st["steps"] == 20
+    assert st["wall_s"] == 20.0 and st["device_s"] == 12.0
+    assert st["coverage"] == pytest.approx(1.0)
+    assert st["fractions"]["device"] == pytest.approx(0.6)
+    # aggregate MFU: 2e9*20 flops over 20s on 4 chips at 1e9 peak = 0.5
+    assert rep["mfu"]["mfu"] == pytest.approx(0.5)
+    assert rep["mfu"]["num_chips"] == 4
+    assert rep["verdicts"]["recompile"].startswith("OK")
+    assert rep["verdicts"]["bound"].startswith("device-bound")
+    assert rep["per_process"]["p0"]["laps"] == 2
+    # an empty stream has no report at all
+    assert anatomy.anatomy_report([{"ts": 0, "kind": "heartbeat"}]) is None
+
+
+def test_anatomy_report_cross_process_duplicates_are_not_flagged():
+    """A restart re-pays the compile of the SAME signature: reported as a
+    duplicate (restarts re-pay jit), not flagged as a recompile storm."""
+    ev = {"kind": "compile", "fn": "train_step", "sig": "f32[8]",
+          "sig_hash": "aa", "compile_s": 1.0, "recompile": False,
+          "aot": True}
+    events = [{"ts": 0.0, "process": "p0", **ev},
+              {"ts": 10.0, "process": "p0", **ev}]
+    rep = anatomy.anatomy_report(events)
+    assert rep["compile_ledger"]["flagged_recompiles"] == 0
+    assert rep["compile_ledger"]["duplicate_signatures"] == 1
+    assert "re-paid" in rep["verdicts"]["recompile"]
+
+
+def test_dlstatus_anatomy_json_schema(tmp_path, capsys):
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=FakeClock(),
+                              host=0)
+    w.emit("compile", fn="train_step", sig="f32[8]", sig_hash="ab",
+           compile_s=2.0, flops=2e9, bytes_accessed=1e6, recompile=False,
+           aot=True)
+    w.emit("step_metrics", **{k: v for k, v in
+                              _lap_event("p0", 0.0).items()
+                              if k not in ("ts", "kind", "process")})
+    w.emit("memory", source="live-buffers", devices=8, live_bytes=4096)
+    w.close()
+    rc = status.main([str(tmp_path), "--anatomy", "--json"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    an = rep["anatomy"]
+    for key in ("compile_ledger", "steps", "mfu", "memory", "per_process",
+                "verdicts"):
+        assert key in an, key
+    cl = an["compile_ledger"]
+    for key in ("compiles", "distinct_signatures", "flagged_recompiles",
+                "duplicate_signatures", "total_compile_s", "by_fn",
+                "events"):
+        assert key in cl, key
+    for key in ("laps", "steps", "wall_s", "device_s", "device_dispatch_s",
+                "device_drain_s", "host_s", "compile_s", "input_wait_s",
+                "coverage", "fractions"):
+        assert key in an["steps"], key
+    for key in ("mfu", "mfu_last_lap", "flops_per_step",
+                "peak_flops_per_chip", "peak_source", "num_chips"):
+        assert key in an["mfu"], key
+    assert an["memory"]["live_bytes"] == 4096
+    # the human rendering carries the section too
+    rc = status.main([str(tmp_path), "--anatomy"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "device anatomy:" in out and "compile ledger:" in out
+
+
+def test_dlstatus_watch_mode(tmp_path, capsys):
+    """--watch re-reads and re-renders; bounded by --watch-count for tests,
+    and an empty workdir waits instead of exiting 1."""
+    rc = status.main([str(tmp_path), "--watch", "--watch-count", "2",
+                      "--interval", "0.11"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("no telemetry events yet") == 2
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=FakeClock())
+    w.heartbeat(step=3)
+    w.close()
+    rc = status.main([str(tmp_path), "--watch", "--watch-count", "1",
+                      "--interval", "0.11", "--json"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["last_step"] == 3
+
+
+def test_chrome_trace_memory_counter_track(tmp_path):
+    from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
+
+    events = [
+        {"ts": 1.0, "kind": "phase", "process": "p0", "name": "compile",
+         "edge": "begin"},
+        {"ts": 3.0, "kind": "phase", "process": "p0", "name": "compile",
+         "edge": "end", "dur_s": 2.0},
+        {"ts": 2.0, "kind": "memory", "process": "p0",
+         "source": "live-buffers", "live_bytes": 1234},
+    ]
+    data = trace_lib.chrome_trace(events)
+    counters = [e for e in data["traceEvents"] if e.get("ph") == "C"]
+    assert len(counters) == 1
+    assert counters[0]["args"] == {"live_bytes": 1234}
+    spans = [e for e in data["traceEvents"]
+             if e.get("ph") == "X" and e["name"] == "compile"]
+    assert len(spans) == 1  # the compile phase lowered into the export
+    # memory events alone still produce a loadable trace
+    data2 = trace_lib.chrome_trace([events[-1]])
+    assert any(e.get("ph") == "C" for e in data2["traceEvents"])
+
+
+# -- serve-side compile visibility (satellite) --------------------------------
+
+
+def test_engine_warmup_emits_compile_phases(tmp_path):
+    """engine.warmup()'s bucket-ladder compiles must land as `compile`
+    phases + ledger events — warmup seconds were silently misattributed
+    before (ISSUE 10 satellite)."""
+    from distributeddeeplearningspark_tpu.serve.engine import InferenceEngine
+
+    def forward(params, batch):
+        return {"y": batch["x"] * params["w"]}
+
+    eng = InferenceEngine(forward, {"w": jnp.float32(2.0)}, max_batch=4,
+                          workdir=str(tmp_path), name="anat")
+    try:
+        n = eng.warmup({"x": np.float32(1.0)})
+        assert n == len(eng.batch_sizes)
+        events = telemetry.read_events(tmp_path)
+        comp = [e for e in events if e["kind"] == "compile"]
+        assert len(comp) == len(eng.batch_sizes)
+        assert all(e["fn"] == "serve-anat" for e in comp)
+        assert not any(e["recompile"] for e in comp)
+        phases = [e for e in events if e["kind"] == "phase"
+                  and e.get("name") == "compile" and e.get("edge") == "end"]
+        assert len(phases) == len(eng.batch_sizes)
+        # goodput now accounts the warmup stall as compile time
+        assert telemetry.goodput(events)["compile_s"] > 0
+        # the pinned-compile-set stat still reads through the wrapper
+        assert eng.stats()["compiled_batch_shapes"] == len(eng.batch_sizes)
+        # traffic through a warmed bucket adds NO compile
+        with eng:
+            eng.infer({"x": np.float32(3.0)})
+        comp2 = [e for e in telemetry.read_events(tmp_path)
+                 if e["kind"] == "compile"]
+        assert len(comp2) == len(comp)
+    finally:
+        eng.stop()
+        telemetry.reset()
+
+
+# -- perf_guard ---------------------------------------------------------------
+
+
+def _bench_record(value, *, metric="resnet50_images_per_sec_per_chip",
+                  backend="tpu", step_time_ms=None, mfu=None,
+                  compile_s=None, recompile_count=None, spread_pct=None):
+    arm = {}
+    for k, v in (("images_per_sec_per_chip", value),
+                 ("step_time_ms", step_time_ms), ("mfu", mfu),
+                 ("compile_s", compile_s),
+                 ("recompile_count", recompile_count),
+                 ("spread_pct", spread_pct)):
+        if v is not None:
+            arm[k] = v
+    return {"metric": metric, "value": value, "unit": "images/sec/chip",
+            "extra": {"backend": backend, "resnet50": arm}}
+
+
+def test_perf_guard_ok_regressed_insufficient():
+    pg = _load_perf_guard()
+    hist = [_bench_record(100.0, step_time_ms=10.0, mfu=0.4),
+            _bench_record(104.0, step_time_ms=9.6, mfu=0.41),
+            _bench_record(98.0, step_time_ms=10.2, mfu=0.39)]
+
+    ok = pg.guard(_bench_record(101.0, step_time_ms=9.9, mfu=0.4), hist)
+    assert ok["verdict"] == "OK" and not ok["regressed"]
+
+    slow = pg.guard(_bench_record(80.0, step_time_ms=12.5, mfu=0.32), hist)
+    assert slow["verdict"] == "REGRESSED"
+    assert "resnet50.images_per_sec_per_chip" in slow["regressed"]
+    assert "resnet50.step_time_ms" in slow["regressed"]
+    assert "value:resnet50_images_per_sec_per_chip" in slow["regressed"]
+
+    # one prior record: every check lacks history -> explicit refusal
+    short = pg.guard(_bench_record(80.0), hist[:1])
+    assert short["verdict"] == "INSUFFICIENT_HISTORY"
+    assert all(c["status"] == "insufficient-history"
+               for c in short["checks"])
+
+
+def test_perf_guard_backend_and_metric_scoping():
+    """A host-degraded round must not be judged against chip history."""
+    pg = _load_perf_guard()
+    tpu_hist = [_bench_record(100.0), _bench_record(101.0)]
+    host = pg.guard(_bench_record(5.0, backend="host"), tpu_hist)
+    assert host["verdict"] == "INSUFFICIENT_HISTORY"
+    assert host["comparable_history"] == 0
+
+
+def test_perf_guard_recompile_and_compile_band():
+    pg = _load_perf_guard()
+    hist = [_bench_record(100.0, compile_s=10.0, recompile_count=0),
+            _bench_record(100.0, compile_s=14.0, recompile_count=0)]
+    # compile_s gets a widened (3x) band: +40% over baseline stays ok
+    ok = pg.guard(_bench_record(100.0, compile_s=16.0, recompile_count=0),
+                  hist)
+    assert ok["verdict"] == "OK"
+    # +60% trips even the widened band
+    slow = pg.guard(_bench_record(100.0, compile_s=20.0), hist)
+    assert "resnet50.compile_s" in slow["regressed"]
+    # ANY recompile over a clean baseline is a regression, band-free
+    storm = pg.guard(_bench_record(100.0, recompile_count=1), hist)
+    assert "resnet50.recompile_count" in storm["regressed"]
+
+
+def test_perf_guard_spread_widens_step_time_band():
+    pg = _load_perf_guard()
+    hist = [_bench_record(100.0, step_time_ms=10.0),
+            _bench_record(100.0, step_time_ms=10.0)]
+    # +18% step time with a self-reported 25% spread: inside the widened band
+    noisy = pg.guard(_bench_record(100.0, step_time_ms=11.8,
+                                   spread_pct=25.0), hist)
+    assert "resnet50.step_time_ms" not in noisy["regressed"]
+    tight = pg.guard(_bench_record(100.0, step_time_ms=11.8,
+                                   spread_pct=2.0), hist)
+    assert "resnet50.step_time_ms" in tight["regressed"]
+
+
+def test_perf_guard_cli_on_wrapper_records(tmp_path):
+    """The CLI reads the driver wrapper shape ({'rc', 'parsed'}) and skips
+    failed rounds when picking current/history."""
+    pg = _load_perf_guard()
+    recs = [(1, 0, _bench_record(100.0)), (2, 0, _bench_record(102.0)),
+            (3, 1, _bench_record(999.0)),  # failed round: ignored
+            (4, 0, _bench_record(101.0))]
+    for n, rc, parsed in recs:
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({"n": n, "rc": rc, "parsed": parsed}))
+    assert pg.main(["--dir", str(tmp_path)]) == 0
+    (tmp_path / "BENCH_r05.json").write_text(
+        json.dumps({"n": 5, "rc": 0, "parsed": _bench_record(70.0)}))
+    assert pg.main(["--dir", str(tmp_path)]) == 1

@@ -449,13 +449,128 @@ PYEOF
   return $rc
 }
 
+# anatomy smoke (ISSUE 10): a short real train run must leave a compile
+# ledger with exactly one compile per signature (zero flagged recompiles),
+# a device/host/input/compile lap split that explains the independently
+# measured Meter lap wall within 5%, and a finite MFU > 0 (nominal CPU
+# peak; DLS_PEAK_FLOPS overrides) — all from `dlstatus --anatomy` alone.
+run_anatomy_smoke() {
+  local t0 rc wd out
+  t0=$(date +%s)
+  rc=0
+  wd=$(mktemp -d /tmp/dls_anatomy_smoke.XXXXXX)
+  DLS_TELEMETRY_DIR="$wd" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python examples/train_mnist.py --master local[2] \
+      --steps 6 --batch-size 16 > "$wd/driver.log" 2>&1 || rc=$?
+  if [ "$rc" -eq 0 ]; then
+    out=$(WD="$wd" python - <<'PYEOF'
+import json, math, os, subprocess, sys
+
+from distributeddeeplearningspark_tpu import telemetry
+
+wd = os.environ["WD"]
+p = subprocess.run(
+    [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+     wd, "--anatomy", "--json"], capture_output=True, text=True)
+assert p.returncode == 0, p.stderr[-500:]
+an = json.loads(p.stdout)["anatomy"]
+
+# 1) exactly-once compile per signature: nothing flagged, no duplicates
+cl = an["compile_ledger"]
+assert cl["compiles"] >= 1, cl
+assert cl["compiles"] == cl["distinct_signatures"], cl
+assert cl["flagged_recompiles"] == 0 and cl["duplicate_signatures"] == 0, cl
+
+# 2) the anatomy split explains the independently measured lap wall:
+#    device+host+input+compile tiles the anatomy clock (coverage == 1),
+#    and the anatomy clock agrees with the Meter's lap_s within 5%
+st = an["steps"]
+covered = (st["device_s"] + st["host_s"] + st["input_wait_s"]
+           + st["compile_s"])
+assert st["wall_s"] > 0 and abs(covered / st["wall_s"] - 1.0) <= 0.05, st
+meter_wall = sum(
+    float(e.get("lap_s", 0.0) or 0.0)
+    for e in telemetry.read_events(wd) if e.get("kind") == "step_metrics")
+assert meter_wall > 0 and abs(st["wall_s"] / meter_wall - 1.0) <= 0.05, (
+    st["wall_s"], meter_wall)
+
+# 3) finite MFU > 0 from the ledger's analytic FLOPs over the peak table
+mfu = an["mfu"]["mfu"]
+assert mfu is not None and math.isfinite(mfu) and mfu > 0, an["mfu"]
+assert an["mfu"]["flops_per_step"] and an["mfu"]["peak_flops_per_chip"]
+
+# 4) memory watermarks present (live-buffer fallback on CPU)
+assert an["memory"] is not None and an["memory"]["source"] in (
+    "memory_stats", "live-buffers"), an["memory"]
+
+print(f"compiles={cl['compiles']} recompiles=0 "
+      f"split={covered / st['wall_s']:.3f}x_anatomy "
+      f"{st['wall_s'] / meter_wall:.3f}x_meter mfu={mfu:.6f} "
+      f"mem={an['memory']['source']}")
+PYEOF
+) || rc=$?
+  else
+    tail -5 "$wd/driver.log"
+  fi
+  log anatomy "${out:-anatomy smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[anatomy] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$wd"
+  return $rc
+}
+
+# perf-guard smoke (ISSUE 10): the regression sentinel must pass on the
+# repo's own BENCH history (rc 0) and must trip — nonzero rc, metric
+# named — when fed a synthetic 20%-slower record as the current round.
+run_perf_guard_smoke() {
+  local t0 rc d out synth
+  t0=$(date +%s)
+  rc=0
+  out=$(python tools/perf_guard.py 2>&1 | head -1) || rc=$?
+  if [ "$rc" -eq 0 ]; then
+    d=$(mktemp -d /tmp/dls_perf_guard.XXXXXX)
+    cp BENCH_*.json "$d"/ 2>/dev/null
+    python - "$d" <<'PYEOF'
+import glob, json, re, sys
+paths = sorted(glob.glob(sys.argv[1] + "/BENCH_*.json"),
+               key=lambda p: int(re.search(r"r(\d+)", p).group(1)))
+good = None
+for p in paths:
+    r = json.load(open(p))
+    if r.get("rc") == 0 and r.get("parsed"):
+        good = r
+assert good, "no good BENCH record to synthesize from"
+p = good["parsed"]
+p["value"] = round(p["value"] * 0.8, 2)
+arm = (p.get("extra") or {}).get("input_pipeline")
+if isinstance(arm, dict) and "host_images_per_sec" in arm:
+    arm["host_images_per_sec"] = p["value"]
+json.dump(good, open(sys.argv[1] + "/BENCH_r99.json", "w"))
+PYEOF
+    synth=$(python tools/perf_guard.py --dir "$d" 2>&1); synth_rc=$?
+    if [ "$synth_rc" -eq 0 ]; then
+      echo "synthetic 20% regression did NOT trip perf_guard"; rc=1
+    elif ! echo "$synth" | grep -q "REGRESSED on .*"; then
+      echo "perf_guard tripped without naming the regressed metric"; rc=1
+    else
+      out="${out}; synthetic: $(echo "$synth" | tail -1)"
+    fi
+    rm -rf "$d"
+  fi
+  log perf-guard "${out:-perf-guard smoke failed}" "${rc}" \
+    $(( $(date +%s) - t0 ))
+  echo "[perf-guard] ${out:-FAILED} (rc=${rc})"
+  return $rc
+}
+
 overall=0
 case "${1:-both}" in
   fast) run_tier fast "not slow" || overall=$? ;;
   slow) run_tier slow "slow" || overall=$? ;;
   both) run_tier fast "not slow" || overall=$?
         run_tier slow "slow" || overall=$?
-        run_shuffle_smoke || overall=$? ;;
+        run_shuffle_smoke || overall=$?
+        run_perf_guard_smoke || overall=$? ;;
   # the recovery drills (kill-mid-finalize, poisoned restore, hang, NaN
   # spike) end-to-end — slow-marked, so the fast tier never pays for gangs
   chaos) run_tier chaos "slow or not slow" tests/test_chaos.py || overall=$? ;;
@@ -481,10 +596,17 @@ case "${1:-both}" in
   # completes via the 2-worker exchange under DLS_SHUFFLE_MEM_MB, exact
   # result + >=1 spill + dlstatus shuffle block (docs/PERFORMANCE.md)
   shuffle) run_shuffle_smoke || overall=$? ;;
+  # device anatomy: compile ledger exactly-once, lap split explains the
+  # Meter wall within 5%, finite MFU (docs/OBSERVABILITY.md "Device
+  # anatomy")
+  anatomy) run_anatomy_smoke || overall=$? ;;
+  # regression sentinel: BENCH history passes, synthetic 20%-slower
+  # record trips rc!=0 with the metric named (tools/perf_guard.py)
+  perf-guard) run_perf_guard_smoke || overall=$? ;;
   # the executable pod-day scripts, logged with the same audit trail
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|anatomy|perf-guard|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
