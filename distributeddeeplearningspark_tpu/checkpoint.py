@@ -52,6 +52,11 @@ _DATA = "data"
 
 #: Integrity manifest filename, written inside each committed step dir.
 MANIFEST_NAME = "dls_manifest.json"
+#: Recorded-geometry filename (mesh shape, device/process counts, per-leaf
+#: sharding specs captured at save time) — what reshard-on-restore projects
+#: onto the restoring topology. Written before the manifest so the manifest
+#: certifies it too.
+SHARDING_NAME = "dls_sharding.json"
 #: Marker orbax itself writes into a step dir at commit time — its presence
 #: is the structural "this step finalized" signal for manifest-less steps.
 _ORBAX_COMMIT_MARKER = "_CHECKPOINT_METADATA"
@@ -59,6 +64,14 @@ _ORBAX_COMMIT_MARKER = "_CHECKPOINT_METADATA"
 
 class RestoreError(RuntimeError):
     """No intact checkpoint could be restored (all steps corrupt/partial)."""
+
+
+class ReshardError(RestoreError):
+    """The checkpoint's recorded topology cannot be reproduced here (e.g. it
+    was saved on more devices than are visible) and the caller asked for the
+    recorded layout back. Restore it by *resharding* instead: pass target
+    ``shardings`` (or ``mesh=``) describing the topology this process
+    actually has."""
 
 
 def abstract_like(tree: Any, shardings: Any = None) -> Any:
@@ -244,6 +257,9 @@ class Checkpointer:
         self.verify_on_restore = verify_on_restore
         os.makedirs(self.directory, exist_ok=True)
         self._pending_manifest: set[int] = set()
+        # geometry captured at save() time (the state's live shardings),
+        # persisted to SHARDING_NAME at the step's manifest flush point
+        self._pending_geometry: dict[int, dict] = {}
         self._manifest_lock = threading.Lock()
         # manifests flush on a helper thread so the full-content CRC of a
         # multi-GB shard never stalls the training loop that async_save
@@ -283,8 +299,17 @@ class Checkpointer:
             # overlaps the next training steps, like the save itself does)
             self._join_manifest_thread()
         if saved:
+            geometry = None
+            try:
+                from distributeddeeplearningspark_tpu.parallel import reshard
+
+                geometry = reshard.geometry_of(state)
+            except Exception:  # geometry is advisory — never fail a save
+                logger.debug("geometry capture failed", exc_info=True)
             with self._manifest_lock:
                 self._pending_manifest.add(int(step))
+                if geometry is not None:
+                    self._pending_geometry[int(step)] = geometry
             logger.info("checkpoint step %d queued → %s", step, self.directory)
         self._manifest_thread = threading.Thread(
             target=self._flush_manifests, kwargs={"exclude": int(step)},
@@ -314,6 +339,15 @@ class Checkpointer:
             try:
                 if os.path.isdir(step_dir):
                     if jax.process_index() == 0:
+                        # geometry first: the manifest scan then certifies it
+                        # like any other file of the step
+                        with self._manifest_lock:
+                            geometry = self._pending_geometry.get(step)
+                        if geometry is not None:
+                            tmp = os.path.join(step_dir, SHARDING_NAME + ".tmp")
+                            with open(tmp, "w") as f:
+                                json.dump(geometry, f)
+                            os.replace(tmp, os.path.join(step_dir, SHARDING_NAME))
                         write_manifest(step_dir, step=step)
                         logger.info(
                             "manifest committed for checkpoint step %d", step)
@@ -321,6 +355,7 @@ class Checkpointer:
                 continue
             with self._manifest_lock:
                 self._pending_manifest.discard(step)
+                self._pending_geometry.pop(step, None)
 
     # -- integrity -----------------------------------------------------------
 
@@ -358,6 +393,62 @@ class Checkpointer:
             pass
 
     # -- read ----------------------------------------------------------------
+
+    def saved_geometry(self, step: int) -> dict | None:
+        """The topology ``step`` was written under, or None for pre-geometry
+        checkpoints: ``{mesh: {axis: size}, num_devices, num_processes,
+        specs: {leaf path: spec entries}}`` (see
+        :func:`..parallel.reshard.geometry_of`)."""
+        try:
+            with open(os.path.join(self._step_dir(step), SHARDING_NAME)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _reshard_check(self, step: int, geometry: dict | None) -> None:
+        """Typed refusal when the caller wants the RECORDED layout back but
+        this process cannot build it — fail with the recovery action named
+        instead of a shape/device mismatch deep inside orbax."""
+        if geometry is None:
+            return
+        recorded = int(geometry.get("num_devices", 0) or 0)
+        visible = jax.device_count()
+        if recorded > visible:
+            raise ReshardError(
+                f"checkpoint step {step} was saved on {recorded} device(s) "
+                f"({geometry.get('num_processes', '?')} process(es), mesh "
+                f"{geometry.get('mesh')}) but only {visible} device(s) are "
+                f"visible here — the recorded layout cannot be rebuilt. "
+                f"Restore by resharding: pass shardings for the current "
+                f"topology (or mesh=<current mesh> to re-project the "
+                f"recorded layout onto it)")
+
+    def _emit_reshard(self, step: int, geometry: dict | None,
+                      shardings: Any) -> None:
+        """One ``recovery`` event when a restore crossed topologies — the
+        durable record dlstatus shows beside the supervisor's
+        ``geometry_change`` so an elastic resume is explainable from the
+        run dir alone."""
+        if geometry is None:
+            return
+        to_mesh = None
+        for leaf in jax.tree.leaves(
+                shardings, is_leaf=lambda x: hasattr(x, "mesh")):
+            if hasattr(leaf, "mesh"):
+                to_mesh = {str(k): int(v) for k, v in leaf.mesh.shape.items()}
+                break
+        if to_mesh is None or to_mesh == geometry.get("mesh"):
+            return
+        logger.warning(
+            "restoring checkpoint step %d across topologies: saved mesh %s "
+            "-> restore mesh %s", step, geometry.get("mesh"), to_mesh)
+        telemetry.emit(
+            "recovery", step=int(step), event="reshard",
+            from_mesh=geometry.get("mesh"), to_mesh=to_mesh,
+            from_devices=geometry.get("num_devices"),
+            to_devices=jax.device_count(),
+            from_processes=geometry.get("num_processes"),
+            to_processes=int(jax.process_count()))
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -399,7 +490,7 @@ class Checkpointer:
             f"*.corrupt-N)")
 
     def restore(self, state_template: Any, *, step: int | None = None,
-                shardings: Any = None) -> tuple[Any, dict | None]:
+                shardings: Any = None, mesh=None) -> tuple[Any, dict | None]:
         """Restore ``(state, data_state)`` at ``step`` (default: newest step
         that passes integrity verification — see :meth:`verify`).
 
@@ -407,8 +498,15 @@ class Checkpointer:
         or ``jax.eval_shape`` output both work). ``shardings`` — typically the
         pytree returned by ``train.step.init_state`` — directs each chip to
         read only its slice; this is what makes cross-topology restore work.
-        With ``shardings=None`` arrays restore with the layout recorded in the
-        checkpoint (same-topology resume only).
+        ``mesh`` (when ``shardings`` is None) re-projects the checkpoint's
+        *recorded* layout onto that mesh — a topology-changed restore with no
+        caller-side sharding rules (axis references the new mesh lacks or can
+        no longer divide degrade to replicated; optimizer-state leaves follow
+        the same recorded template, so momentum survives the move). With
+        neither, arrays restore with the layout recorded in the checkpoint
+        (same-topology resume only — a checkpoint written on more devices
+        than are visible raises :class:`ReshardError` instead of dying deep
+        in orbax).
 
         An explicitly requested ``step`` is verified but never walked back
         from: if its bytes don't match its manifest, :class:`RestoreError`
@@ -430,6 +528,16 @@ class Checkpointer:
                 raise RestoreError(
                     f"requested checkpoint step {step} failed integrity "
                     f"verification: {reason}")
+        geometry = self.saved_geometry(step)
+        if shardings is None and mesh is not None:
+            from distributeddeeplearningspark_tpu.parallel import reshard
+
+            shardings = reshard.shardings_from_record(
+                geometry or {}, state_template, mesh)
+        if shardings is None:
+            self._reshard_check(step, geometry)
+        else:
+            self._emit_reshard(step, geometry, shardings)
         abstract = abstract_like(state_template, shardings)
         items = {_STATE: ocp.args.StandardRestore(abstract)}
         step_dir = self._step_dir(step)
@@ -452,8 +560,8 @@ class Checkpointer:
         logger.info("restored checkpoint step %d from %s", step, self.directory)
         return restored[_STATE], data_state
 
-    def restore_params(self, *, step: int | None = None,
-                       sharding=None) -> tuple[Any, int]:
+    def restore_params(self, *, step: int | None = None, sharding=None,
+                       mesh=None, rules=None) -> tuple[Any, int]:
         """Restore ONLY the params subtree — no caller-side state template.
 
         The serving path (:mod:`.serve.reload`) runs in a process that has
@@ -464,9 +572,19 @@ class Checkpointer:
         full state restores against that self-described template, and the
         ``params`` subtree is returned. Returns ``(params, step)``.
 
-        ``sharding``: one sharding applied to every leaf (e.g.
-        ``NamedSharding(mesh, P())`` to replicate onto a serving mesh);
-        ``None`` restores to the default device layout.
+        Target layout, one of:
+
+        - ``sharding``: one sharding applied to every leaf (e.g.
+          ``NamedSharding(mesh, P())`` to replicate onto a serving mesh);
+        - ``mesh`` (+ optional ``rules``): per-leaf metadata-templated
+          reshard — with ``rules`` (a :class:`..parallel.sharding
+          .ShardingRules`) each leaf's sharding is derived from its
+          checkpoint-recorded shape through the rule engine (how an
+          fsdp-saved checkpoint comes back tensor-sharded); without, the
+          checkpoint's recorded specs are re-projected onto ``mesh``;
+        - neither: the layout recorded in the checkpoint (same-topology
+          only; :class:`ReshardError` when it needs more devices than are
+          visible).
 
         Step selection: the default walks back to the newest step that
         passes verification, but — unlike :meth:`restore` — WITHOUT
@@ -492,11 +610,34 @@ class Checkpointer:
                     f"requested checkpoint step {step} failed integrity "
                     f"verification: {reason}")
         meta = self._mgr.item_metadata(int(step))[_STATE]
-        abstract = jax.tree.map(
-            lambda m: jax.ShapeDtypeStruct(
-                m.shape, m.dtype,
-                **({"sharding": sharding} if sharding is not None else {})),
-            meta)
+        geometry = self.saved_geometry(step)
+        if sharding is None and mesh is not None:
+            meta_abstract = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype), meta)
+            if rules is not None:
+                from distributeddeeplearningspark_tpu.parallel.sharding import (
+                    state_shardings,
+                )
+
+                leaf_shardings = state_shardings(meta_abstract, mesh, rules)
+            else:
+                from distributeddeeplearningspark_tpu.parallel import reshard
+
+                leaf_shardings = reshard.shardings_from_record(
+                    geometry or {}, meta_abstract, mesh)
+            self._emit_reshard(step, geometry, leaf_shardings)
+            abstract = jax.tree.map(
+                lambda m, s: jax.ShapeDtypeStruct(m.shape, m.dtype,
+                                                  sharding=s),
+                meta, leaf_shardings)
+        else:
+            if sharding is None:
+                self._reshard_check(step, geometry)
+            abstract = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(
+                    m.shape, m.dtype,
+                    **({"sharding": sharding} if sharding is not None else {})),
+                meta)
         items = {_STATE: ocp.args.StandardRestore(abstract)}
         step_dir = self._step_dir(step)
         if os.path.isdir(step_dir) and _DATA in set(os.listdir(step_dir)):

@@ -303,7 +303,12 @@ def imagenet_train(dataset: PartitionedDataset, *, size: int = 224, seed: int = 
     core's ~50–100 img/s while a chip consumes thousands (``bench.py
     --model input``). ``num_threads``: thread-pool decode/augment (the
     Spark task-slots-per-executor analog; 0/1 = serial; augmentation is
-    content-seeded per example, so scheduling cannot change the output).
+    content-seeded per example, so thread scheduling cannot change WHICH
+    augmentation an example gets — but concurrent native-kernel calls have
+    been observed to race at the byte level on oversubscribed shared hosts
+    (tests/test_input_workers.py quarantine note), so pipelines that need
+    bit-determinism should use ``num_threads=0`` or worker processes,
+    which reproduce exactly at any width).
     ``repeat=True`` makes the stream infinite HERE — shuffle must precede
     repeat, and repeating before the parallel map keeps one thread pool
     alive across epochs instead of respawning per pass.

@@ -12,14 +12,26 @@ through every failure mode by the supervisor tests::
     DLS_FAULT=truncate_ckpt@20  # after the step-20 checkpoint finalizes,
                                 # tear a byte range out of it, then SIGKILL
                                 # (the kill-mid-finalize torn write)
+    DLS_FAULT=die_host@15       # kill every rank of ONE host at step 15 —
+                                # and keep that host dead on every later
+                                # attempt (a dead machine stays dead); the
+                                # victim is DLS_FAULT_HOST (default 1)
 
 Determinism rules:
 
 - A fault fires on **attempt 0 only** (``DLS_RESTART`` != "0" disables it),
   so a supervisor relaunch runs clean — set ``DLS_FAULT_ALL_ATTEMPTS=1`` to
   keep faulting across restarts (for testing that the supervisor gives up).
+  ``die_host`` is the exception: it *persists across attempts by default*
+  (on relaunch the dead host's ranks die at startup, before training) —
+  that is the whole point of the elastic shrink drill. Set
+  ``DLS_FAULT_ONCE=1`` to restore the first-attempt-only discipline.
 - In a multi-process gang every process sees the same env; set
   ``DLS_FAULT_RANK=k`` to restrict the fault to ``jax.process_index() == k``.
+  ``die_host`` instead targets by *host identity* (``DLS_HOST_ID``, the
+  supervisor-exported original host ordinal, falling back to
+  ``DLS_PROCESS_ID``) — after an elastic shrink ranks are renumbered but
+  host identities are not, so the fault keeps naming the same machine.
 - ``nan`` fires exactly once (the equality-matched step); ``crash``/``hang``
   never return; ``truncate_ckpt`` fires at the first checkpoint boundary at
   or after its step.
@@ -38,7 +50,7 @@ import time
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.faults")
 
-KINDS = ("crash", "hang", "nan", "truncate_ckpt")
+KINDS = ("crash", "hang", "nan", "truncate_ckpt", "die_host")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,15 +79,64 @@ def parse(spec: str) -> Fault:
     return Fault(kind, step)
 
 
+def fault_host() -> int:
+    """The host ordinal a ``die_host`` fault targets (``DLS_FAULT_HOST``,
+    default 1 — the first non-coordinating host, so the survivor keeps the
+    shared checkpoint dir it already owns). Validated like the spec ladder:
+    a typo'd drill must fail loudly."""
+    raw = os.environ.get("DLS_FAULT_HOST", "1")
+    try:
+        host = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"bad DLS_FAULT_HOST {raw!r}: expected a host ordinal (int >= 0)")
+    if host < 0:
+        raise ValueError(
+            f"bad DLS_FAULT_HOST {host}: host ordinals are >= 0")
+    return host
+
+
+def this_host() -> int:
+    """This process's host identity: ``DLS_HOST_ID`` (the supervisor's
+    original-host ordinal, stable across elastic renumbering) falling back
+    to ``DLS_PROCESS_ID`` (rank == host in the 1-process-per-host model)."""
+    return int(os.environ.get("DLS_HOST_ID",
+                              os.environ.get("DLS_PROCESS_ID", "0")) or 0)
+
+
+def die_if_dead_host_on_relaunch() -> None:
+    """The shared "a dead host stays dead" gate: when a ``die_host`` fault
+    targets THIS host and this is a relaunch attempt (``DLS_RESTART`` > 0),
+    SIGKILL now. Workers call it before building their session so the dead
+    rank never reaches the gang rendezvous (the survivors' attempt then
+    fails by fast exit detection, not by blocking until the hang watchdog);
+    ``Trainer.fit`` calls it too as the fallback for drivers launched some
+    other way. No-op in every other case."""
+    fault = get()
+    if (fault is not None and fault.kind == "die_host"
+            and int(os.environ.get("DLS_RESTART", "0") or 0) > 0):
+        crash()
+
+
 def get() -> Fault | None:
     """The fault this process should inject, or None (the common case).
 
     Reads ``DLS_FAULT`` fresh each call (faults are rare; caching would only
-    complicate tests) and applies the attempt/rank gating documented above.
+    complicate tests) and applies the attempt/rank/host gating documented
+    above. For ``die_host`` the returned fault is already host-gated: ranks
+    of surviving hosts get None.
     """
     spec = os.environ.get("DLS_FAULT")
     if not spec:
         return None
+    fault = parse(spec)
+    if fault.kind == "die_host":
+        # persists across attempts (a dead host stays dead) unless the
+        # drill opts back into the one-shot discipline
+        if (os.environ.get("DLS_RESTART", "0") != "0"
+                and os.environ.get("DLS_FAULT_ONCE") == "1"):
+            return None
+        return fault if this_host() == fault_host() else None
     if (os.environ.get("DLS_RESTART", "0") != "0"
             and os.environ.get("DLS_FAULT_ALL_ATTEMPTS") != "1"):
         return None
@@ -85,7 +146,7 @@ def get() -> Fault | None:
 
         if jax.process_index() != int(rank):
             return None
-    return parse(spec)
+    return fault
 
 
 # -- the injections ----------------------------------------------------------

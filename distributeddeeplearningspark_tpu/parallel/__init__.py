@@ -19,6 +19,12 @@ from distributeddeeplearningspark_tpu.parallel.mesh import (
     replicated,
     single_device_mesh,
 )
+from distributeddeeplearningspark_tpu.parallel.reshard import (
+    SpanUnavailableError,
+    project_spec,
+    redistribute,
+    shardings_from_record,
+)
 from distributeddeeplearningspark_tpu.parallel.sharding import (
     FSDP,
     REPLICATED,
@@ -44,4 +50,8 @@ __all__ = [
     "REPLICATED",
     "FSDP",
     "state_shardings",
+    "SpanUnavailableError",
+    "project_spec",
+    "redistribute",
+    "shardings_from_record",
 ]

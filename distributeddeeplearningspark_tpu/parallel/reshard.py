@@ -1,0 +1,240 @@
+"""Portable cross-topology redistribution — load mesh-M state onto mesh M′.
+
+The elasticity story (ISSUE 11 / ROADMAP `[elastic]`) needs arrays laid out
+for one device mesh to move onto a *different* one: a checkpoint written by a
+2-host FSDP gang restored by the single surviving host, or a live state
+handed from a train mesh to a differently shaped serve mesh. arXiv:2112.01075
+(PAPERS.md) frames the portable mechanism: each participant all-gathers the
+spans it is missing and dynamic-slices out exactly the block its new layout
+assigns it — no host ever needs the full array unless its new shard IS the
+full array.
+
+Two layers live here:
+
+- **spec re-projection** (:func:`project_spec`, :func:`shardings_from_record`)
+  — map a PartitionSpec written against mesh M onto mesh M′, dropping axis
+  references M′ lacks (or can no longer divide the dimension by) down to
+  replicated. This is how a checkpoint's *recorded* layout is re-expressed on
+  whatever topology the restoring process actually has.
+- **data movement** (:func:`redistribute`) — move live arrays to target
+  shardings. Same-process mesh changes go through ``jax.device_put`` (XLA
+  emits the all-gather/dynamic-slice pair); when that is not possible the
+  explicit fallback assembles each target device's block from the
+  host-available source shards by interval slicing — the dynamic-slice half
+  done host-side — and raises :class:`SpanUnavailableError` naming the
+  missing span when the local shards cannot cover it (the caller must then
+  fetch it from a peer, e.g. by restoring from the shared checkpoint).
+
+:mod:`..checkpoint` builds its metadata-templated reshard-on-restore path on
+the spec layer; the data layer serves in-process geometry changes and the
+tests that pin the bitwise round-trip acceptance (fsdp-saved →
+tensor-restored → replicated, identical bytes).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class SpanUnavailableError(RuntimeError):
+    """A target shard needs an index span no host-available source shard
+    covers — cross-host redistribution is required (restore from the shared
+    checkpoint, or run the gather on a mesh that spans both hosts)."""
+
+
+# -- spec re-projection -------------------------------------------------------
+
+
+def spec_to_record(spec: P) -> list:
+    """JSON-serializable form of a PartitionSpec: one entry per dimension,
+    ``None`` | axis name | list of axis names."""
+    out: list = []
+    for entry in spec:
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:  # tuple of axis names (e.g. ("data", "fsdp") batch axes)
+            out.append(list(entry))
+    return out
+
+
+def spec_from_record(entries: list | tuple) -> P:
+    return P(*[tuple(e) if isinstance(e, list) else e for e in entries])
+
+
+def project_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Re-express ``spec`` on ``mesh``, dropping what no longer fits.
+
+    An axis reference survives iff the target mesh has that axis AND the
+    dimension is still divisible by its (new) size; otherwise it degrades to
+    replicated for that dimension. Shrinking fsdp=4 → fsdp=2 keeps the
+    sharding at the new degree; shrinking to a mesh with no ``fsdp`` axis (or
+    fsdp=1) yields a replicated dimension — exactly the "survivors hold
+    everything" layout a 1-host restore wants.
+    """
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    out: list = []
+    for dim, entry in zip(shape, entries):
+        if entry is None:
+            out.append(None)
+            continue
+        names = (entry,) if isinstance(entry, str) else tuple(entry)
+        kept = []
+        degree = 1
+        for name in names:
+            size = mesh.shape.get(name, 1)
+            if size > 1 and dim % (degree * size) == 0:
+                kept.append(name)
+                degree *= size
+        out.append(None if not kept
+                   else (kept[0] if len(kept) == 1 else tuple(kept)))
+    return P(*out)
+
+
+def shardings_from_record(record: dict, abstract: Any, mesh: Mesh) -> Any:
+    """Per-leaf NamedShardings for ``abstract`` on ``mesh`` from a recorded
+    geometry (:func:`..checkpoint.Checkpointer.saved_geometry`).
+
+    ``record["specs"]`` maps '/'-joined leaf paths to recorded spec entries;
+    each is re-projected onto ``mesh`` via :func:`project_spec`. Leaves the
+    record does not name (new optimizer slots, renamed params) come out
+    replicated — the safe layout everywhere.
+    """
+    from distributeddeeplearningspark_tpu.parallel.sharding import path_str
+
+    specs: dict = record.get("specs") or {}
+
+    def leaf_sharding(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        entries = specs.get(path_str(path))
+        if not shape or entries is None:
+            return NamedSharding(mesh, P())
+        return NamedSharding(
+            mesh, project_spec(spec_from_record(entries), shape, mesh))
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, abstract)
+
+
+# -- data movement ------------------------------------------------------------
+
+
+def _slices_cover(shape, index) -> list[tuple[int, int]]:
+    """Normalize a shard index (tuple of slices) to [lo, hi) per dimension."""
+    out = []
+    for dim, sl in zip(shape, tuple(index) + (slice(None),) * (len(shape) - len(index))):
+        lo = 0 if sl.start is None else int(sl.start)
+        hi = dim if sl.stop is None else int(sl.stop)
+        out.append((lo, hi))
+    return out
+
+
+def _assemble_block(shape, target_span, source_shards) -> np.ndarray:
+    """Fill one target device's block from overlapping source shards.
+
+    ``target_span``: [lo, hi) per dimension. ``source_shards``: list of
+    (span, ndarray). Host-side dynamic-slice: for every source shard compute
+    the intersection with the target span and copy it in. Raises
+    :class:`SpanUnavailableError` if any cell stays unwritten.
+    """
+    block_shape = tuple(hi - lo for lo, hi in target_span)
+    block = np.empty(block_shape, dtype=source_shards[0][1].dtype)
+    covered = np.zeros(block_shape, dtype=bool) if block.size else None
+    for span, data in source_shards:
+        dst, src = [], []
+        empty = False
+        for (tlo, thi), (slo, shi) in zip(target_span, span):
+            lo, hi = max(tlo, slo), min(thi, shi)
+            if lo >= hi:
+                empty = True
+                break
+            dst.append(slice(lo - tlo, hi - tlo))
+            src.append(slice(lo - slo, hi - slo))
+        if empty:
+            continue
+        block[tuple(dst)] = np.asarray(data)[tuple(src)]
+        if covered is not None:
+            covered[tuple(dst)] = True
+    if covered is not None and not covered.all():
+        missing = int(covered.size - covered.sum())
+        raise SpanUnavailableError(
+            f"target span {target_span} of a {tuple(shape)} array has "
+            f"{missing} element(s) no host-available shard covers — the "
+            f"missing span lives on another host; restore it from the "
+            f"shared checkpoint instead of redistributing live state")
+    return block
+
+
+def _reshard_leaf(x: jax.Array, target: NamedSharding) -> jax.Array:
+    if getattr(x, "sharding", None) is not None and x.sharding.is_equivalent_to(
+            target, x.ndim):
+        return x
+    try:
+        return jax.device_put(x, target)
+    except (ValueError, TypeError, RuntimeError):
+        pass  # cross-mesh device_put unsupported here: explicit assembly
+    # materialize each source shard to host ONCE: _assemble_block slices
+    # these per target block, and leaving them on-device would re-pay the
+    # device→host transfer target-count times over
+    sources = [(_slices_cover(x.shape, s.index), np.asarray(s.data))
+               for s in x.addressable_shards]
+    if not sources:
+        raise SpanUnavailableError(
+            f"array of shape {x.shape} has no addressable shards on this "
+            f"host — nothing to redistribute from")
+    index_map = target.addressable_devices_indices_map(x.shape)
+    arrays = []
+    for dev, idx in index_map.items():
+        span = _slices_cover(x.shape, idx)
+        block = _assemble_block(x.shape, span, sources)
+        arrays.append(jax.device_put(block, dev))
+    return jax.make_array_from_single_device_arrays(x.shape, target, arrays)
+
+
+def redistribute(tree: Any, target_shardings: Any) -> Any:
+    """Move every leaf of ``tree`` to its sharding in ``target_shardings``.
+
+    Leaves already laid out equivalently pass through untouched (no copy).
+    The general path is ``jax.device_put`` — within one process XLA lowers
+    the move to the all-gather/dynamic-slice pair of arXiv:2112.01075 — with
+    the explicit host-side shard assembly as the fallback for mesh pairs
+    ``device_put`` refuses. Scalars and non-array leaves are placed fresh.
+    """
+    return jax.tree.map(
+        lambda x, s: (_reshard_leaf(x, s) if hasattr(x, "addressable_shards")
+                      else jax.device_put(x, s)),
+        tree, target_shardings,
+    )
+
+
+def geometry_of(tree: Any) -> dict | None:
+    """The recorded-geometry dict for a live sharded pytree: mesh axis sizes,
+    device/process counts, and per-leaf spec entries — what
+    :meth:`..checkpoint.Checkpointer.save` persists beside the step.
+
+    None when no leaf carries a NamedSharding (host-only trees).
+    """
+    from distributeddeeplearningspark_tpu.parallel.sharding import path_str
+
+    specs: dict[str, list] = {}
+    mesh_shape: dict[str, int] | None = None
+    num_devices = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        sh = getattr(leaf, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            continue
+        specs[path_str(path)] = spec_to_record(sh.spec)
+        if mesh_shape is None:
+            mesh_shape = {str(k): int(v) for k, v in sh.mesh.shape.items()}
+            num_devices = int(math.prod(mesh_shape.values()))
+    if mesh_shape is None:
+        return None
+    return {
+        "mesh": mesh_shape,
+        "num_devices": num_devices,
+        "num_processes": int(jax.process_count()),
+        "specs": specs,
+    }

@@ -92,13 +92,16 @@ def attempts_from(events: list[dict]) -> list[dict]:
             "session": session, "ordinal": ordinal, "begin_ts": None,
             "end_ts": None, "duration_s": None, "returncodes": None,
             "classification": None, "made_progress": None, "backoff_s": None,
+            "num_processes": None, "dead_host": None,
         })
         if edge == "begin":
             row["begin_ts"] = float(e["ts"])
+            if "num_processes" in e:
+                row["num_processes"] = e["num_processes"]
         elif edge == "end":
             row["end_ts"] = float(e["ts"])
             for k in ("duration_s", "returncodes", "classification",
-                      "made_progress"):
+                      "made_progress", "num_processes", "dead_host"):
                 if k in e:
                     row[k] = e[k]
         elif edge == "backoff":
@@ -621,8 +624,25 @@ def render(rep: dict) -> str:
                 f"  {tag}: {state}"
                 f"  dur={_fmt_s(a['duration_s'])}"
                 f"  codes={codes if codes is not None else '-'}"
+                + (f"  np={a['num_processes']}"
+                   if a.get("num_processes") is not None else "")
+                + (f"  dead_host={a['dead_host']}"
+                   if a.get("dead_host") is not None else "")
                 + (f"  backoff={_fmt_s(a['backoff_s'])}"
                    if a["backoff_s"] is not None else ""))
+        # an elastic run's shrinks, summarized where the operator looks
+        # first: one line per geometry change, between the attempt rows
+        # it separates (the events also appear in the recovery list below)
+        geo = [e for e in rep["recovery_events"]
+               if e.get("event") == "geometry_change"]
+        for e in geo:
+            lines.append(
+                f"  geometry change: {e.get('from_processes')} -> "
+                f"{e.get('to_processes')} host(s) after "
+                f"{e.get('evidence_attempts')} attempt(s) blamed host "
+                f"{e.get('dead_host')}; survivors {e.get('hosts')}, "
+                f"resume step {e.get('step', '-')}, batch "
+                f"{e.get('batch_policy')}")
     if rep["recovery_events"]:
         lines.append("")
         lines.append("recovery events")

@@ -71,6 +71,14 @@ class Attempt:
     #: from the gang's telemetry streams, when they carry enough evidence
     #: to name a single stalled host (telemetry.fleet.localize_hang).
     culprit: dict | None = None
+    #: The ORIGINAL host ordinal this failure points at, when the evidence
+    #: names exactly one: the hang culprit's host, or the unique first-
+    #: failing process (mapped through the surviving-host list, so the id
+    #: stays stable across elastic renumbering). None when ambiguous —
+    #: the shrink policy only acts on an unambiguous, repeated verdict.
+    dead_host: int | None = None
+    #: Gang width of this attempt (shrinks when hosts are dropped).
+    num_processes: int = 0
 
     @property
     def ok(self) -> bool:
@@ -120,10 +128,27 @@ class Supervisor:
     is right.
 
     **Backoff.** Restart delay grows exponentially from
-    ``restart_backoff_s`` (doubling per attempt, capped at
-    ``restart_backoff_max_s``) with ``±backoff_jitter`` relative jitter so a
-    fleet of supervisors recovering from a shared-infra blip doesn't
-    stampede the storage/coordinator in lockstep.
+    ``restart_backoff_s`` (doubling per *consecutive fruitless* attempt,
+    capped at ``restart_backoff_max_s``) with ``±backoff_jitter`` relative
+    jitter so a fleet of supervisors recovering from a shared-infra blip
+    doesn't stampede the storage/coordinator in lockstep. An attempt that
+    made observed progress (heartbeat/checkpoint evidence — only when
+    progress tracking is configured) resets the ladder: a run that trains
+    10k steps and then crashes is a fresh incident, not the next rung of
+    its early flaky attempts' 30s max-backoff.
+
+    **Shrink-to-survive (elastic).** With ``shrink_after=K``, once K
+    consecutive failed attempts point at the SAME dead host (the hang
+    localization's culprit, or the unique first-failing process), the
+    supervisor stops relaunching a doomed geometry: it drops that host from
+    the gang, recomputes ``DLS_NUM_PROCESSES`` (ranks renumber contiguously;
+    each process also gets its stable original ordinal as ``DLS_HOST_ID``),
+    and relaunches the survivors from the last checkpoint — workers restore
+    through the checkpoint layer's reshard-on-restore path, and the global
+    batch is preserved (the feed splits it over fewer hosts, so the
+    per-host share grows; recorded as ``batch_policy`` on the
+    ``geometry_change`` recovery event). The gang never shrinks below
+    ``min_processes``.
     """
 
     def __init__(
@@ -144,9 +169,18 @@ class Supervisor:
         fallback_on_restore_failure: bool = True,
         max_restore_fallbacks: int = 1,
         telemetry_dir: str | None = None,
+        shrink_after: int | None = None,
+        min_processes: int = 1,
     ):
         self.argv = list(argv)
         self.num_processes = num_processes
+        # surviving ORIGINAL host ordinals, in launch order: rank i of the
+        # next attempt is host self._hosts[i]. Shrinks drop entries; ranks
+        # renumber contiguously (jax.distributed wants 0..n-1) while
+        # DLS_HOST_ID keeps naming the same machine across attempts.
+        self._hosts: list[int] = list(range(num_processes))
+        self.shrink_after = shrink_after
+        self.min_processes = max(1, min_processes)
         self.max_restarts = max_restarts
         self.env = dict(env or {})
         self.poll_interval = poll_interval
@@ -223,9 +257,14 @@ class Supervisor:
         try:
             from distributeddeeplearningspark_tpu.telemetry import fleet
 
-            return fleet.localize_hang(
-                telemetry_lib.read_events(self.telemetry_dir),
-                now=time.time())
+            # restrict to the CURRENT gang's ranks: after a shrink the
+            # dropped rank's stream is forever silent, and folding it in
+            # would make every later hang blame the ghost (its silence
+            # always leads) instead of the host actually stuck
+            width = len(self._hosts)
+            events = [e for e in telemetry_lib.read_events(self.telemetry_dir)
+                      if e.get("host") is None or int(e["host"]) < width]
+            return fleet.localize_hang(events, now=time.time())
         except Exception:  # noqa: BLE001 — diagnosis must not mask recovery
             logger.debug("hang localization failed", exc_info=True)
             return None
@@ -247,13 +286,16 @@ class Supervisor:
     def _launch(self, ordinal: int) -> list[subprocess.Popen]:
         port = free_port()
         procs = []
-        for pid in range(self.num_processes):
+        for pid, host in enumerate(self._hosts):
             env = {
                 **os.environ,
                 **self.env,
                 "DLS_COORDINATOR": f"localhost:{port}",
                 "DLS_NUM_PROCESSES": str(self.num_processes),
                 "DLS_PROCESS_ID": str(pid),
+                # stable machine identity: ranks renumber after a shrink,
+                # hosts do not (faults and operators target hosts)
+                "DLS_HOST_ID": str(host),
                 "DLS_RESTART": str(ordinal),
             }
             if self._hb_dir is not None:
@@ -330,10 +372,27 @@ class Supervisor:
             return "restore-failure"
         return "training-crash"
 
+    def _dead_host_from(self, culprit: dict | None,
+                        first_failed: list[int] | None) -> int | None:
+        """The original host ordinal this failure unambiguously names.
+
+        Rank → host goes through the surviving-host list; a localization
+        that names several ranks (or none) yields None — the shrink policy
+        must never amputate on a guess."""
+        rank: int | None = None
+        if culprit and culprit.get("host") is not None:
+            rank = int(culprit["host"])
+        elif first_failed and len(set(first_failed)) == 1:
+            rank = first_failed[0]
+        if rank is None or not (0 <= rank < len(self._hosts)):
+            return None
+        return self._hosts[rank]
+
     def _run_attempt(self, ordinal: int) -> Attempt:
         t0 = time.monotonic()
         self._emit_attempt("begin", ordinal,
-                           num_processes=self.num_processes)
+                           num_processes=self.num_processes,
+                           hosts=list(self._hosts))
         procs = self._launch(ordinal)
         last_progress = time.monotonic()
         track_progress = self._hb_dir is not None or self.progress_path is not None
@@ -341,21 +400,32 @@ class Supervisor:
         seen_progress = False
         hang = False
 
-        def finish(codes: list[int]) -> Attempt:
+        def finish(codes: list[int],
+                   first_failed: list[int] | None = None) -> Attempt:
             progressed = (not track_progress
                           or seen_progress
                           or self._progress_stamp() > stamp0)
             cls = self._classify(codes, ordinal=ordinal, hang=hang,
                                  made_progress=progressed)
+            if first_failed is None and cls != "clean":
+                first_failed = [i for i, c in enumerate(codes) if c != 0]
+            culprit = self._localize_hang() if hang else None
             att = Attempt(ordinal, codes, time.monotonic() - t0,
                           classification=cls, made_progress=progressed,
-                          culprit=self._localize_hang() if hang else None)
+                          culprit=culprit,
+                          dead_host=(None if cls == "clean" else
+                                     self._dead_host_from(culprit,
+                                                          first_failed)),
+                          num_processes=self.num_processes)
             if att.culprit:
                 logger.warning("attempt %d hang localized: %s", ordinal,
                                att.culprit.get("verdict"))
             self._emit_attempt("end", ordinal, returncodes=att.returncodes,
                                duration_s=att.duration_s, classification=cls,
                                made_progress=progressed,
+                               num_processes=self.num_processes,
+                               **({"dead_host": att.dead_host}
+                                  if att.dead_host is not None else {}),
                                **self._culprit_fields(att))
             return att
 
@@ -371,7 +441,8 @@ class Supervisor:
                         ordinal, failed, [codes[i] for i in failed],
                     )
                     self._kill(procs)
-                    return finish([int(p.wait()) for p in procs])
+                    return finish([int(p.wait()) for p in procs],
+                                  first_failed=failed)
                 if self.hang_timeout_s is not None:
                     now_stamp = self._progress_stamp()
                     limit = (self.hang_timeout_s if seen_progress
@@ -446,9 +517,45 @@ class Supervisor:
         if tele is not None:
             tele.recovery(step, "restore-fallback", directory=self.ckpt_dir)
 
+    def _shrink(self, dead_host: int, *, streak: int) -> None:
+        """Drop ``dead_host`` from the gang and re-plan onto the survivors.
+
+        The destructive half of elasticity that is NOT destructive to state:
+        nothing is quarantined or deleted — the next attempt restores the
+        last verified checkpoint through the reshard-on-restore path, on a
+        gang one host narrower. One ``geometry_change`` recovery record ties
+        the evidence (dead host, streak) to the action (new geometry,
+        batch policy) for ``dlstatus`` and the span model."""
+        from distributeddeeplearningspark_tpu.checkpoint import latest_step_in
+
+        old_n = self.num_processes
+        self._hosts.remove(dead_host)
+        self.num_processes = len(self._hosts)
+        resume_step = (latest_step_in(self.ckpt_dir)
+                       if self.ckpt_dir else None)
+        # advisory for workers that want to log/scale on it; the feed math
+        # already preserves the global batch by splitting it n-1 ways
+        self.env["DLS_ELASTIC_GEOMETRY"] = f"{old_n}:{self.num_processes}"
+        logger.warning(
+            "shrink-to-survive: host %d blamed by %d consecutive failed "
+            "attempt(s) — re-planning the gang %d -> %d process(es) "
+            "(survivors: %s), resuming from checkpoint step %s",
+            dead_host, streak, old_n, self.num_processes, self._hosts,
+            resume_step)
+        tele = self._telemetry()
+        if tele is not None:
+            tele.recovery(
+                resume_step, "geometry_change", dead_host=dead_host,
+                evidence_attempts=streak, from_processes=old_n,
+                to_processes=self.num_processes, hosts=list(self._hosts),
+                batch_policy="preserve_global")
+
     def run(self) -> SupervisorResult:
         attempts: list[Attempt] = []
         fallbacks = 0
+        backoff_ordinal = 0  # consecutive fruitless attempts (not launches)
+        streak_host: int | None = None
+        streak = 0
         try:
             for ordinal in range(self.max_restarts + 1):
                 attempt = self._run_attempt(ordinal)
@@ -459,6 +566,12 @@ class Supervisor:
                         ordinal, attempt.duration_s, ordinal,
                     )
                     return SupervisorResult(attempts)
+                if attempt.dead_host is not None and attempt.dead_host == streak_host:
+                    streak += 1
+                elif attempt.dead_host is not None:
+                    streak_host, streak = attempt.dead_host, 1
+                else:
+                    streak_host, streak = None, 0
                 if ordinal < self.max_restarts:
                     logger.warning(
                         "attempt %d failed (codes %s, classified %s); "
@@ -498,7 +611,23 @@ class Supervisor:
                                 "against the same step (a transient storage "
                                 "error must not eat the retention window)",
                                 fallbacks)
-                    delay = self._backoff_delay(ordinal)
+                    track = (self._hb_dir is not None
+                             or self.progress_path is not None)
+                    if attempt.made_progress and track:
+                        # OBSERVED progress (not the no-tracking default):
+                        # this crash is a fresh incident — restart from the
+                        # base delay, not the flaky-era ceiling
+                        backoff_ordinal = 0
+                    if (self.shrink_after is not None
+                            and streak >= self.shrink_after
+                            and streak_host is not None
+                            and self.num_processes > self.min_processes):
+                        self._shrink(streak_host, streak=streak)
+                        streak_host, streak = None, 0
+                        # new geometry = new incident: fresh backoff ladder
+                        backoff_ordinal = 0
+                    delay = self._backoff_delay(backoff_ordinal)
+                    backoff_ordinal += 1
                     self._emit_attempt("backoff", ordinal + 1, delay_s=delay)
                     time.sleep(delay)
             logger.error("giving up after %d attempt(s)", len(attempts))
@@ -535,6 +664,13 @@ def main(argv: list[str] | None = None) -> int:
                         "(defaults to --progress-path)")
     p.add_argument("--no-restore-fallback", action="store_true",
                    help="never quarantine the latest step on restore-failure")
+    p.add_argument("--shrink-after", type=int, default=None, metavar="K",
+                   help="elastic shrink-to-survive: after K consecutive "
+                        "failed attempts blaming the SAME dead host, drop "
+                        "it from the gang and relaunch the survivors from "
+                        "the last checkpoint (default: disabled)")
+    p.add_argument("--min-processes", type=int, default=1,
+                   help="never shrink the gang below this width")
     p.add_argument("--telemetry-dir", default=None,
                    help="run workdir for the telemetry event stream "
                         "(defaults to --ckpt-dir/--progress-path); inspect "
@@ -556,6 +692,8 @@ def main(argv: list[str] | None = None) -> int:
         ckpt_dir=args.ckpt_dir,
         fallback_on_restore_failure=not args.no_restore_fallback,
         telemetry_dir=args.telemetry_dir,
+        shrink_after=args.shrink_after,
+        min_processes=args.min_processes,
     ).run()
     return 0 if result.ok else 1
 

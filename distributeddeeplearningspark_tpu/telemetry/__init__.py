@@ -22,10 +22,19 @@ present):
   ``eval``. Other names (``run``, ``manifest``, ``profile-trace``) are
   informational.
 - ``recovery`` — a recovery action fired: ``event`` ("skip", "rollback",
-  "restart", "restore-fallback", ...) plus free-form evidence fields.
+  "restart", "restore-fallback", "geometry_change", "reshard", ...) plus
+  free-form evidence fields. ``geometry_change`` is the supervisor's
+  elastic shrink (``dead_host``, ``evidence_attempts``,
+  ``from_processes``/``to_processes``, surviving ``hosts``,
+  ``batch_policy``; ``step`` is the checkpoint the survivors resume
+  from); ``reshard`` is the checkpoint layer restoring across
+  topologies (``from_mesh``/``to_mesh``, ``from_devices``/``to_devices``,
+  ``from_processes``/``to_processes``).
 - ``attempt`` — supervisor gang lifecycle: ``edge`` ("begin"/"end"/
-  "backoff"), ``ordinal``, and on end ``returncodes``/``classification``/
-  ``duration_s``.
+  "backoff"), ``ordinal``, ``num_processes`` (+ ``hosts``, the surviving
+  original host ordinals, on begin), and on end ``returncodes``/
+  ``classification``/``duration_s`` (+ ``dead_host`` when the failure
+  unambiguously names one).
 - ``heartbeat`` — liveness stamp (``step``), the telemetry twin of the
   supervisor's ``DLS_HEARTBEAT_FILE`` mtime. The writer auto-enriches it
   with the innermost open ``phase`` so a stalled host is localizable from

@@ -519,6 +519,10 @@ class Trainer:
                     f"land mid-batch; resume with the original batch size")
             skip = int(data_state["examples_seen"]) // batch_size
         got_batch = False
+        # fallback gate for drivers not launched through the test workers
+        # (those already died pre-rendezvous): on a relaunch, a die_host
+        # target must not train — the machine it stands in for is gone
+        faults.die_if_dead_host_on_relaunch()
         fault = faults.get()
         skipped_dev = None  # device-side cumulative skip count (stays async)
         n_skipped = 0
@@ -542,7 +546,7 @@ class Trainer:
                         meter.set_flops(self.compiled_cost(batch))
                     flops_pending = False
                 if fault is not None and step_i + 1 == fault.step \
-                        and fault.kind in ("nan", "crash", "hang"):
+                        and fault.kind in ("nan", "crash", "hang", "die_host"):
                     kind = fault.kind
                     # one-shot: a rollback rewinds step_i past the trigger,
                     # and re-poisoning the retrained window would turn one
@@ -550,7 +554,7 @@ class Trainer:
                     fault = None
                     if kind == "nan":
                         batch = faults.nan_batch(batch)
-                    elif kind == "crash":
+                    elif kind in ("crash", "die_host"):
                         faults.crash()
                     else:
                         faults.hang()

@@ -78,6 +78,43 @@ def test_fault_gating(monkeypatch):
     assert faults.get() is None
 
 
+def test_die_host_fault_gating(monkeypatch):
+    """die_host targets by stable host identity, persists across attempts
+    by default (a dead machine stays dead), and validates its env knobs
+    with the same loud ladder as the spec itself."""
+    monkeypatch.setenv("DLS_FAULT", "die_host@7")
+    monkeypatch.setenv("DLS_PROCESS_ID", "1")
+    monkeypatch.delenv("DLS_RESTART", raising=False)
+    monkeypatch.delenv("DLS_HOST_ID", raising=False)
+    monkeypatch.delenv("DLS_FAULT_HOST", raising=False)
+    assert faults.get() == faults.Fault("die_host", 7)
+    # persists across attempts (unlike crash's first-attempt-only rule) …
+    monkeypatch.setenv("DLS_RESTART", "2")
+    assert faults.get() == faults.Fault("die_host", 7)
+    # … unless the drill opts back into one-shot
+    monkeypatch.setenv("DLS_FAULT_ONCE", "1")
+    assert faults.get() is None
+    monkeypatch.delenv("DLS_FAULT_ONCE")
+    # DLS_HOST_ID (stable across elastic renumbering) wins over the rank
+    monkeypatch.setenv("DLS_PROCESS_ID", "0")
+    monkeypatch.setenv("DLS_HOST_ID", "1")
+    assert faults.get() == faults.Fault("die_host", 7)
+    # surviving hosts run clean
+    monkeypatch.setenv("DLS_HOST_ID", "0")
+    assert faults.get() is None
+    # validation ladder: bad host env and 0-step specs fail loudly
+    monkeypatch.setenv("DLS_HOST_ID", "1")
+    monkeypatch.setenv("DLS_FAULT_HOST", "frobnicate")
+    with pytest.raises(ValueError, match="DLS_FAULT_HOST"):
+        faults.get()
+    monkeypatch.setenv("DLS_FAULT_HOST", "-1")
+    with pytest.raises(ValueError, match=">= 0"):
+        faults.get()
+    for bad in ("die_host@0", "die_host@", "die_host@x"):
+        with pytest.raises(ValueError):
+            faults.parse(bad)
+
+
 # -- drill 1: SIGKILL mid-checkpoint-finalize --------------------------------
 
 
@@ -246,6 +283,149 @@ def test_crash_drill_dlstatus_reports_attempts_and_goodput(tmp_path):
     assert total == pytest.approx(g["wall_s"], rel=0.05), (total, g)
     # the CLI renders the same report and exits 0
     assert status.main([str(tmp_path)]) == 0
+
+
+# -- drill 5: kill-a-host — elastic shrink-to-survive ------------------------
+
+
+def _geometry_changes(workdir):
+    return [e for e in _recovery_events(workdir)
+            if e["event"] == "geometry_change"]
+
+
+def _losses_by_step(workdir, *, after_ts=None):
+    out = {}
+    for e in telemetry.read_events(workdir):
+        if e.get("kind") != "step_metrics":
+            continue
+        if after_ts is not None and float(e["ts"]) <= after_ts:
+            continue
+        loss = (e.get("metrics") or {}).get("loss")
+        if loss is not None:
+            out[int(e["step"])] = float(loss)
+    return out
+
+
+@pytest.mark.slow
+def test_die_host_shrinks_gang_and_training_continues(tmp_path):
+    """THE elastic acceptance drill: DLS_FAULT=die_host@12 kills host 1 of a
+    2-host gang mid-run and keeps it dead across attempts. After 2
+    consecutive failures blaming the same host, the supervisor re-plans the
+    gang onto the surviving host (shrink-to-survive), relaunches from the
+    last verified checkpoint, and training runs to completion on 1 host —
+    with a loss trajectory matching a clean 1-host run restored from the
+    same step, and the shrink recorded as a first-class geometry_change
+    event that ``dlstatus`` renders.
+
+    (On builds whose CPU backend cannot run cross-process collectives the
+    gang uses the worker's ``elastic`` mode — rank 0 trains, rank 1 is a
+    stand-in host agent; the supervisor machinery under test is identical.
+    The real-gang variant below additionally proves the resharded restore
+    when multiprocess collectives exist.)"""
+    import shutil
+
+    wd = tmp_path / "run"
+    wd.mkdir()
+    sup = Supervisor(
+        [sys.executable, WORKER, "elastic", "--ckpt-dir", str(wd),
+         "--steps", "24", "--checkpoint-every", "6"],
+        num_processes=2, max_restarts=4, restart_backoff_s=0.05,
+        backoff_jitter=0.0, shrink_after=2,
+        env={**_CLEAN_ENV, "DLS_FAULT": "die_host@12"},
+        progress_path=str(wd),
+    )
+    result = sup.run()
+    assert result.ok, (
+        f"attempts: {[(a.ordinal, a.returncodes, a.classification) for a in result.attempts]}")
+    # attempt 0: host 1 died at the step-12 checkpoint; attempt 1: host 1
+    # died at startup (a dead host stays dead); attempt 2: 1-host gang
+    assert result.restarts == 2
+    assert [a.num_processes for a in result.attempts] == [2, 2, 1]
+    assert result.attempts[0].dead_host == 1
+    assert result.attempts[1].dead_host == 1
+    step, attempt, nprocs = open(wd / "DONE").read().split()
+    assert (int(step), int(attempt), int(nprocs)) == (24, 2, 1)
+
+    # the shrink is a first-class durable event naming evidence and action
+    geo = _geometry_changes(wd)
+    assert len(geo) == 1, geo
+    assert geo[0]["dead_host"] == 1
+    assert geo[0]["from_processes"] == 2 and geo[0]["to_processes"] == 1
+    assert geo[0]["hosts"] == [0]
+    assert geo[0]["batch_policy"] == "preserve_global"
+    assert geo[0]["evidence_attempts"] == 2
+
+    # dlstatus explains the whole incident from the run dir alone
+    rep = status.report(str(wd))
+    assert any(e["event"] == "geometry_change"
+               for e in rep["recovery_events"])
+    nps = [a.get("num_processes") for a in rep["attempts"]]
+    assert nps == [2, 2, 1], nps
+    rendered = status.render(rep)
+    assert "geometry" in rendered and "np=1" in rendered, rendered
+
+    # loss trajectory: the post-shrink attempt must match a CLEAN 1-host run
+    # restored from the same checkpoint step, batch for batch
+    events = telemetry.read_events(wd)
+    restores = [e for e in events
+                if e.get("kind") == "phase" and e.get("name") == "restore"
+                and e.get("edge") == "end"]
+    assert restores, "the shrunk relaunch never restored a checkpoint"
+    resume_step = int(restores[-1]["step"])
+    geo_ts = float(next(e["ts"] for e in events
+                        if e.get("kind") == "recovery"
+                        and e.get("event") == "geometry_change"))
+    drill_losses = _losses_by_step(wd, after_ts=geo_ts)
+    assert max(drill_losses) == 24 and min(drill_losses) > resume_step
+
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    for d in os.listdir(wd):
+        if d.isdigit() and int(d) <= resume_step:
+            shutil.copytree(wd / d, clean / d)
+    sup2 = Supervisor(
+        [sys.executable, WORKER, "elastic", "--ckpt-dir", str(clean),
+         "--steps", "24", "--checkpoint-every", "6"],
+        num_processes=1, max_restarts=0, env=_CLEAN_ENV,
+        progress_path=str(clean),
+    )
+    assert sup2.run().ok
+    clean_losses = _losses_by_step(clean)
+    common = sorted(set(drill_losses) & set(clean_losses))
+    assert common and common[-1] == 24, (drill_losses, clean_losses)
+    for s in common:
+        assert drill_losses[s] == pytest.approx(clean_losses[s], rel=1e-6), (
+            s, drill_losses[s], clean_losses[s])
+
+
+@pytest.mark.slow
+def test_die_host_real_gang_reshards_onto_survivor(tmp_path):
+    """The same drill over a REAL jax.distributed gang (2 processes sharing
+    one DP mesh): host 1's rank dies at step 12 and stays dead; the shrunk
+    relaunch restores the 2-host checkpoint onto the 1-host mesh through
+    the reshard-on-restore path and finishes. Skips (with evidence) on
+    builds whose CPU backend cannot run multiprocess collectives."""
+    from tests.test_supervisor import _gang_skip_reason
+
+    reason = _gang_skip_reason()
+    if reason:
+        pytest.skip(reason)
+    sup = Supervisor(
+        [sys.executable, WORKER, "train", "--ckpt-dir", str(tmp_path),
+         "--steps", "24", "--checkpoint-every", "6"],
+        num_processes=2, max_restarts=4, restart_backoff_s=0.05,
+        backoff_jitter=0.0, shrink_after=2,
+        env={**_CLEAN_ENV, "DLS_FAULT": "die_host@12"},
+        progress_path=str(tmp_path), hang_timeout_s=60.0,
+        startup_grace_s=240.0,
+    )
+    result = sup.run()
+    assert result.ok, (
+        f"attempts: {[(a.ordinal, a.returncodes, a.classification) for a in result.attempts]}")
+    assert result.attempts[-1].num_processes == 1
+    step, _attempt = open(tmp_path / "DONE").read().split()
+    assert int(step) == 24
+    assert _geometry_changes(tmp_path), "no geometry_change event recorded"
 
 
 # -- drill 4: NaN spike vs the divergence policies ---------------------------

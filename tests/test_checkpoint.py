@@ -358,6 +358,129 @@ def test_restore_metadata_fallback_path(tmp_path, eight_devices, monkeypatch):
         _assert_trees_equal(_host_tree(state), _host_tree(restored2))
 
 
+def test_reshard_on_restore_fsdp_to_tensor_bitwise(tmp_path, eight_devices):
+    """ISSUE 11 acceptance: fsdp-saved → tensor-restored (and → replicated)
+    round-trips are bitwise on params, with optimizer momentum following
+    the same template — through restore_params' metadata-templated path
+    (no caller-side state), driven only by (mesh, rules)."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributeddeeplearningspark_tpu.parallel.sharding import ShardingRules
+
+    mesh_a = MeshSpec(data=2, fsdp=4).build()
+    state, _ = step_lib.init_state(
+        LeNet5(), optax.sgd(0.1, momentum=0.9), _sample_batch(), mesh_a,
+        FSDP, seed=3)
+    with Checkpointer(tmp_path / "ckpt", async_save=False) as ckpt:
+        ckpt.save(1, state)
+        ckpt.wait()
+        # the saved geometry names the fsdp layout
+        geo = ckpt.saved_geometry(1)
+        assert geo["num_devices"] == 8
+        assert any("fsdp" in str(v) for v in geo["specs"].values())
+
+        tensor_rules = ShardingRules(rules=(
+            (r"Dense_0/kernel", P(None, "tensor")),
+            (r"Dense_1/kernel", P("tensor", None))))
+        mesh_t = MeshSpec(data=1, tensor=8).build()
+        params_t, step = ckpt.restore_params(mesh=mesh_t, rules=tensor_rules)
+        assert step == 1
+        flat_a = {tuple(map(str, p)): v for p, v in
+                  jax.tree_util.tree_flatten_with_path(state.params)[0]}
+        flat_t = {tuple(map(str, p)): v for p, v in
+                  jax.tree_util.tree_flatten_with_path(params_t)[0]}
+        assert flat_a.keys() == flat_t.keys()
+        for k, v in flat_a.items():
+            assert (_host_tree(v).tobytes()
+                    == _host_tree(flat_t[k]).tobytes()), k
+        specs = {str(l.sharding.spec) for l in jax.tree.leaves(params_t)}
+        assert any("tensor" in s for s in specs), specs
+
+        # → replicated (the serving shape), still bitwise
+        params_r, _ = ckpt.restore_params(mesh=MeshSpec(data=8).build())
+        flat_r = {tuple(map(str, p)): v for p, v in
+                  jax.tree_util.tree_flatten_with_path(params_r)[0]}
+        for k, v in flat_a.items():
+            assert (_host_tree(v).tobytes()
+                    == _host_tree(flat_r[k]).tobytes()), k
+
+        # full-state restore onto a SMALLER topology (8 → 4 devices) via the
+        # recorded-layout projection: optimizer momentum survives the move
+        mesh_half = MeshSpec(data=1, fsdp=4).build(jax.devices()[:4])
+        restored, _ = ckpt.restore(state, mesh=mesh_half)
+    _assert_trees_equal(_host_tree(state), _host_tree(restored))
+    half_devs = set(mesh_half.devices.flat)
+    for leaf in jax.tree.leaves(restored):
+        assert set(leaf.sharding.device_set) <= half_devs
+
+
+def test_restore_params_walks_back_past_quarantined_boundary(
+        tmp_path, eight_devices):
+    """Satellite: restore_params at a quarantined ``step.corrupt-N``
+    walk-back boundary — the newest step is torn and already quarantined by
+    the owner; the reader must land on the previous verified step without
+    touching the quarantined dir (and a torn-but-not-yet-quarantined latest
+    must be skipped without quarantining it: readers don't rename the
+    owner's steps)."""
+    import os
+
+    from distributeddeeplearningspark_tpu import faults
+
+    mesh = MeshSpec(data=8).build()
+    state, _ = step_lib.init_state(
+        LeNet5(), optax.sgd(0.1), _sample_batch(), mesh, REPLICATED, seed=3)
+    with Checkpointer(tmp_path / "ckpt", async_save=False) as ckpt:
+        ckpt.save(1, state, data_state={"examples_seen": 8})
+        ckpt.save(2, state, data_state={"examples_seen": 16})
+        ckpt.wait()
+        faults.truncate_latest_checkpoint(str(tmp_path / "ckpt"))
+        # owner-side quarantine: step 2 becomes 2.corrupt-0
+        ckpt.quarantine(2)
+        params, step = ckpt.restore_params()
+        assert step == 1
+        entries = sorted(os.listdir(tmp_path / "ckpt"))
+        assert any(e.startswith("2.corrupt-") for e in entries), entries
+
+        # now tear step 1 too but do NOT quarantine: the reader walks past
+        # it only in selection (latest_verified_step), never renames
+        ckpt2 = Checkpointer(tmp_path / "ckpt", async_save=False)
+        ckpt2.save(3, state, data_state={"examples_seen": 24})
+        ckpt2.wait()
+        faults.truncate_latest_checkpoint(str(tmp_path / "ckpt"))
+        _, step = ckpt2.restore_params()
+        assert step == 1
+        entries = sorted(os.listdir(tmp_path / "ckpt"))
+        assert os.path.isdir(tmp_path / "ckpt" / "3"), entries
+        assert not any(e.startswith("3.corrupt-") for e in entries), entries
+
+
+def test_restore_needing_more_devices_raises_typed_error(
+        tmp_path, eight_devices, monkeypatch):
+    """Satellite: asking for the RECORDED layout back when the checkpoint
+    was saved on more devices than are visible must raise ReshardError
+    (naming the reshard escape hatch), not a shape/device mismatch deep in
+    orbax — and passing a target mesh must still restore fine."""
+    from distributeddeeplearningspark_tpu import checkpoint as ckpt_mod
+
+    mesh = MeshSpec(data=2, fsdp=4).build()
+    state, _ = step_lib.init_state(
+        LeNet5(), optax.sgd(0.1), _sample_batch(), mesh, FSDP)
+    with Checkpointer(tmp_path / "ckpt", async_save=False) as ckpt:
+        ckpt.save(1, state)
+        ckpt.wait()
+        # simulate a host that sees fewer devices than the checkpoint used
+        monkeypatch.setattr(ckpt_mod.jax, "device_count", lambda: 4)
+        with pytest.raises(ckpt_mod.ReshardError, match="8 device"):
+            ckpt.restore(state)
+        with pytest.raises(ckpt_mod.ReshardError, match="reshard"):
+            ckpt.restore_params()
+        monkeypatch.undo()
+        # the escape hatch the error names: restore onto the mesh we have
+        mesh_half = MeshSpec(data=1, fsdp=4).build(jax.devices()[:4])
+        restored, _ = ckpt.restore(state, mesh=mesh_half)
+    _assert_trees_equal(_host_tree(state), _host_tree(restored))
+
+
 def test_trainer_restore_before_init_raises(tmp_path):
     """Satellite: the restore guards are real exceptions (visible under
     python -O), with a call-init()-first message."""

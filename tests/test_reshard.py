@@ -1,0 +1,138 @@
+"""Portable cross-topology redistribution (parallel/reshard.py) — the data
+and spec layers behind elastic reshard-on-restore (ISSUE 11, PAPERS.md
+2112.01075's all-gather/dynamic-slice framing).
+
+The acceptance invariant pinned here: moving state between layouts is
+BITWISE — fsdp-saved → tensor-restored → replicated round-trips change
+where bytes live, never what they are.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributeddeeplearningspark_tpu.parallel import reshard
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+
+
+def _host(x):
+    return np.asarray(jax.device_get(x))
+
+
+@pytest.fixture()
+def meshes(eight_devices):
+    return {
+        "fsdp": MeshSpec(data=2, fsdp=4).build(),
+        "tensor": MeshSpec(data=1, tensor=8).build(),
+        "dp": MeshSpec(data=8).build(),
+        "half": MeshSpec(data=1, fsdp=4).build(jax.devices()[:4]),
+    }
+
+
+# -- spec re-projection -------------------------------------------------------
+
+
+def test_spec_record_round_trip():
+    for spec in (P(), P(None, "fsdp"), P(("data", "fsdp"), None),
+                 P("tensor")):
+        rec = reshard.spec_to_record(spec)
+        assert reshard.spec_from_record(rec) == spec
+        # records are JSON-clean (lists/strings/None only)
+        import json
+
+        json.dumps(rec)
+
+
+def test_project_spec_keeps_divisible_axes(meshes):
+    # fsdp=4 on the source survives onto the 4-wide fsdp of the half mesh
+    assert (reshard.project_spec(P("fsdp", None), (64, 16), meshes["half"])
+            == P("fsdp", None))
+    # ...degrades to replicated where the target axis is width 1
+    assert (reshard.project_spec(P("fsdp", None), (64, 16), meshes["tensor"])
+            == P(None, None))
+    # ...and where the dim no longer divides (65 % 4 != 0)
+    assert (reshard.project_spec(P("fsdp", None), (65, 16), meshes["half"])
+            == P(None, None))
+
+
+def test_project_spec_tuple_entries(meshes):
+    # ("data","fsdp") batch-style entries keep the members that still fit
+    out = reshard.project_spec(P(("data", "fsdp"), None), (64, 16),
+                               meshes["half"])
+    assert out == P("fsdp", None)
+
+
+def test_shardings_from_record_unknown_leaf_replicates(meshes):
+    record = {"specs": {"w": ["fsdp", None]}}
+    abstract = {"w": jax.ShapeDtypeStruct((64, 16), np.float32),
+                "new_leaf": jax.ShapeDtypeStruct((8,), np.float32)}
+    sh = reshard.shardings_from_record(record, abstract, meshes["half"])
+    assert sh["w"].spec == P("fsdp", None)
+    assert sh["new_leaf"].spec == P()
+
+
+# -- data movement ------------------------------------------------------------
+
+
+def test_redistribute_round_trip_bitwise(meshes):
+    """fsdp → tensor → replicated → fsdp: every hop preserves bytes."""
+    x_host = np.arange(64 * 16, dtype=np.float32).reshape(64, 16)
+    x = jax.device_put(x_host, NamedSharding(meshes["fsdp"], P("fsdp", None)))
+    hops = [NamedSharding(meshes["tensor"], P(None, "tensor")),
+            NamedSharding(meshes["dp"], P()),
+            NamedSharding(meshes["fsdp"], P("fsdp", None))]
+    tree = {"w": x}
+    for target in hops:
+        tree = reshard.redistribute(tree, {"w": target})
+        assert tree["w"].sharding.is_equivalent_to(target, 2)
+        assert _host(tree["w"]).tobytes() == x_host.tobytes()
+
+
+def test_redistribute_noop_on_equivalent_layout(meshes):
+    x = jax.device_put(np.ones((8, 8), np.float32),
+                       NamedSharding(meshes["dp"], P()))
+    out = reshard.redistribute({"w": x}, {"w": NamedSharding(meshes["dp"], P())})
+    assert out["w"] is x  # no copy when already placed right
+
+
+def test_assembly_fallback_matches_device_put(meshes, monkeypatch):
+    """The explicit shard-assembly path (what runs when device_put refuses a
+    mesh pair) produces the same bytes and layout as the fast path."""
+    x_host = np.arange(32 * 24, dtype=np.float32).reshape(32, 24)
+    x = jax.device_put(x_host, NamedSharding(meshes["fsdp"], P(None, "fsdp")))
+    target = NamedSharding(meshes["tensor"], P("tensor", None))
+
+    real_put = jax.device_put
+
+    def refuse_sharded(v, s=None, **kw):
+        if hasattr(s, "spec"):
+            raise ValueError("forced fallback")
+        return real_put(v, s, **kw)
+
+    monkeypatch.setattr(jax, "device_put", refuse_sharded)
+    out = reshard._reshard_leaf(x, target)
+    assert _host(out).tobytes() == x_host.tobytes()
+    assert out.sharding.is_equivalent_to(target, 2)
+
+
+def test_assembly_reports_missing_span():
+    """A target span no local shard covers raises the typed error naming
+    the recovery action (restore from the shared checkpoint)."""
+    shape = (16, 4)
+    span = [(0, 16), (0, 4)]
+    # only rows 0..8 available
+    sources = [([(0, 8), (0, 4)], np.zeros((8, 4), np.float32))]
+    with pytest.raises(reshard.SpanUnavailableError, match="checkpoint"):
+        reshard._assemble_block(shape, span, sources)
+
+
+def test_geometry_of_records_mesh_and_specs(meshes):
+    x = jax.device_put(np.zeros((64, 16), np.float32),
+                       NamedSharding(meshes["fsdp"], P("fsdp", None)))
+    g = reshard.geometry_of({"a": {"w": x}, "scalar": 3})
+    assert g["num_devices"] == 8
+    assert g["mesh"]["fsdp"] == 4 and g["mesh"]["data"] == 2
+    assert g["specs"]["a/w"] == ["fsdp", None]
+    assert g["num_processes"] == 1
+    assert reshard.geometry_of({"host_only": np.zeros(3)}) is None

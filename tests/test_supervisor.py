@@ -210,6 +210,98 @@ def test_restart_backoff_timing_observed(monkeypatch):
     assert backoffs == [0.15, 0.3], backoffs
 
 
+def test_backoff_resets_after_observed_progress(tmp_path, monkeypatch):
+    """Satellite: an attempt with OBSERVED progress evidence resets the
+    backoff ladder — a run that trains for a while and then crashes backs
+    off from the base delay, not from its early flaky attempts' doubled
+    ceiling. (Without progress tracking the ladder still doubles — see
+    test_restart_backoff_timing_observed — because made_progress is then
+    only assumed.)"""
+    from distributeddeeplearningspark_tpu import supervisor as sup_mod
+
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "open(os.path.join(os.environ['PROG'], 'touch'), 'w').write('x')\n"
+        "sys.exit(7)\n"
+    )
+    prog = tmp_path / "prog"
+    prog.mkdir()
+    sleeps: list[float] = []
+    real_sleep = sup_mod.time.sleep
+    monkeypatch.setattr(
+        sup_mod.time, "sleep",
+        lambda s: (sleeps.append(s), real_sleep(min(s, 0.01)))[1])
+    result = Supervisor(
+        [sys.executable, str(script)],
+        max_restarts=2, restart_backoff_s=0.15, backoff_jitter=0.0,
+        poll_interval=0.01, progress_path=str(prog),
+        env={"PROG": str(prog)},
+    ).run()
+    assert len(result.attempts) == 3
+    assert all(a.made_progress for a in result.attempts)
+    backoffs = [s for s in sleeps if s > 0.01]
+    # every attempt made real progress → every delay is the base, no doubling
+    assert backoffs == [0.15, 0.15], backoffs
+
+
+def test_shrink_to_survive_drops_dead_host(tmp_path):
+    """Fast-tier shrink drill (plain-python workers): host 1 dies on every
+    attempt; after shrink_after=2 consecutive same-host failures the gang
+    re-plans to the surviving host — which then finishes — and the
+    geometry_change recovery record ties evidence to action. Host identity
+    (DLS_HOST_ID) stays stable across the rank renumbering."""
+    from distributeddeeplearningspark_tpu import telemetry
+
+    (tmp_path / "10").mkdir()  # the "last checkpoint" the relaunch resumes
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "host = os.environ.get('DLS_HOST_ID', os.environ['DLS_PROCESS_ID'])\n"
+        "if host == '1':\n"
+        "    sys.exit(1)\n"
+        "if os.environ['DLS_NUM_PROCESSES'] == '1':\n"
+        "    with open(os.path.join(sys.argv[1], 'DONE'), 'w') as f:\n"
+        "        f.write(os.environ['DLS_RESTART'] + ' '\n"
+        "                + os.environ['DLS_HOST_ID'])\n"
+        "    sys.exit(0)\n"
+        "time.sleep(30)\n"  # healthy peer: killed when host 1 dies
+    )
+    sup = Supervisor(
+        [sys.executable, str(script), str(tmp_path)],
+        num_processes=2, max_restarts=3, restart_backoff_s=0.01,
+        backoff_jitter=0.0, ckpt_dir=str(tmp_path), shrink_after=2,
+    )
+    result = sup.run()
+    assert result.ok, [(a.returncodes, a.classification) for a in result.attempts]
+    assert [a.num_processes for a in result.attempts] == [2, 2, 1]
+    assert [a.dead_host for a in result.attempts] == [1, 1, None]
+    attempt, host = open(tmp_path / "DONE").read().split()
+    assert (attempt, host) == ("2", "0")  # survivor kept its host identity
+    geo = [e for e in telemetry.read_events(tmp_path)
+           if e.get("kind") == "recovery" and e["event"] == "geometry_change"]
+    assert len(geo) == 1
+    assert geo[0]["dead_host"] == 1 and geo[0]["hosts"] == [0]
+    assert geo[0]["from_processes"] == 2 and geo[0]["to_processes"] == 1
+    assert geo[0]["step"] == 10  # the checkpoint the survivors resume from
+    assert geo[0]["batch_policy"] == "preserve_global"
+
+
+def test_shrink_respects_min_processes(tmp_path):
+    """The gang never shrinks below min_processes — a persistent dead host
+    in a gang already at the floor burns restarts instead of amputating to
+    nothing."""
+    script = "import sys; sys.exit(1)\n"
+    sup = Supervisor(
+        [sys.executable, "-c", script],
+        num_processes=2, max_restarts=3, restart_backoff_s=0.01,
+        backoff_jitter=0.0, shrink_after=2, min_processes=2,
+    )
+    result = sup.run()
+    assert not result.ok
+    assert all(a.num_processes == 2 for a in result.attempts)
+
+
 def test_result_shapes():
     r = SupervisorResult(attempts=[])
     assert not r.ok and r.restarts == 0

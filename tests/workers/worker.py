@@ -11,6 +11,15 @@ Modes:
   the test compares them numerically against a single-process reference
   (VERDICT r4 next-#8: the supervisor drills prove lifecycle across the
   DCN/process boundary; this proves the NUMBERS cross it unchanged).
+- ``elastic``: the kill-a-host chaos drill's gang shape for builds whose
+  CPU backend cannot run cross-process collectives (the same environmental
+  limit that skips the real-gang drills): rank 0 is the training host — a
+  deterministic single-device run with checkpoint/resume and telemetry —
+  and every other rank is a stand-in *host agent* that heartbeats, honors
+  ``DLS_FAULT=die_host@N`` (dies when the step-N checkpoint lands; stays
+  dead on relaunches), and exits cleanly when training completes. The
+  supervisor cannot tell the difference: gang launch, death detection,
+  shrink-to-survive, and restore-from-checkpoint all run the real code.
 """
 
 import argparse
@@ -43,9 +52,12 @@ def mode_train(args) -> int:
     import optax
 
     from distributeddeeplearningspark_tpu import Checkpointer, PartitionedDataset, Trainer
+    from distributeddeeplearningspark_tpu import faults
     from distributeddeeplearningspark_tpu.models import LeNet5
     from distributeddeeplearningspark_tpu.train import losses
 
+    faults.die_if_dead_host_on_relaunch()  # pre-rendezvous, so the gang
+    # fails by fast exit detection, not by blocking in jax.distributed
     spark = build_session()
     rng = np.random.default_rng(0)
     examples = [
@@ -95,6 +107,106 @@ def mode_train(args) -> int:
     if jax.process_index() == 0:
         with open(os.path.join(args.ckpt_dir, "DONE"), "w") as f:
             f.write(f"{final_step} {attempt}\n")
+    return 0 if final_step >= args.steps else 4
+
+
+def _latest_step(directory: str) -> int | None:
+    """checkpoint.latest_step_in without the jax import — the host agent
+    must stay a sub-second process (its whole job is dying on time)."""
+    try:
+        steps = [int(d) for d in os.listdir(directory)
+                 if d.isdigit() and os.path.isdir(os.path.join(directory, d))]
+    except OSError:
+        return None
+    return max(steps) if steps else None
+
+
+def host_agent(args) -> int:
+    """A stand-in surviving/dying pod host (ranks > 0 of ``elastic`` mode).
+
+    No jax: it stamps the supervisor's heartbeat file, applies the
+    ``die_host`` discipline (die at the step-N checkpoint boundary on
+    attempt 0; die at startup on every later attempt — a dead machine
+    stays dead), and exits 0 once rank 0's DONE marker appears."""
+    import time
+
+    from distributeddeeplearningspark_tpu import faults
+
+    faults.die_if_dead_host_on_relaunch()
+    fault = faults.get()  # already host-gated for die_host
+    hb = os.environ.get("DLS_HEARTBEAT_FILE")
+    deadline = time.monotonic() + 600.0
+    while time.monotonic() < deadline:
+        if hb:
+            try:
+                with open(hb, "w") as f:
+                    f.write(str(os.getpid()))
+            except OSError:
+                pass
+        if fault is not None and fault.kind == "die_host":
+            latest = _latest_step(args.ckpt_dir)
+            if latest is not None and latest >= fault.step:
+                faults.crash()
+        if os.path.exists(os.path.join(args.ckpt_dir, "DONE")):
+            return 0
+        time.sleep(0.1)
+    return 5  # training host never finished nor died — drill misconfigured
+
+
+def mode_elastic(args) -> int:
+    """Rank 0: deterministic single-device training with checkpoint/resume
+    (a fixed 2-partition stream, so the batch sequence is identical at any
+    gang width); ranks > 0: :func:`host_agent`."""
+    if int(os.environ.get("DLS_PROCESS_ID", "0") or 0) != 0:
+        return host_agent(args)
+    gang_width = os.environ.get("DLS_NUM_PROCESSES", "1")
+    # solo trainer: do NOT auto-join the pod (Session would rendezvous with
+    # stand-in agents that never initialize jax.distributed)
+    os.environ.pop("DLS_COORDINATOR", None)
+    import optax
+
+    from distributeddeeplearningspark_tpu import (
+        Checkpointer,
+        PartitionedDataset,
+        Session,
+        Trainer,
+    )
+    from distributeddeeplearningspark_tpu.models import LeNet5
+    from distributeddeeplearningspark_tpu.train import losses
+
+    spark = Session.builder.master("local[1]").appName("elastic").getOrCreate()
+    rng = np.random.default_rng(0)
+    examples = [
+        {"image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+         "label": np.int32(i % 10)}
+        for i in range(256)
+    ]
+    ds = PartitionedDataset.parallelize(examples, 2).repeat()
+    ckpt = Checkpointer(args.ckpt_dir)
+    trainer = Trainer(spark, LeNet5(), losses.softmax_xent,
+                      optax.sgd(0.05, momentum=0.9), checkpointer=ckpt, seed=5)
+    data_state = None
+    if ckpt.latest_step() is not None:
+        trainer.init(trainer._sample_batch(ds, args.batch_size))
+        try:
+            _, data_state = trainer.restore()
+        except Exception:
+            from distributeddeeplearningspark_tpu.supervisor import (
+                RESTORE_FAILED_EXIT)
+
+            import traceback
+
+            traceback.print_exc()
+            return RESTORE_FAILED_EXIT
+    attempt = int(os.environ.get("DLS_RESTART", "0") or 0)
+    state, _ = trainer.fit(
+        ds, batch_size=args.batch_size, steps=args.steps, log_every=2,
+        checkpoint_every=args.checkpoint_every, data_state=data_state,
+    )
+    ckpt.wait()
+    final_step = int(jax.device_get(state.step))
+    with open(os.path.join(args.ckpt_dir, "DONE"), "w") as f:
+        f.write(f"{final_step} {attempt} {gang_width}\n")
     return 0 if final_step >= args.steps else 4
 
 
@@ -188,7 +300,8 @@ def mode_fingerprint(args) -> int:
 
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("mode", choices=["train", "desync", "fingerprint"])
+    p.add_argument("mode", choices=["train", "desync", "fingerprint",
+                                    "elastic"])
     p.add_argument("--ckpt-dir", default="/tmp/worker_ck")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--batch-size", type=int, default=32)
@@ -200,6 +313,8 @@ def main() -> int:
     args = p.parse_args()
     if args.mode == "fingerprint":
         return mode_fingerprint(args)
+    if args.mode == "elastic":
+        return mode_elastic(args)
     return mode_train(args) if args.mode == "train" else mode_desync(args)
 
 

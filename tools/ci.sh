@@ -519,6 +519,112 @@ PYEOF
   return $rc
 }
 
+# elastic smoke (ISSUE 11): the kill-a-host drill end-to-end — a 2-host
+# supervised run loses host 1 mid-run (DLS_FAULT=die_host@N, the host stays
+# dead across attempts), the supervisor shrinks the gang to the survivor
+# after 2 same-host verdicts, and training CONTINUES TO COMPLETION on 1
+# host from the last verified checkpoint; `dlstatus` must show the
+# geometry change, and an fsdp-saved → tensor-restored params round-trip
+# must be bitwise.
+run_elastic_smoke() {
+  local t0 rc wd out
+  t0=$(date +%s)
+  rc=0
+  wd=$(mktemp -d /tmp/dls_elastic_smoke.XXXXXX)
+  out=$(WD="$wd" python - <<'PYEOF'
+import json, os, subprocess, sys
+
+import numpy as np
+
+wd = os.environ["WD"]
+run_dir = os.path.join(wd, "run")
+os.makedirs(run_dir)
+worker = os.path.join("tests", "workers", "worker.py")
+
+from distributeddeeplearningspark_tpu.supervisor import Supervisor
+
+sup = Supervisor(
+    [sys.executable, worker, "elastic", "--ckpt-dir", run_dir,
+     "--steps", "18", "--checkpoint-every", "6"],
+    num_processes=2, max_restarts=4, restart_backoff_s=0.05,
+    backoff_jitter=0.0, shrink_after=2,
+    env={"XLA_FLAGS": "", "JAX_PLATFORMS": "cpu",
+         "DLS_FAULT": "die_host@9"},
+    progress_path=run_dir,
+)
+result = sup.run()
+assert result.ok, [(a.ordinal, a.returncodes, a.classification)
+                   for a in result.attempts]
+step, attempt, nprocs = open(os.path.join(run_dir, "DONE")).read().split()
+assert (int(step), int(nprocs)) == (18, 1), (step, attempt, nprocs)
+
+# dlstatus shows the shrink as a first-class event, attempts carry np=
+p = subprocess.run(
+    [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+     run_dir, "--json"], capture_output=True, text=True)
+assert p.returncode == 0, p.stderr[-500:]
+rep = json.loads(p.stdout)
+geo = [e for e in rep["recovery_events"]
+       if e.get("event") == "geometry_change"]
+assert geo and geo[0]["from_processes"] == 2 \
+    and geo[0]["to_processes"] == 1 and geo[0]["dead_host"] == 1, geo
+assert [a.get("num_processes") for a in rep["attempts"]][-1] == 1
+human = subprocess.run(
+    [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+     run_dir], capture_output=True, text=True)
+assert "geometry change: 2 -> 1" in human.stdout, human.stdout[-800:]
+
+# bitwise fsdp-saved → tensor-restored params round-trip
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import optax
+from jax.sharding import PartitionSpec as P
+
+from distributeddeeplearningspark_tpu.checkpoint import Checkpointer
+from distributeddeeplearningspark_tpu.models import LeNet5
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+from distributeddeeplearningspark_tpu.parallel.sharding import FSDP, ShardingRules
+from distributeddeeplearningspark_tpu.train import step as step_lib
+
+rng = np.random.default_rng(0)
+batch = {"image": rng.normal(0, 1, (8, 28, 28, 1)).astype(np.float32),
+         "label": rng.integers(0, 10, (8,)).astype(np.int32)}
+state, _ = step_lib.init_state(
+    LeNet5(), optax.sgd(0.1, momentum=0.9), batch,
+    MeshSpec(data=2, fsdp=4).build(), FSDP, seed=3)
+ck_dir = os.path.join(wd, "ck")
+with Checkpointer(ck_dir, async_save=False) as ck:
+    ck.save(1, state)
+    ck.wait()
+    params, _ = ck.restore_params(
+        mesh=MeshSpec(data=1, tensor=8).build(),
+        rules=ShardingRules(rules=((r"Dense_0/kernel", P(None, "tensor")),
+                                   (r"Dense_1/kernel", P("tensor", None)))))
+src = {tuple(map(str, p)): v for p, v in
+       jax.tree_util.tree_flatten_with_path(state.params)[0]}
+dst = {tuple(map(str, p)): v for p, v in
+       jax.tree_util.tree_flatten_with_path(params)[0]}
+bitwise = all(
+    np.asarray(jax.device_get(v)).tobytes()
+    == np.asarray(jax.device_get(dst[k])).tobytes()
+    for k, v in src.items())
+assert bitwise, "fsdp->tensor restore was not bitwise"
+specs = {str(l.sharding.spec) for l in jax.tree.leaves(params)}
+assert any("tensor" in s for s in specs), specs
+
+print(f"survived=1host step={step} attempts={len(result.attempts)} "
+      f"shrink=2->1 dead_host={geo[0]['dead_host']} "
+      f"resume_step={geo[0].get('step')} bitwise_fsdp->tensor=ok")
+PYEOF
+) || rc=$?
+  log elastic "${out:-elastic smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[elastic] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$wd"
+  return $rc
+}
+
 # perf-guard smoke (ISSUE 10): the regression sentinel must pass on the
 # repo's own BENCH history (rc 0) and must trip — nonzero rc, metric
 # named — when fed a synthetic 20%-slower record as the current round.
@@ -570,6 +676,7 @@ case "${1:-both}" in
   both) run_tier fast "not slow" || overall=$?
         run_tier slow "slow" || overall=$?
         run_shuffle_smoke || overall=$?
+        run_elastic_smoke || overall=$?
         run_perf_guard_smoke || overall=$? ;;
   # the recovery drills (kill-mid-finalize, poisoned restore, hang, NaN
   # spike) end-to-end — slow-marked, so the fast tier never pays for gangs
@@ -600,6 +707,10 @@ case "${1:-both}" in
   # Meter wall within 5%, finite MFU (docs/OBSERVABILITY.md "Device
   # anatomy")
   anatomy) run_anatomy_smoke || overall=$? ;;
+  # elastic recovery: kill-a-host drill (die_host@N, shrink-to-survive,
+  # completion on the survivor) + dlstatus geometry change + bitwise
+  # fsdp→tensor restore (docs/POD_PLAYBOOK.md "We lost a host")
+  elastic) run_elastic_smoke || overall=$? ;;
   # regression sentinel: BENCH history passes, synthetic 20%-slower
   # record trips rc!=0 with the metric named (tools/perf_guard.py)
   perf-guard) run_perf_guard_smoke || overall=$? ;;
@@ -607,6 +718,6 @@ case "${1:-both}" in
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|anatomy|perf-guard|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|anatomy|elastic|perf-guard|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
