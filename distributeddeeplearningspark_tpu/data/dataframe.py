@@ -562,6 +562,142 @@ def _agg_row_value(fn: str, cnt: int, stats) -> Any:
             "min": mn, "max": mx}[fn]
 
 
+def _agg_plane_layout(spec: Mapping[str, str]) -> tuple[tuple, tuple]:
+    """The columnar value-plane layout for one agg spec: plane 0 is the
+    int64 group count (combined by sum), then per non-count spec column
+    its f64 sum plane (+ a min or max plane when the fn needs one).
+    ``slots[i]`` maps spec column i back into the plane tuple —
+    ``None`` for count-only columns, else ``(sum_i, min_i, max_i)``."""
+    combines: list[str] = ["sum"]
+    slots: list = []
+    for _c, fn in spec.items():
+        if fn == "count":
+            slots.append(None)
+            continue
+        s_i = len(combines)
+        combines.append("sum")
+        m_i = x_i = None
+        if fn == "min":
+            m_i = len(combines)
+            combines.append("min")
+        elif fn == "max":
+            x_i = len(combines)
+            combines.append("max")
+        slots.append((s_i, m_i, x_i))
+    return tuple(combines), tuple(slots)
+
+
+def _agg_partial_planes(ch: Chunk, keys: Sequence[str],
+                        spec: Mapping[str, str]):
+    """One chunk's group partials as flat planes — the columnar twin of
+    :func:`_agg_partial`, staying numpy end to end (no ``.tolist()``, no
+    per-key tuples except the one hash pass). Returns ``None`` when the
+    chunk's keys are not columnar-eligible (strings, objects, NaN floats,
+    uint64) — that chunk then walks :func:`_agg_partial` and ships as
+    pickled tuples, byte-identically. The key hashes ARE computed from
+    the same python-scalar tuples the tuple path would pickle, so both
+    formats land every key in the same bucket at the same sort position."""
+    from distributeddeeplearningspark_tpu.data import exchange
+
+    key_arrays = [np.asarray(ch[k]) for k in keys]
+    if any(exchange.canon_key_dtype(a.dtype) is None for a in key_arrays):
+        return None
+    for a in key_arrays:
+        if np.issubdtype(a.dtype, np.floating):
+            # NaN keys: the tuple path refuses them with the fillna
+            # remediation (_agg_partial) — fall back so the error is
+            # THAT error, not a silently different grouping. Zeros:
+            # -0.0 == 0.0 under np.unique/dict merging but they pickle
+            # to different key bytes, and only the tuple path carries
+            # the dict-merge semantics — every ±0.0 float key goes
+            # there (a columnar +0.0 could never merge with a
+            # tuple-path -0.0 from another chunk)
+            if np.isnan(a).any():
+                return None
+            if (a == 0).any():
+                return None
+    stacked = np.stack(key_arrays, axis=1)
+    canon = exchange.canon_key_dtype(stacked.dtype)
+    if canon is None:  # mixed dtypes promoted past fixed-width numerics
+        return None
+    uniq, inv = np.unique(stacked, axis=0, return_inverse=True)
+    g = uniq.shape[0]
+    h = exchange.hash_rows(list(map(tuple, uniq.tolist())))
+    key_cols = tuple(np.ascontiguousarray(uniq[:, i]).astype(canon)
+                     for i in range(uniq.shape[1]))
+    vals: list[np.ndarray] = [
+        np.bincount(inv, minlength=g).astype(np.int64)]
+    for c, fn in spec.items():
+        if fn == "count":
+            continue
+        v = np.asarray(ch[c], np.float64)
+        vals.append(np.bincount(inv, weights=v, minlength=g))
+        if fn == "min":
+            mn = np.full(g, np.inf)
+            np.minimum.at(mn, inv, v)
+            vals.append(mn)
+        elif fn == "max":
+            mx = np.full(g, -np.inf)
+            np.maximum.at(mx, inv, v)
+            vals.append(mx)
+    return exchange._Planes(h, key_cols, tuple(vals))
+
+
+def _agg_columnar_plan(keys: Sequence[str], spec: Mapping[str, str]):
+    """The agg spec's :class:`~.exchange.ColumnarPlan` plus the slot map
+    read-side consumers decode value planes with."""
+    from distributeddeeplearningspark_tpu.data import exchange
+
+    combines, slots = _agg_plane_layout(spec)
+
+    def vals_to_acc(vs: tuple):
+        per_col = []
+        for slot in slots:
+            if slot is None:
+                per_col.append(None)
+            else:
+                s_i, m_i, x_i = slot
+                per_col.append((vs[s_i],
+                                vs[m_i] if m_i is not None else None,
+                                vs[x_i] if x_i is not None else None))
+        return (vs[0], tuple(per_col))
+
+    plan = exchange.ColumnarPlan(
+        combines=combines,
+        pre_planes=lambda ch: _agg_partial_planes(ch, keys, spec),
+        key_of_row=lambda kr: kr,
+        vals_to_acc=vals_to_acc,
+        row_emit=lambda k, vs: (k, vals_to_acc(vs)))
+    return plan, slots
+
+
+def _agg_chunk_from_planes(keys: Sequence[str], spec: Mapping[str, str],
+                           slots: tuple):
+    """Chunk builder off raw combined planes — the read-side finalize
+    (mean = sum/count happens HERE, with the same f64 division
+    :func:`_agg_row_value` performs per row, so the bytes agree)."""
+    def build(pl) -> Chunk:
+        ch: Chunk = {k: pl.keys[i] for i, k in enumerate(keys)}
+        cnt = pl.vals[0]
+        for (c, fn), slot in zip(spec.items(), slots):
+            name = f"{fn}({c})"
+            if fn == "count":
+                ch[name] = cnt
+            else:
+                s_i, m_i, x_i = slot
+                if fn == "sum":
+                    ch[name] = pl.vals[s_i]
+                elif fn == "mean":
+                    ch[name] = pl.vals[s_i] / cnt
+                elif fn == "min":
+                    ch[name] = pl.vals[m_i]
+                else:
+                    ch[name] = pl.vals[x_i]
+        return ch
+
+    return build
+
+
 class GroupedData:
     """Result of :meth:`DataFrame.groupBy`; terminal ops produce a
     single-partition DataFrame of one row per group."""
@@ -584,7 +720,8 @@ class GroupedData:
 
     def agg(self, spec: Mapping[str, str], *,
             max_groups: int | None = None,
-            num_workers: int | None = None) -> DataFrame:
+            num_workers: int | None = None,
+            transport: str | None = None) -> DataFrame:
         """``{"col": "sum"|"mean"|"min"|"max"|"count"}`` → one row per
         distinct key tuple, pyspark-style ``fn(col)`` output names.
 
@@ -599,6 +736,20 @@ class GroupedData:
         is NO cardinality ceiling; a 10M-key aggregation completes under a
         bounded memory budget. Output rows stream bucket-major in
         canonical key order, one partition per bucket.
+
+        ``transport`` (default ``DLS_SHUFFLE_TRANSPORT`` or ``auto``)
+        picks the exchange's data-plane format: ``auto``/``columnar``
+        ships numeric-key chunks as flat planes (key-hash + key columns +
+        value arrays; an order of magnitude faster at 10M keys) with
+        byte-identical per-chunk fallback to ``tuple`` for non-conforming
+        keys; ``tuple`` forces the per-key pickled path (the measurement
+        baseline); ``device`` skips the worker exchange entirely and
+        lowers the combines onto the accelerator as jitted
+        ``jax.ops.segment_*`` kernels (:mod:`~.device_agg`, compiles
+        ledgered by ``dlstatus --anatomy``) — numeric keys required,
+        result arrays driver-resident (~32B/key, no ``max_groups``
+        ceiling), output bit-equal to the exchange under the float-sum
+        exactness proviso both paths share.
 
         Serial (no workers): chunk partials merge in a DRIVER-SIDE dict —
         fine for the vocab-sized results this plane is documented for
@@ -625,15 +776,16 @@ class GroupedData:
         names = keys + [f"{f}({c})" for c, f in spec.items()]
         spec = dict(spec)
         n_out = df._chunks.num_partitions
+        transport = exchange.resolve_transport(transport, allow_device=True)
+
+        if transport == "device":
+            return _device_agg_frame(df, keys, spec, names, n_out)
 
         nw = exchange.resolve_shuffle_workers(num_workers)
         if nw:
             ex_spec = exchange._Spec(
                 pre=lambda ch: _agg_partial(ch, keys, spec),
                 combine=_merge_agg_entry)
-            recs = exchange._lazy_exchange_dataset(
-                df._chunks._parts, num_workers=nw, n_out=n_out,
-                spec=ex_spec, label="groupBy.agg")
 
             def to_chunks(it: Iterable) -> Iterator[Chunk]:
                 buf: list[tuple] = []
@@ -656,7 +808,38 @@ class GroupedData:
                 if buf:
                     yield emit(buf)
 
-            return DataFrame(recs.map_partitions(to_chunks), names)
+            if transport == "tuple":
+                recs = exchange._lazy_exchange_dataset(
+                    df._chunks._parts, num_workers=nw, n_out=n_out,
+                    spec=ex_spec, label="groupBy.agg")
+                return DataFrame(recs.map_partitions(to_chunks), names)
+
+            # columnar: share one memoized ShuffleResult so columnar
+            # buckets build chunks STRAIGHT from the output planes (no
+            # per-row Python on the read side either); tuple-format
+            # buckets (mixed-eligibility datasets) fall back to the row
+            # reader — same bytes, chunked at the block size instead
+            plan, slots = _agg_columnar_plan(keys, spec)
+            result = exchange.lazy_exchange(
+                df._chunks._parts, num_workers=nw, n_out=n_out,
+                spec=ex_spec, label="groupBy.agg", plan=plan)
+            from_planes = _agg_chunk_from_planes(keys, spec, slots)
+
+            def make_part(bucket: int):
+                def gen() -> Iterator[Chunk]:
+                    res = result()
+                    pit = res.iter_bucket_planes(bucket)
+                    if pit is not None:
+                        for pl in pit:
+                            if len(pl):
+                                yield from_planes(pl)
+                    else:
+                        yield from to_chunks(res.iter_bucket(bucket))
+                return gen
+
+            return DataFrame(
+                PartitionedDataset.from_generators(
+                    [make_part(b) for b in range(n_out)]), names)
 
         memo: dict = {}
 
@@ -710,6 +893,124 @@ class GroupedData:
             PartitionedDataset.from_generators(
                 [lambda: iter([result_chunk()])]),
             names)
+
+
+def _device_agg_frame(df: DataFrame, keys: list[str],
+                      spec: Mapping[str, str], names: list[str],
+                      n_out: int) -> DataFrame:
+    """``groupBy().agg(transport="device")``: serial chunk scan into
+    columnar partials, combines lowered onto the accelerator
+    (:func:`~.device_agg.segment_combine` — jitted ``jax.ops.segment_*``
+    under the PR 9 compile ledger), output in the SAME canonical
+    bucket-major key-hash order as the exchange, so the bytes agree.
+
+    Partials compact against ``DLS_SHUFFLE_MEM_MB`` as they accumulate
+    (each compaction is itself a device combine), so the scan's resident
+    set is bounded; the RESULT is driver-resident flat arrays (~32 bytes
+    per key — no ``max_groups`` ceiling, that guard exists for python
+    dict blowup, not for arrays). Emits the standard ``shuffle`` done
+    event with ``transport="device"`` plus the map/merge phase pair, so
+    ``dlstatus`` renders it like any exchange."""
+    import time as _time
+
+    from distributeddeeplearningspark_tpu import telemetry
+    from distributeddeeplearningspark_tpu.data import device_agg, exchange
+
+    plan, slots = _agg_columnar_plan(keys, spec)
+    from_planes = _agg_chunk_from_planes(keys, spec, slots)
+    memo: dict = {}
+
+    def buckets() -> dict:
+        if "b" in memo:
+            return memo["b"]
+        if not device_agg.available():
+            raise RuntimeError(
+                "transport='device' needs a usable jax backend "
+                "(data/device_agg.py probe failed — see its warning); "
+                "use transport='columnar' with DLS_DATA_WORKERS instead")
+        budget = exchange.mem_budget_bytes()
+        t0 = _time.perf_counter()
+        telemetry.emit("phase", name="shuffle-map", edge="begin",
+                       op="groupBy.agg")
+        batches: list = []
+        held = elems = pairs = moved = 0
+        # compaction threshold doubles when a combine fails to shrink
+        # below it (distinct keys legitimately outgrowing the budget) —
+        # otherwise EVERY later chunk would re-sort the whole accumulated
+        # set and the scan would go quadratic in chunk count
+        compact_at = budget
+        aborted = True
+        try:
+            for ch in df._iter_chunks():
+                elems += 1
+                pl = plan.pre_planes(ch)
+                if pl is None:
+                    raise ValueError(
+                        f"transport='device' needs numeric (int/float/"
+                        f"bool, non-NaN, no ±0.0 floats) groupBy keys; "
+                        f"{keys} do not conform — fillna()/hash_bucket "
+                        f"them first, or use transport='columnar', whose "
+                        f"per-chunk tuple fallback handles them")
+                pairs += len(pl)
+                moved += pl.nbytes
+                batches.append(pl)
+                held += pl.nbytes
+                if held >= compact_at and len(batches) > 1:
+                    batches = [device_agg.segment_combine(
+                        exchange._Planes.concat(batches), plan)]
+                    held = batches[0].nbytes
+                    while held >= compact_at:
+                        compact_at *= 2
+            aborted = False
+        finally:
+            map_s = _time.perf_counter() - t0
+            telemetry.emit("phase", name="shuffle-map", edge="end",
+                           dur_s=map_s, op="groupBy.agg",
+                           **({"aborted": True} if aborted else {}))
+        t1 = _time.perf_counter()
+        telemetry.emit("phase", name="shuffle-merge", edge="begin",
+                       op="groupBy.agg")
+        aborted = True
+        try:
+            out: dict[int, Any] = {}
+            if batches:
+                combined = device_agg.segment_combine(
+                    exchange._Planes.concat(batches), plan)
+                out = {b: sub for b, sub
+                       in exchange._bucket_split(combined, n_out)}
+            aborted = False
+        finally:
+            merge_s = _time.perf_counter() - t1
+            telemetry.emit("phase", name="shuffle-merge", edge="end",
+                           dur_s=merge_s, op="groupBy.agg",
+                           **({"aborted": True} if aborted else {}))
+        rows_list = [len(out.get(b, ())) for b in range(n_out)]
+        telemetry.emit(
+            "shuffle", edge="done", op="groupBy.agg", workers=0,
+            reducers=0, buckets=n_out, elems_in=elems, pairs_in=pairs,
+            rows_out=sum(rows_list), bytes_moved=moved, overflow=0,
+            spills=0, spill_bytes=0, map_s=round(map_s, 3),
+            merge_s=round(merge_s, 3), bucket_rows=rows_list,
+            mem_budget_mb=round(budget / (1 << 20), 1),
+            transport="device", columnar_pairs=pairs,
+            columnar_bytes=moved, tuple_pairs=0, tuple_bytes=0,
+            columnar_buckets=sum(1 for r in rows_list if r),
+            tuple_buckets=0)
+        memo["b"] = out
+        return out
+
+    def make_part(bucket: int):
+        def gen() -> Iterator[Chunk]:
+            pl = buckets().get(bucket)
+            if pl is not None and len(pl):
+                for lo in range(0, len(pl), DEFAULT_CHUNK_ROWS):
+                    yield from_planes(
+                        pl.cut(lo, min(lo + DEFAULT_CHUNK_ROWS, len(pl))))
+        return gen
+
+    return DataFrame(
+        PartitionedDataset.from_generators(
+            [make_part(b) for b in range(n_out)]), names)
 
 
 # ---------------------------------------------------------------------------

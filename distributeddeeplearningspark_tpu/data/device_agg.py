@@ -1,0 +1,236 @@
+"""Device-side segment-reduce aggregation — the combines leave the host.
+
+The columnar exchange (:mod:`~.exchange`, ISSUE 12) made the shuffle's
+data plane flat arrays; this module takes the last step the DrJAX framing
+(PAPERS.md 2403.07128) points at: once the reduce phase's input is a
+hash-sorted plane with segment boundaries, the numeric combines —
+``count`` / ``sum`` / ``min`` / ``max`` (``mean`` derives from sum/count
+at read time) and the top-V vocab filter — are exactly
+``jax.ops.segment_*`` / ``jax.lax.top_k`` kernels. ``groupBy().agg(
+transport="device")`` runs them here, and the DLRM feature pipeline's
+vocab build streams its top-V selection through :class:`TopV`.
+
+Three disciplines keep this honest:
+
+- **Bit-exactness.** Kernels trace under ``jax.experimental.enable_x64``
+  so float64 sums stay float64 (this repo otherwise runs x32); the final
+  division for ``mean`` happens host-side with the identical formula the
+  tuple path uses. The usual proviso carries over unchanged from the
+  exchange: float sums are bit-equal across paths while the values make
+  the sum exact (integer-valued f64, magnitudes < 2^53) — min/max/count
+  are order-free and always exact.
+- **No recompiles on warm repeats.** Every kernel input pads to a pow-2
+  ladder (data length AND segment count), so steady workloads reuse one
+  executable per (op, size bucket); kernels are wrapped in the PR 9
+  compile ledger (:func:`~..telemetry.anatomy.instrument`) with a
+  generous ``expected_signatures``, so every compile is a ledgered,
+  cost-analyzed ``compile`` event in ``dlstatus --anatomy`` and a repeat
+  at the same shapes compiles NOTHING.
+- **Graceful absence.** No jax / no x64 context → :func:`available` is
+  False and callers (dataframe agg, the DLRM example) keep their host
+  paths; :func:`segment_combine` itself falls back to the exchange's
+  ``reduceat`` fold — same bytes, no device.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Sequence
+
+import numpy as np
+
+from distributeddeeplearningspark_tpu.data import exchange
+
+logger = logging.getLogger(__name__)
+
+#: pad floor: below this, padding overhead dwarfs the work and the ladder
+#: would mint one executable per tiny size.
+_MIN_PAD = 1 << 10
+#: per-kernel distinct-signature allowance: the pow-2 ladder bounds the
+#: genuine signature set well under this, so a flagged recompile is a
+#: real bug (same signature compiled twice), never ladder noise.
+_EXPECTED_SIGS = 64
+
+_kernels: dict[tuple, Any] = {}
+_state: dict[str, Any] = {"available": None}
+
+
+def _pad_len(n: int) -> int:
+    return max(_MIN_PAD, 1 << max(0, int(n - 1)).bit_length())
+
+
+def available() -> bool:
+    """Can the device path run here? (jax importable, an x64 scope
+    available, at least one device.) Cached per process."""
+    if _state["available"] is None:
+        try:
+            import jax
+
+            jax.experimental.enable_x64  # noqa: B018 — probe the attr
+            _state["available"] = bool(jax.devices())
+        except Exception as e:  # noqa: BLE001 — any failure = host path
+            logger.warning("device_agg unavailable (%s: %s) — callers "
+                           "keep their host combine paths",
+                           type(e).__name__, e)
+            _state["available"] = False
+    return _state["available"]
+
+
+def _x64():
+    import jax
+
+    return jax.experimental.enable_x64()
+
+
+def _identity(op: str, dtype: np.dtype):
+    if op == "sum":
+        return 0
+    if dtype.kind == "f":
+        return np.inf if op == "min" else -np.inf
+    info = np.iinfo(dtype)
+    return info.max if op == "min" else info.min
+
+
+def _segment_kernel(op: str, nseg_pad: int):
+    key = ("seg", op, nseg_pad)
+    if key not in _kernels:
+        import jax
+
+        from distributeddeeplearningspark_tpu.telemetry.anatomy import (
+            instrument)
+
+        def fn(data, seg_ids, _op=op, _n=nseg_pad):
+            if _op == "sum":
+                return jax.ops.segment_sum(
+                    data, seg_ids, num_segments=_n, indices_are_sorted=True)
+            if _op == "min":
+                return jax.ops.segment_min(
+                    data, seg_ids, num_segments=_n, indices_are_sorted=True)
+            return jax.ops.segment_max(
+                data, seg_ids, num_segments=_n, indices_are_sorted=True)
+
+        _kernels[key] = instrument(
+            jax.jit(fn), name=f"device_agg.segment_{op}",
+            expected_signatures=_EXPECTED_SIGS)
+    return _kernels[key]
+
+
+def segment_reduce(op: str, values: np.ndarray, seg_ids: np.ndarray,
+                   nseg: int) -> np.ndarray:
+    """One plane's device fold: ``values`` (sorted so equal segments are
+    adjacent) reduce into ``nseg`` outputs. Pads both axes to the pow-2
+    ladder (pad rows target a trash segment past ``nseg``) and slices the
+    real segments back out."""
+    if op not in exchange.NUMERIC_COMBINES:
+        raise ValueError(f"op {op!r} not in {exchange.NUMERIC_COMBINES}")
+    n = len(values)
+    n_pad = _pad_len(n)
+    nseg_pad = _pad_len(nseg + 1)
+    data = np.full(n_pad, _identity(op, values.dtype), dtype=values.dtype)
+    data[:n] = values
+    ids = np.full(n_pad, nseg, dtype=np.int32)
+    ids[:n] = seg_ids
+    import jax.numpy as jnp
+
+    with _x64():
+        out = np.asarray(
+            _segment_kernel(op, nseg_pad)(jnp.asarray(data),
+                                          jnp.asarray(ids)))
+    return out[:nseg]
+
+
+def segment_combine(pl: "exchange._Planes",
+                    plan: "exchange.ColumnarPlan") -> "exchange._Planes":
+    """The exchange's sort-and-fold, with the folds on device: stable
+    argsort by ``key_hash`` (host — ordering is control flow, combining is
+    the FLOP work), then one :func:`segment_reduce` per value plane. Hash
+    collisions (distinct keys, equal digest) drop to the exchange's
+    full-key-compare path, and an unavailable device degrades to its
+    ``reduceat`` fold — all three produce identical bytes."""
+    n = len(pl)
+    if n == 0:
+        return pl
+    pl, starts, seg_id, collision = exchange.sorted_segments(pl)
+    if collision:
+        return exchange._combine_colliding(pl, plan)
+    if len(starts) == n:
+        return pl
+    if not available():
+        return exchange.combine_planes(pl, plan, assume_sorted=True)
+    ids = seg_id.astype(np.int32)
+    nseg = len(starts)
+    out_vals = tuple(
+        segment_reduce(op, col, ids, nseg)
+        for col, op in zip(pl.vals, plan.combines))
+    return exchange._Planes(pl.h[starts],
+                            tuple(a[starts] for a in pl.keys), out_vals)
+
+
+class TopV:
+    """Streaming device top-V filter — the vocab build's reduce phase.
+
+    Feed ``update(scores, payloads)`` blocks (token counts + the tokens
+    themselves); the running top-``v`` set lives in two small host arrays
+    and every selection round is ONE ``jax.lax.top_k`` over a
+    fixed-shape candidate buffer (kept ∪ block, padded to a constant
+    length — so the whole stream compiles exactly one executable and warm
+    repeats compile none). Tie-breaking matches the host heap it
+    replaces: candidates pre-sort by payload descending, and ``top_k``'s
+    lowest-index tie rule then prefers the larger payload — the
+    ``(count, token)`` ordering ``examples/dlrm_features.py`` has always
+    used. ``ranked()`` returns ``[(score, payload), ...]`` best-first.
+    """
+
+    def __init__(self, v: int, block: int = 65536):
+        if v < 1:
+            raise ValueError(f"v must be >= 1, got {v}")
+        self.v = int(v)
+        self.block = int(block)
+        self._cap = _pad_len(self.v + self.block)
+        self._scores = np.empty(0, dtype=np.int64)
+        self._payloads: np.ndarray | None = None  # dtype from first block
+
+    def _topk_kernel(self):
+        key = ("topk", self.v, self._cap)
+        if key not in _kernels:
+            import jax
+
+            from distributeddeeplearningspark_tpu.telemetry.anatomy import (
+                instrument)
+
+            def fn(x, _k=self.v):
+                return jax.lax.top_k(x, _k)
+
+            _kernels[key] = instrument(
+                jax.jit(fn), name="device_agg.top_v",
+                expected_signatures=_EXPECTED_SIGS)
+        return _kernels[key]
+
+    def update(self, scores: Sequence[int], payloads: Sequence) -> None:
+        scores = np.asarray(scores, dtype=np.int64)
+        payloads = np.asarray(payloads)
+        if self._payloads is None:
+            self._payloads = payloads[:0]
+        for off in range(0, len(scores), self.block):
+            s = np.concatenate([self._scores, scores[off:off + self.block]])
+            p = np.concatenate([self._payloads,
+                                payloads[off:off + self.block]])
+            order = np.argsort(p, kind="stable")[::-1]  # tie-break: payload desc
+            s, p = s[order], p[order]
+            n = len(s)
+            pad = np.full(self._cap, np.iinfo(np.int64).min, dtype=np.int64)
+            pad[:n] = s
+            import jax.numpy as jnp
+
+            with _x64():
+                _vals, idx = self._topk_kernel()(jnp.asarray(pad))
+            idx = np.asarray(idx)
+            idx = idx[idx < n][:self.v]
+            self._scores, self._payloads = s[idx], p[idx]
+
+    def ranked(self) -> list[tuple[int, Any]]:
+        if self._payloads is None or not len(self._scores):
+            return []
+        order = np.lexsort((self._payloads, self._scores))[::-1]
+        return list(zip(self._scores[order].tolist(),
+                        self._payloads[order].tolist()))

@@ -52,13 +52,40 @@ sort back to encounter order at emit.
 merge`` phase spans (lowered into the PR 7 span model like any phase) and
 mirrors reducer spills plus a final summary as ``shuffle`` gauge events —
 ``dlstatus`` renders them as the shuffle block (bytes moved, spill count,
-per-bucket skew, slowest-bucket verdict).
+per-bucket skew, slowest-bucket verdict, per-format byte/key split).
+
+**Columnar transport (ISSUE 12).** Per-key pickled tuples cap the agg
+path at ~35–70k keys/s/worker — at 10M keys the data plane, not the
+combine math, is the bottleneck. When an operation declares a
+:class:`ColumnarPlan` (``groupBy().agg`` with numeric keys;
+``reduce_by_key``/``distinct`` over plain int/float scalars with a
+declared numeric combine), conforming batches travel as **flat planes**
+instead: a ``key_hash`` uint64 array (the first 8 bytes of
+:func:`key_bytes`, so bucketing and ordering stay IDENTICAL to the tuple
+path), the key columns, and one value array per combine — whole arrays
+pickled once and shipped through the same shm arenas, metered by their
+exact ``nbytes`` (:meth:`_ByteMeter.add_exact` — the every-64th-item
+sampling that keeps tuple accounting cheap would under-throttle a 16MB
+plane). Map side, flushes sort by hash and segment-combine with
+``np.argsort``/``ufunc.reduceat`` (no per-key Python); reduce side,
+bucket planes merge by sorted hash, spill runs are columnar block files
+k-way merged on the hash column, and hash collisions (2⁻⁶⁴, but tested)
+resolve by full-key compare against the pickled key bytes. Batches that
+do NOT conform (object keys, mixed value types) fall back to the tuple
+path per batch, and a bucket that receives both formats degrades to
+tuple merging — output is byte-identical to an all-tuple run either
+way, which is the whole contract: ``DLS_SHUFFLE_TRANSPORT=tuple``
+exists only to measure the difference. The numeric combines themselves
+can additionally be lowered onto the accelerator via
+:mod:`~.device_agg` (``groupBy().agg(transport="device")``), whose
+jitted ``jax.ops.segment_*`` kernels ride the PR 9 compile ledger.
 """
 
 from __future__ import annotations
 
 import hashlib
 import heapq
+import math
 import multiprocessing as mp
 import os
 import pickle
@@ -95,6 +122,18 @@ SPILL_DIR_ENV = "DLS_SHUFFLE_SPILL_DIR"
 #: point of it).
 MAX_GROUPS_ENV = "DLS_AGG_MAX_GROUPS"
 _DEFAULT_MAX_GROUPS = 1_000_000
+#: env knob: transport override for eligible wide ops — ``auto`` (default:
+#: columnar where batches conform, tuple elsewhere), ``columnar`` (alias of
+#: auto — non-conforming batches still fall back, byte-identically),
+#: ``tuple`` (force the per-key pickled path; the measurement baseline), or
+#: ``device`` (groupBy.agg only: serial scan + jitted segment-reduce
+#: combines, data/device_agg.py).
+TRANSPORT_ENV = "DLS_SHUFFLE_TRANSPORT"
+TRANSPORTS = ("auto", "tuple", "columnar", "device")
+#: declared numeric combines a ColumnarPlan can vectorize. "count" is a
+#: sum of int64 count planes, "mean" derives from (sum, count) at read
+#: time — both reduce to these three.
+NUMERIC_COMBINES = ("sum", "min", "max")
 
 _PICKLE_PROTO = 4
 #: per-reducer metadata queue bound: flush payloads in flight beyond the
@@ -104,6 +143,14 @@ _QUEUE_AHEAD = 16
 _ALLOC_WAIT_S = 0.25
 _MIN_ARENA = 1 << 20
 _MIN_CAP = 1 << 18
+#: rdd-pair columnar mode: pairs buffered before a vectorization attempt
+#: (conformance is judged per batch — one odd batch degrades itself, not
+#: the whole shuffle).
+_PAIR_BATCH = 8192
+#: row cap per pickled plane block in columnar spill runs / output files —
+#: the unit the k-way merge streams, so merge residency is O(streams ×
+#: block), never O(run).
+_COLS_BLOCK_ROWS = 131_072
 
 
 def max_groups_limit(explicit: int | None = None) -> int:
@@ -127,6 +174,27 @@ def resolve_shuffle_workers(num_workers: int | None) -> int:
                       "method is unavailable; using the serial path")
         return 0
     return nw
+
+
+def resolve_transport(explicit: str | None = None, *,
+                      allow_device: bool = False) -> str:
+    """Shuffle transport: explicit value wins, else ``DLS_SHUFFLE_TRANSPORT``,
+    else ``auto``. ``device`` is only meaningful where the caller supports
+    it (groupBy.agg); elsewhere it resolves to ``auto`` — the env knob must
+    never break an ineligible op."""
+    t = explicit or os.environ.get(TRANSPORT_ENV, "") or "auto"
+    t = t.strip().lower()
+    if t not in TRANSPORTS:
+        raise ValueError(
+            f"unknown shuffle transport {t!r}; choose one of {TRANSPORTS}")
+    if t == "device" and not allow_device:
+        if explicit:
+            raise ValueError(
+                "transport='device' is only supported by groupBy().agg "
+                "numeric combines (data/device_agg.py); use 'auto', "
+                "'columnar', or 'tuple' here")
+        return "auto"
+    return t
 
 
 def mem_budget_bytes(explicit_mb: float | None = None) -> int:
@@ -182,7 +250,17 @@ class _ByteMeter:
     ``add`` re-measures the item with :func:`_approx_nbytes` and the
     in-between items are charged the rolling estimate. ``value`` tracks
     the store's resident bytes well enough to bound memory (the budget's
-    contract), at 1/64th the walk cost."""
+    contract), at 1/64th the walk cost.
+
+    Columnar planes do NOT go through the sampler: a shipped plane is one
+    array whose size is already known exactly, and charging it the rolling
+    per-tuple estimate would book a 16MB plane as ~200 bytes — the sampled
+    heuristic exists to dodge recursive size walks, not to excuse
+    under-throttling against ``DLS_SHUFFLE_MEM_MB``. The mapper charges
+    planes through :meth:`add_exact` on a dedicated meter; the reducer
+    keeps the same exact-``nbytes`` accounting as per-bucket tallies
+    (spilling a bucket must subtract exactly its planes, which one
+    aggregate counter cannot express)."""
 
     __slots__ = ("value", "_est", "_n")
 
@@ -196,6 +274,11 @@ class _ByteMeter:
         if self._n & 0x3F == 1:
             self._est = float(_approx_nbytes(item))
         self.value += self._est + overhead
+
+    def add_exact(self, nbytes: int) -> None:
+        """Charge a known size verbatim — no sampling, no estimate drift
+        (whole shipped planes: one array, exact ``nbytes``)."""
+        self.value += float(nbytes)
 
     def reset(self) -> None:
         self.value = 0.0
@@ -252,6 +335,417 @@ def _distinct_spec() -> _Spec:
 
 
 # ---------------------------------------------------------------------------
+# columnar transport (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def canon_key_dtype(dt: np.dtype) -> np.dtype | None:
+    """The dtype a key column lands in after the tuple path's
+    ``.tolist()`` → ``np.asarray(python scalars)`` round trip — int kinds
+    widen to int64, floats to float64, bool stays bool. ``None`` = not a
+    fixed-width columnar-eligible key dtype (objects, strings, uint64
+    whose values could exceed int64). BOTH paths must emit THESE dtypes
+    or bit-identity dies on a dtype byte."""
+    dt = np.dtype(dt)
+    if dt.kind == "i" or (dt.kind == "u" and dt.itemsize < 8):
+        return np.dtype(np.int64)
+    if dt.kind == "f":
+        return np.dtype(np.float64)
+    if dt.kind == "b":
+        return np.dtype(np.bool_)
+    return None
+
+
+def hash_rows(keys: Sequence[Any]) -> np.ndarray:
+    """``key_hash`` plane for a batch of PYTHON keys: the uint64 big-endian
+    read of each key's :func:`key_bytes` 8-byte digest prefix — so
+    ``hash % n_out`` IS :func:`bucket_of` and ascending-hash order IS
+    ascending ``key_bytes`` order (collisions excepted; those resolve by
+    the full pickled bytes, rare path below). Routed through
+    :func:`key_bytes` on purpose: one source of truth, and tests can
+    force collisions by patching it."""
+    return np.fromiter(
+        (int.from_bytes(key_bytes(k)[:8], "big") for k in keys),
+        dtype=np.uint64, count=len(keys))
+
+
+class _Planes:
+    """One columnar batch: aligned flat arrays — ``h`` (uint64 key hash),
+    ``keys`` (one array per key column, canonical dtypes), ``vals`` (one
+    array per combine plane). The unit that ships whole through the shm
+    arenas and is metered by its exact ``nbytes``."""
+
+    __slots__ = ("h", "keys", "vals")
+
+    def __init__(self, h: np.ndarray, keys: tuple, vals: tuple):
+        self.h = h
+        self.keys = tuple(keys)
+        self.vals = tuple(vals)
+
+    def __len__(self) -> int:
+        return len(self.h)
+
+    @property
+    def nbytes(self) -> int:
+        return (self.h.nbytes + sum(a.nbytes for a in self.keys)
+                + sum(a.nbytes for a in self.vals))
+
+    def take(self, idx) -> "_Planes":
+        return _Planes(self.h[idx], tuple(a[idx] for a in self.keys),
+                       tuple(a[idx] for a in self.vals))
+
+    def cut(self, lo: int, hi: int) -> "_Planes":
+        return self.take(slice(lo, hi))
+
+    def dtype_sig(self) -> tuple:
+        return (tuple(a.dtype.str for a in self.keys),
+                tuple(a.dtype.str for a in self.vals))
+
+    def payload(self) -> tuple:
+        """The picklable wire/disk form (a plain tuple, no class on the
+        wire — a reducer from a future version must still read it)."""
+        return ("cols", self.h, self.keys, self.vals)
+
+    @staticmethod
+    def from_payload(rec: tuple) -> "_Planes":
+        return _Planes(rec[1], rec[2], rec[3])
+
+    @staticmethod
+    def concat(planes: "Sequence[_Planes]") -> "_Planes":
+        if len(planes) == 1:
+            return planes[0]
+        return _Planes(
+            np.concatenate([p.h for p in planes]),
+            tuple(np.concatenate([p.keys[i] for p in planes])
+                  for i in range(len(planes[0].keys))),
+            tuple(np.concatenate([p.vals[i] for p in planes])
+                  for i in range(len(planes[0].vals))))
+
+
+class ColumnarPlan:
+    """How one wide op's batches become planes (and back).
+
+    ``combines`` names the vectorized fold per value plane (``sum`` /
+    ``min`` / ``max``). ``pre_planes(elem)`` turns a source element into a
+    :class:`_Planes` batch (unique keys within the batch, hashes filled) or
+    ``None`` when the element does not conform — that element then walks
+    the tuple path via ``spec.pre``, byte-identically. When ``pre_planes``
+    is absent the mapper batches raw ``(key, value)`` pairs and calls
+    ``pair_planes`` per batch (the rdd ops). The tuple-interop trio —
+    ``key_of_row`` / ``vals_to_acc`` / ``row_emit`` — lets a mixed-format
+    bucket degrade to tuple merging and lets generic consumers iterate
+    rows off a columnar output file."""
+
+    __slots__ = ("combines", "pre_planes", "pair_planes", "key_of_row",
+                 "vals_to_acc", "row_emit")
+
+    def __init__(self, *, combines: Sequence[str], pre_planes=None,
+                 pair_planes=None, key_of_row=None, vals_to_acc=None,
+                 row_emit=None):
+        for c in combines:
+            if c not in NUMERIC_COMBINES:
+                raise ValueError(
+                    f"combine {c!r} not in {NUMERIC_COMBINES}")
+        self.combines = tuple(combines)
+        self.pre_planes = pre_planes
+        self.pair_planes = pair_planes
+        self.key_of_row = (key_of_row if key_of_row is not None
+                           else (lambda kv: kv[0]))
+        self.vals_to_acc = (vals_to_acc if vals_to_acc is not None
+                            else (lambda vs: vs[0] if vs else None))
+        self.row_emit = (row_emit if row_emit is not None
+                         else (lambda k, vs: (k, vs[0])))
+
+    # -- tuple interop ------------------------------------------------------
+
+    def entries_from_planes(self, pl: _Planes) -> list:
+        """Planes → the tuple path's ``(kb, key, acc)`` entries (the
+        degrade direction for a mixed-format bucket; also feeds columnar
+        spill runs into a tuple-mode heapq merge). Entries come out in the
+        planes' (hash, kb) order, which IS kb order."""
+        key_lists = [a.tolist() for a in self.keys_as_python(pl)]
+        val_lists = [a.tolist() for a in pl.vals]
+        out = []
+        for i in range(len(pl)):
+            key = self.key_of_row(tuple(col[i] for col in key_lists))
+            out.append((key_bytes(key), key,
+                        self.vals_to_acc(tuple(v[i] for v in val_lists))))
+        return out
+
+    def keys_as_python(self, pl: _Planes) -> tuple:
+        return pl.keys
+
+    def rows_from_planes(self, pl: _Planes) -> Iterator:
+        """Output rows off a columnar bucket file, matching what
+        ``spec.final`` emits on the tuple path (python scalars — a
+        consumer comparing ``5 == np.int64(5)`` is fine, one pickling the
+        row is not)."""
+        key_lists = [a.tolist() for a in pl.keys]
+        val_lists = [a.tolist() for a in pl.vals]
+        for i in range(len(pl)):
+            yield self.row_emit(
+                self.key_of_row(tuple(col[i] for col in key_lists)),
+                tuple(v[i] for v in val_lists))
+
+
+def _cmp_view(a: np.ndarray) -> np.ndarray:
+    """Bitwise-comparable view of a key column (float NaN != NaN would
+    false-positive the collision check; the tuple path compares pickled
+    bytes, i.e. bit patterns)."""
+    if a.dtype.kind == "f":
+        return a.view(np.uint64 if a.dtype.itemsize == 8 else np.uint32)
+    return a
+
+
+def sorted_segments(pl: _Planes, *, assume_sorted: bool = False
+                    ) -> tuple[_Planes, np.ndarray, np.ndarray, bool]:
+    """The shared segment prologue for EVERY plane fold (host
+    ``combine_planes`` and the device :mod:`~.device_agg` path — one
+    source of truth, because the collision check is the bit-identity-
+    critical step): stable-sort by ``key_hash`` and return
+    ``(sorted planes, segment starts, per-row segment id, collision)``.
+    ``collision=True`` means an equal-hash run holds DIFFERENT keys (a
+    digest collision) — the caller must fold through
+    :func:`_combine_colliding`, which orders by the full pickled key
+    bytes, the tuple path's exact tie-break."""
+    n = len(pl)
+    if not assume_sorted:
+        order = np.argsort(pl.h, kind="stable")
+        pl = pl.take(order)
+    changed = np.empty(n, dtype=bool)
+    changed[0] = True
+    np.not_equal(pl.h[1:], pl.h[:-1], out=changed[1:])
+    starts = np.flatnonzero(changed)
+    seg_id = np.cumsum(changed) - 1
+    collision = False
+    if len(starts) < n:
+        # same hash, same key? (the overwhelmingly common duplicate case)
+        for col in pl.keys:
+            cv = _cmp_view(col)
+            if not np.array_equal(cv, cv[starts][seg_id]):
+                collision = True
+                break
+    return pl, starts, seg_id, collision
+
+
+def combine_planes(pl: _Planes, plan: ColumnarPlan,
+                   *, assume_sorted: bool = False) -> _Planes:
+    """Sort a batch by ``key_hash`` (stable) and fold equal-key runs with
+    the plan's vectorized combines (``np.add.reduceat`` /
+    ``np.minimum.reduceat`` / ``np.maximum.reduceat`` — C loops, no
+    per-key Python). Equal-hash runs holding DIFFERENT keys (a digest
+    collision) drop to :func:`_combine_colliding`, which orders and folds
+    by the full pickled key bytes — the tuple path's exact tie-break."""
+    n = len(pl)
+    if n == 0:
+        return pl
+    pl, starts, _seg_id, collision = sorted_segments(
+        pl, assume_sorted=assume_sorted)
+    if collision:
+        return _combine_colliding(pl, plan)
+    out_vals = []
+    for col, op in zip(pl.vals, plan.combines):
+        if len(starts) == n:
+            out_vals.append(col)
+        elif op == "sum":
+            out_vals.append(np.add.reduceat(col, starts))
+        elif op == "min":
+            out_vals.append(np.minimum.reduceat(col, starts))
+        else:
+            out_vals.append(np.maximum.reduceat(col, starts))
+    if len(starts) == n:
+        return pl
+    return _Planes(pl.h[starts], tuple(a[starts] for a in pl.keys),
+                   tuple(out_vals))
+
+
+def _combine_colliding(pl: _Planes, plan: ColumnarPlan) -> _Planes:
+    """The 2⁻⁶⁴ path: at least one hash run holds distinct keys. Fold the
+    whole batch per full key in Python, ordered by complete
+    :func:`key_bytes` (digest + pickled key — the tuple path's total
+    order), and rebuild planes. Correctness over speed: production never
+    lands here; the collision tests force it."""
+    key_lists = [a.tolist() for a in pl.keys]
+    val_lists = [a.tolist() for a in pl.vals]
+    acc: dict[bytes, list] = {}
+    for i in range(len(pl)):
+        key_row = tuple(col[i] for col in key_lists)
+        kb = key_bytes(plan.key_of_row(key_row))
+        vals = [v[i] for v in val_lists]
+        ent = acc.get(kb)
+        if ent is None:
+            acc[kb] = [key_row, vals]
+        else:
+            held = ent[1]
+            for j, op in enumerate(plan.combines):
+                if op == "sum":
+                    held[j] = held[j] + vals[j]
+                elif op == "min":
+                    held[j] = min(held[j], vals[j])
+                else:
+                    held[j] = max(held[j], vals[j])
+    ordered = sorted(acc.items(), key=lambda t: t[0])
+    h = np.fromiter((int.from_bytes(kb[:8], "big") for kb, _ in ordered),
+                    dtype=np.uint64, count=len(ordered))
+    keys = tuple(
+        np.asarray([ent[0][c] for _, ent in ordered], dtype=pl.keys[c].dtype)
+        for c in range(len(pl.keys)))
+    vals = tuple(
+        np.asarray([ent[1][j] for _, ent in ordered], dtype=pl.vals[j].dtype)
+        for j in range(len(pl.vals)))
+    return _Planes(h, keys, vals)
+
+
+def _bucket_split(pl: _Planes, n_out: int) -> Iterator[tuple[int, _Planes]]:
+    """Hash-sorted planes → (bucket, sub-planes) runs, bucket-major with
+    hash order preserved inside each bucket (stable argsort over
+    ``h % n_out`` of already-hash-sorted rows = the canonical layout)."""
+    if len(pl) == 0:
+        return
+    bucket = (pl.h % np.uint64(n_out)).astype(np.int64)
+    order = np.argsort(bucket, kind="stable")
+    pl = pl.take(order)
+    bucket = bucket[order]
+    edges = np.flatnonzero(np.r_[True, bucket[1:] != bucket[:-1]])
+    bounds = list(edges) + [len(pl)]
+    for i, lo in enumerate(bounds[:-1]):
+        yield int(bucket[lo]), pl.cut(lo, bounds[i + 1])
+
+
+def _merge_cols_streams(streams: list, plan: ColumnarPlan
+                        ) -> Iterator[_Planes]:
+    """K-way merge of hash-sorted plane streams (spill-run block iterators
+    plus the in-memory remainder), yielding combined blocks in hash order.
+    Classic min-of-buffered-maxes frontier: rows strictly below the
+    smallest buffered maximum of any live stream are complete and emit;
+    rows at the frontier wait for the stream that set it to buffer
+    another block. Residency is O(streams × block), never O(run)."""
+    bufs: list[_Planes | None] = [None] * len(streams)
+    alive = [True] * len(streams)
+
+    def fill(i: int) -> None:
+        while alive[i] and (bufs[i] is None or len(bufs[i]) == 0):
+            try:
+                blk = next(streams[i])
+            except StopIteration:
+                alive[i] = False
+                return
+            bufs[i] = (blk if bufs[i] is None or len(bufs[i]) == 0
+                       else _Planes.concat([bufs[i], blk]))
+
+    for i in range(len(streams)):
+        fill(i)
+    while True:
+        live = [i for i in range(len(streams)) if alive[i]]
+        if not live:
+            rest = [b for b in bufs if b is not None and len(b)]
+            if rest:
+                yield combine_planes(_Planes.concat(rest), plan)
+            return
+        thresh = min(bufs[i].h[-1] for i in live)
+        parts = []
+        for i, b in enumerate(bufs):
+            if b is None or len(b) == 0:
+                continue
+            cut = int(np.searchsorted(b.h, thresh, side="left"))
+            if cut:
+                parts.append(b.cut(0, cut))
+                bufs[i] = b.cut(cut, len(b))
+        if parts:
+            yield combine_planes(_Planes.concat(parts), plan)
+        # advance every live stream sitting AT the frontier — next loop's
+        # threshold must strictly grow or a stream must die
+        for i in live:
+            if len(bufs[i]) == 0 or bufs[i].h[-1] == thresh:
+                blk = None
+                try:
+                    blk = next(streams[i])
+                except StopIteration:
+                    alive[i] = False
+                if blk is not None:
+                    bufs[i] = (_Planes.concat([bufs[i], blk])
+                               if len(bufs[i]) else blk)
+
+
+# -- rdd-side plan factories (the dataframe builds its own in agg) ----------
+
+
+def _scalar_batch(keys: list, vals: list | None, combines) -> _Planes | None:
+    """Vectorize one rdd pair batch, or ``None`` when it does not conform:
+    every key must be a plain python ``int``, ``float``, or ``bool`` and
+    every value a plain ``int`` or ``float``, type-uniform per batch —
+    exact types, because the tuple path pickles the ORIGINAL objects and
+    ``np.int64(5)`` pickles differently from ``5`` (and ``True`` min/max
+    results must come back as ``True``, so bool VALUES stay tuple-path).
+    Two more exactness guards: under ``combine="sum"`` int values must fit
+    int32, so even 2³² occurrences of one key cannot wrap the int64
+    accumulator the planes sum in (the tuple path's python ints are
+    arbitrary-precision — a wrapped plane would be a silently wrong
+    answer, not a slow one); and float keys containing ANY zero fall
+    back, because ``-0.0 == 0.0`` merges in a tuple-path dict but pickles
+    to different key bytes (the documented equal-but-pickles-differently
+    caveat — keeping every ±0.0 on one path keeps both transports on the
+    same side of it)."""
+    def uniform(xs, allowed) -> type | None:
+        t = type(xs[0])
+        if t not in allowed:
+            return None
+        for x in xs:
+            if type(x) is not t:
+                return None
+        return t
+
+    kt = uniform(keys, (int, float, bool))
+    if kt is None:
+        return None
+    if kt is int and any(abs(k) > 0x7FFF_FFFF_FFFF_FFFF for k in keys):
+        return None  # arbitrary-precision python ints stay tuple-path
+    if kt is float and any(k == 0.0 for k in keys):
+        # ±0.0 are dict-equal but pickle-different; only the tuple path
+        # carries the dict-merge semantics, so EVERY zero goes there —
+        # a columnar +0.0 could never merge with a tuple-path -0.0
+        return None
+    val_planes: tuple = ()
+    if combines:
+        vt = uniform(vals, (int, float))
+        if vt is None:
+            return None
+        v_bound = (0x7FFF_FFFF if combines[0] == "sum"
+                   else 0x7FFF_FFFF_FFFF_FFFF)
+        if vt is int and any(abs(v) > v_bound for v in vals):
+            return None
+        val_planes = (np.asarray(vals, dtype=np.dtype(
+            np.int64 if vt is int else np.float64)),)
+    key_col = np.asarray(keys, dtype=np.dtype(
+        {int: np.int64, float: np.float64, bool: np.bool_}[kt]))
+    return _Planes(hash_rows(keys), (key_col,), val_planes)
+
+
+def reduce_pair_plan(combine: str) -> ColumnarPlan:
+    """Plan for ``reduce_by_key(f, combine=...)``: scalar numeric key, one
+    value plane folded with the DECLARED combine — the declaration is a
+    contract exactly like commutativity is (an ``f`` that disagrees with
+    it diverges between paths, and that is the caller's bug)."""
+    return ColumnarPlan(
+        combines=(combine,),
+        pair_planes=lambda ks, vs: _scalar_batch(ks, vs, (combine,)),
+        key_of_row=lambda kr: kr[0],
+        vals_to_acc=lambda vs: vs[0],
+        row_emit=lambda k, vs: (k, vs[0]))
+
+
+def distinct_pair_plan() -> ColumnarPlan:
+    """Plan for ``distinct()`` over numeric scalars: key planes only, the
+    segment fold is pure dedup (first row of each hash run)."""
+    return ColumnarPlan(
+        combines=(),
+        pair_planes=lambda ks, vs: _scalar_batch(ks, None, ()),
+        key_of_row=lambda kr: kr[0],
+        vals_to_acc=lambda vs: None,
+        row_emit=lambda k, vs: k)
+
+
+# ---------------------------------------------------------------------------
 # mapper / reducer process bodies (fork-inherited closures, no jax)
 # ---------------------------------------------------------------------------
 
@@ -266,18 +760,30 @@ def _drain_frees(ring: _Arena, free_q) -> None:
 
 def _mapper_loop(mid: int, parts, assignment, spec: _Spec, n_out: int,
                  shm, out_qs, free_q, ctrl_q, stop_evt, cap_bytes: int,
-                 sort_route=None) -> None:
+                 sort_route=None, plan: ColumnarPlan | None = None) -> None:
     """Child body: walk assigned (partition, slot, k) slices, combine into a
-    bounded dict, flush bucketed payloads through the arena/queues."""
+    bounded dict, flush bucketed payloads through the arena/queues. With a
+    :class:`ColumnarPlan`, conforming batches accumulate as planes instead
+    (exact-byte metered) and flush via vectorized sort + segment-combine +
+    hash-bucket split; non-conforming batches walk the tuple dict path."""
     os.environ["DLS_NATIVE_THREADS"] = "1"  # same capping rationale as workers
     ring = _Arena(shm.size)
     buf = shm.buf
     alloc_id = 0
     R = len(out_qs)
     stats = {"elems": 0, "pairs": 0, "bytes_moved": 0, "overflow": 0,
-             "flushes": 0, "busy_s": 0.0}
+             "flushes": 0, "busy_s": 0.0, "cols_pairs": 0, "cols_bytes": 0}
     store: dict = {}
     meter = _ByteMeter()
+    cols: list[_Planes] = []        # columnar batches awaiting a flush
+    cols_meter = _ByteMeter()       # their EXACT bytes (add_exact — a
+    #                                 plane's size is known, never sampled)
+    pend_k: list = []               # rdd pair-mode vectorization buffer
+    pend_v: list = []
+    pin_sig: list = [None]          # first columnar batch pins the dtypes
+    #: one shipped payload must fit the arena with room to breathe; planes
+    #: above this split by rows (each slice is independently decodable)
+    ship_cap = max(_MIN_CAP, shm.size // 4)
 
     def put(q, rec) -> bool:
         while not stop_evt.is_set():
@@ -302,9 +808,11 @@ def _mapper_loop(mid: int, parts, assignment, spec: _Spec, n_out: int,
             except queue_lib.Empty:
                 pass
 
-    def ship(bucket: int, payload: bytes) -> bool:
+    def ship(bucket: int, payload: bytes, columnar: bool = False) -> bool:
         nonlocal alloc_id
         stats["bytes_moved"] += len(payload)
+        if columnar:
+            stats["cols_bytes"] += len(payload)
         off = alloc(_align(len(payload)))
         if off is None:
             stats["overflow"] += 1
@@ -315,20 +823,71 @@ def _mapper_loop(mid: int, parts, assignment, spec: _Spec, n_out: int,
         alloc_id += 1
         return ok
 
+    def add_tuple_pair(key, v) -> None:
+        if key in store:
+            store[key] = spec.combine(store[key], v)
+            meter.add(v)
+        else:
+            store[key] = spec.seed(v)
+            meter.add(v, 120)
+
+    def drain_pend() -> None:
+        """Vectorize the buffered rdd pairs, or route the batch through
+        the tuple dict when it does not conform / breaks the pinned
+        dtype signature (np.concatenate across mismatched planes would
+        silently promote — int keys becoming floats is a wrong answer,
+        not a slow one)."""
+        if not pend_k:
+            return
+        pl = plan.pair_planes(pend_k, pend_v)
+        if pl is not None and (pin_sig[0] is None
+                               or pl.dtype_sig() == pin_sig[0]):
+            pin_sig[0] = pin_sig[0] or pl.dtype_sig()
+            cols.append(pl)
+            cols_meter.add_exact(pl.nbytes)
+            stats["cols_pairs"] += len(pl)
+        else:
+            for key, v in zip(pend_k, pend_v):
+                add_tuple_pair(key, v)
+        pend_k.clear()
+        pend_v.clear()
+
     def flush() -> bool:
-        if not store:
+        if plan is not None and plan.pre_planes is None:
+            drain_pend()
+        if not store and not cols:
             return True
         stats["flushes"] += 1
-        buckets: dict[int, list] = {}
-        for key, acc in store.items():
-            kb = key_bytes(key)
-            buckets.setdefault(bucket_of(kb, n_out), []).append(
-                (kb, key, acc))
-        store.clear()
-        meter.reset()
-        for b in sorted(buckets):
-            if not ship(b, pickle.dumps(buckets[b], protocol=_PICKLE_PROTO)):
-                return False
+        if cols:
+            combined = combine_planes(_Planes.concat(cols), plan)
+            cols.clear()
+            cols_meter.reset()
+            # size payload slices to what the arena can actually place:
+            # its largest current hole (advisory — frees land async), the
+            # static cap as the floor/ceiling
+            _drain_frees(ring, free_q)
+            cap_now = min(ship_cap, max(_MIN_CAP, ring.largest_hole()))
+            for b, sub in _bucket_split(combined, n_out):
+                row_bytes = max(1, sub.nbytes // max(1, len(sub)))
+                step = max(1, cap_now // row_bytes)
+                for lo in range(0, len(sub), step):
+                    payload = pickle.dumps(
+                        sub.cut(lo, min(lo + step, len(sub))).payload(),
+                        protocol=_PICKLE_PROTO)
+                    if not ship(b, payload, columnar=True):
+                        return False
+        if store:
+            buckets: dict[int, list] = {}
+            for key, acc in store.items():
+                kb = key_bytes(key)
+                buckets.setdefault(bucket_of(kb, n_out), []).append(
+                    (kb, key, acc))
+            store.clear()
+            meter.reset()
+            for b in sorted(buckets):
+                if not ship(b, pickle.dumps(buckets[b],
+                                            protocol=_PICKLE_PROTO)):
+                    return False
         return True
 
     try:
@@ -357,18 +916,37 @@ def _mapper_loop(mid: int, parts, assignment, spec: _Spec, n_out: int,
                         store.clear()
                         meter.reset()
                     continue
+                if plan is not None and plan.pre_planes is not None:
+                    pl = plan.pre_planes(elem)
+                    if pl is not None and (pin_sig[0] is None
+                                           or pl.dtype_sig() == pin_sig[0]):
+                        pin_sig[0] = pin_sig[0] or pl.dtype_sig()
+                        cols.append(pl)
+                        cols_meter.add_exact(pl.nbytes)
+                        stats["pairs"] += len(pl)
+                        stats["cols_pairs"] += len(pl)
+                        if meter.value + cols_meter.value >= cap_bytes:
+                            if not flush():
+                                return
+                        continue
                 pairs = spec.pre(elem) if spec.pre is not None else (elem,)
+                if plan is not None and plan.pre_planes is None:
+                    for key, v in pairs:
+                        stats["pairs"] += 1
+                        pend_k.append(key)
+                        pend_v.append(v)
+                        if len(pend_k) >= _PAIR_BATCH:
+                            drain_pend()
+                    if meter.value + cols_meter.value >= cap_bytes:
+                        if not flush():
+                            return
+                    continue
                 for key, v in pairs:
                     stats["pairs"] += 1
                     if spec.tag_values:
                         v = (part_idx, j, v)
-                    if key in store:
-                        store[key] = spec.combine(store[key], v)
-                        meter.add(v)
-                    else:
-                        store[key] = spec.seed(v)
-                        meter.add(v, 120)
-                    if meter.value >= cap_bytes:
+                    add_tuple_pair(key, v)
+                    if meter.value + cols_meter.value >= cap_bytes:
                         if not flush():
                             return
             # flush at every partition boundary: mapper state never spans
@@ -392,12 +970,42 @@ def _mapper_loop(mid: int, parts, assignment, spec: _Spec, n_out: int,
         put(ctrl_q, ("err", ("mapper", mid), traceback.format_exc()))
 
 
-def _spill_path(spill_dir: str, rid: int, bucket: int, n: int) -> str:
-    return os.path.join(spill_dir, f"r{rid}-b{bucket}-run{n}.pkl")
+def _spill_path(spill_dir: str, rid: int, bucket: int, n: int,
+                fmt: str = "pkl") -> str:
+    return os.path.join(spill_dir, f"r{rid}-b{bucket}-run{n}.{fmt}")
 
 
 def out_path(spill_dir: str, bucket: int) -> str:
     return os.path.join(spill_dir, f"out-b{bucket}.pkl")
+
+
+def cols_out_path(spill_dir: str, bucket: int) -> str:
+    return os.path.join(spill_dir, f"out-b{bucket}.cols")
+
+
+def _write_cols_run(path: str, pl: _Planes) -> int:
+    """One columnar spill run / output: hash-sorted combined planes as a
+    stream of independently-pickled row blocks (the k-way merge and the
+    readers stream blocks — run size never has to fit memory again)."""
+    with open(path, "wb") as f:
+        p = pickle.Pickler(f, protocol=_PICKLE_PROTO)
+        for lo in range(0, len(pl), _COLS_BLOCK_ROWS):
+            p.dump(pl.cut(lo, min(lo + _COLS_BLOCK_ROWS, len(pl))).payload())
+        return f.tell()
+
+
+def _iter_cols_blocks(path: str) -> Iterator[_Planes]:
+    for rec in _iter_run(path):
+        yield _Planes.from_payload(rec)
+
+
+def _iter_cols_as_entries(path: str, plan: ColumnarPlan) -> Iterator:
+    """A columnar run read as tuple-path ``(kb, key, acc)`` entries, in kb
+    order (hash order + in-collision kb order IS kb order) — so a
+    degraded bucket's earlier columnar spills merge straight into the
+    tuple heapq without re-sorting."""
+    for pl in _iter_cols_blocks(path):
+        yield from plan.entries_from_planes(pl)
 
 
 def _write_run(path: str, items: list) -> int:
@@ -422,18 +1030,28 @@ def _iter_run(path: str) -> Iterator:
 
 def _reducer_loop(rid: int, M: int, R: int, n_out: int, spec: _Spec | None,
                   in_q, free_qs, shm_names, ctrl_q, stop_evt,
-                  cap_bytes: int, spill_dir: str, sort_spec=None) -> None:
+                  cap_bytes: int, spill_dir: str, sort_spec=None,
+                  plan: ColumnarPlan | None = None) -> None:
     """Child body: merge arriving bucket payloads under a byte budget,
     spill sorted runs past it, k-way-merge runs into one output file per
-    owned bucket."""
+    owned bucket. A bucket receiving only plane payloads stays columnar
+    end to end (exact-byte metered, columnar spill runs, vectorized
+    merge, ``.cols`` output); the first tuple payload for a bucket
+    degrades THAT bucket to the tuple dict path — output bytes are
+    identical either way, the formats differ only in speed."""
     os.environ["DLS_NATIVE_THREADS"] = "1"
     shms: dict[int, shared_memory.SharedMemory] = {}
-    # keyed mode: bucket -> {key: [kb, acc]}; sort mode: bucket -> [entry]
+    # keyed mode: bucket -> {key: [kb, acc]} (tuple) | [_Planes] (cols);
+    # sort mode: bucket -> [entry]
     stores: dict[int, Any] = {}
-    runs: dict[int, list[str]] = {}
+    modes: dict[int, str] = {}          # bucket -> "cols" | "tuple"
+    sigs: dict[int, tuple] = {}         # bucket -> pinned plane dtype sig
+    cols_bytes: dict[int, int] = {}     # bucket -> exact resident plane B
+    runs: dict[int, list] = {}          # bucket -> [(fmt, path)]
     meter = _ByteMeter()
     done = set()
-    stats = {"spills": 0, "spill_bytes": 0, "bucket_rows": {}, "merge_s": 0.0}
+    stats = {"spills": 0, "spill_bytes": 0, "bucket_rows": {}, "merge_s": 0.0,
+             "cols_buckets": 0, "tuple_buckets": 0}
 
     def notify(msg) -> None:
         try:
@@ -455,36 +1073,83 @@ def _reducer_loop(rid: int, M: int, R: int, n_out: int, spec: _Spec | None,
             pass
         return data
 
+    def merge_entries(bucket: int, items) -> None:
+        st = stores.setdefault(bucket, {})
+        for kb, key, acc in items:
+            ent = st.get(key)
+            if ent is None:
+                st[key] = [kb, acc]
+                meter.add(acc, len(kb) + 100)
+            else:
+                ent[1] = spec.merge(ent[1], acc)
+                meter.add(acc)
+
+    def degrade(bucket: int) -> None:
+        """Mixed formats arrived for this bucket: convert its resident
+        planes to tuple entries and continue dict-side. Earlier columnar
+        spill runs stay columnar on disk; the finalize merge reads them
+        back as kb-ordered entries."""
+        planes = stores.pop(bucket, [])
+        cols_bytes.pop(bucket, None)
+        modes[bucket] = "tuple"
+        sigs.pop(bucket, None)
+        stores[bucket] = {}
+        if planes:
+            merge_entries(bucket, plan.entries_from_planes(
+                combine_planes(_Planes.concat(planes), plan)))
+
+    def resident() -> float:
+        return meter.value + sum(cols_bytes.values())
+
+    def bucket_size(b: int) -> int:
+        s = stores[b]
+        return (sum(len(p) for p in s) if modes.get(b) == "cols"
+                else len(s))
+
     def spill_largest() -> None:
         if not stores:
             return
-        bucket = max(stores, key=lambda b: len(stores[b]))
-        if sort_spec is not None:
-            items = sorted(stores.pop(bucket), key=sort_spec[0],
-                           reverse=sort_spec[1])
+        bucket = max(stores, key=bucket_size)
+        n_run = len(runs.setdefault(bucket, []))
+        if modes.get(bucket) == "cols":
+            combined = combine_planes(_Planes.concat(stores.pop(bucket)),
+                                      plan)
+            cols_bytes.pop(bucket, None)
+            path = _spill_path(spill_dir, rid, bucket, n_run, "cols")
+            nbytes = _write_cols_run(path, combined)
+            runs[bucket].append(("cols", path))
+            n_items = len(combined)
         else:
-            items = sorted(
-                ((e[0], key, e[1]) for key, e in stores.pop(bucket).items()),
-                key=lambda t: t[0])
-        path = _spill_path(spill_dir, rid, bucket,
-                           len(runs.setdefault(bucket, [])))
-        nbytes = _write_run(path, items)
-        runs[bucket].append(path)
+            if sort_spec is not None:
+                items = sorted(stores.pop(bucket), key=sort_spec[0],
+                               reverse=sort_spec[1])
+            else:
+                items = sorted(
+                    ((e[0], key, e[1])
+                     for key, e in stores.pop(bucket).items()),
+                    key=lambda t: t[0])
+            path = _spill_path(spill_dir, rid, bucket, n_run)
+            nbytes = _write_run(path, items)
+            runs[bucket].append(("pkl", path))
+            n_items = len(items)
+            # rebase surviving tuple buckets at the meter's OWN rolling
+            # per-item estimate — a flat constant here would under-charge
+            # fat values (group lists) and let residency creep past the
+            # budget share. Columnar residency is exact and untouched.
+            meter.value = (sum(len(s) for b, s in stores.items()
+                               if modes.get(b) != "cols")
+                           * (meter._est + 100))
         stats["spills"] += 1
         stats["spill_bytes"] += nbytes
-        # rebase surviving buckets at the meter's OWN rolling per-item
-        # estimate — a flat constant here would under-charge fat values
-        # (group lists) and let residency creep past the budget share
-        meter.value = (sum(len(s) for s in stores.values())
-                       * (meter._est + 100))
-        notify(("spill", rid, bucket, len(items), nbytes))
+        notify(("spill", rid, bucket, n_items, nbytes))
 
     def merge_bucket(bucket: int) -> None:
         """Stream the bucket's runs + memory into its final output file."""
         t0 = time.perf_counter()
         rows = 0
-        streams = [_iter_run(p) for p in runs.get(bucket, [])]
+        bucket_runs = runs.get(bucket, [])
         if sort_spec is not None:
+            streams = [_iter_run(p) for _fmt, p in bucket_runs]
             mem = sorted(stores.pop(bucket, []), key=sort_spec[0],
                          reverse=sort_spec[1])
             merged = heapq.merge(*streams, mem, key=sort_spec[0],
@@ -494,7 +1159,27 @@ def _reducer_loop(rid: int, M: int, R: int, n_out: int, spec: _Spec | None,
                 for _kv, _part, _j, elem in merged:
                     p.dump(elem)
                     rows += 1
+        elif modes.get(bucket) == "cols":
+            # pure columnar bucket: k-way merge the sorted runs + memory
+            # on the hash column, blockwise — no per-key Python anywhere
+            planes = stores.pop(bucket, [])
+            cols_bytes.pop(bucket, None)
+            streams = [_iter_cols_blocks(p) for _fmt, p in bucket_runs]
+            if planes:
+                streams.append(iter(
+                    [combine_planes(_Planes.concat(planes), plan)]))
+            with open(cols_out_path(spill_dir, bucket), "wb") as f:
+                pk = pickle.Pickler(f, protocol=_PICKLE_PROTO)
+                for blk in _merge_cols_streams(streams, plan):
+                    if len(blk):
+                        pk.dump(blk.payload())
+                        rows += len(blk)
+            if rows:
+                stats["cols_buckets"] += 1
         else:
+            streams = [(_iter_run(p) if fmt == "pkl"
+                        else _iter_cols_as_entries(p, plan))
+                       for fmt, p in bucket_runs]
             mem = sorted(
                 ((e[0], key, e[1])
                  for key, e in stores.pop(bucket, {}).items()),
@@ -514,7 +1199,9 @@ def _reducer_loop(rid: int, M: int, R: int, n_out: int, spec: _Spec | None,
                 if cur_kb is not None:
                     p.dump(spec.final(cur_key, cur_acc))
                     rows += 1
-        for p_ in runs.pop(bucket, []):
+            if rows:
+                stats["tuple_buckets"] += 1
+        for _fmt, p_ in runs.pop(bucket, []):
             try:
                 os.remove(p_)
             except OSError:
@@ -540,17 +1227,35 @@ def _reducer_loop(rid: int, M: int, R: int, n_out: int, spec: _Spec | None,
                 lst.extend(items)
                 for e in items:
                     meter.add(e[3], 64)
-            else:
-                st = stores.setdefault(bucket, {})
-                for kb, key, acc in items:
-                    ent = st.get(key)
-                    if ent is None:
-                        st[key] = [kb, acc]
-                        meter.add(acc, len(kb) + 100)
+            elif (isinstance(items, tuple) and items
+                  and items[0] == "cols"):
+                pl = _Planes.from_payload(items)
+                mode = modes.get(bucket)
+                if mode is None:
+                    modes[bucket] = "cols"
+                    sigs[bucket] = pl.dtype_sig()
+                    stores[bucket] = [pl]
+                    cols_bytes[bucket] = pl.nbytes
+                elif mode == "cols":
+                    if pl.dtype_sig() != sigs[bucket]:
+                        # two mappers pinned different scalar types for
+                        # keys landing here — concatenation would promote
+                        # (wrong bytes); the tuple path merges them right
+                        degrade(bucket)
+                        merge_entries(bucket,
+                                      plan.entries_from_planes(pl))
                     else:
-                        ent[1] = spec.merge(ent[1], acc)
-                        meter.add(acc)
-            while meter.value >= cap_bytes and stores:
+                        stores.setdefault(bucket, []).append(pl)
+                        cols_bytes[bucket] = (cols_bytes.get(bucket, 0)
+                                              + pl.nbytes)
+                else:
+                    merge_entries(bucket, plan.entries_from_planes(pl))
+            else:
+                if modes.get(bucket) == "cols":
+                    degrade(bucket)
+                modes.setdefault(bucket, "tuple")
+                merge_entries(bucket, items)
+            while resident() >= cap_bytes and stores:
                 spill_largest()
         for bucket in range(rid, n_out, R):
             if stop_evt.is_set():
@@ -578,10 +1283,11 @@ class ShuffleResult:
     every dataset partition referencing it) is garbage-collected."""
 
     def __init__(self, spill_dir: str, n_out: int, stats: dict,
-                 keep_dir: bool):
+                 keep_dir: bool, plan: ColumnarPlan | None = None):
         self.spill_dir = spill_dir
         self.n_out = n_out
         self.stats = stats
+        self.plan = plan
         self._fin = (weakref.finalize(self, _rm_dir, spill_dir)
                      if not keep_dir else None)
 
@@ -592,6 +1298,27 @@ class ShuffleResult:
         path = out_path(self.spill_dir, bucket)
         if os.path.exists(path):
             yield from _iter_run(path)
+            return
+        cpath = cols_out_path(self.spill_dir, bucket)
+        if os.path.exists(cpath) and self.plan is not None:
+            for pl in _iter_cols_blocks(cpath):
+                yield from self.plan.rows_from_planes(pl)
+
+    def iter_bucket_planes(self, bucket: int) -> Iterator[_Planes] | None:
+        """Blockwise plane access for a columnar bucket, or ``None`` when
+        this bucket finalized in tuple format (mixed-eligibility buckets
+        do) — the caller then falls back to :meth:`iter_bucket` rows. The
+        zero-copy read the dataframe agg path builds chunks straight
+        from."""
+        cpath = cols_out_path(self.spill_dir, bucket)
+        if self.plan is None or not os.path.exists(cpath):
+            return None
+        return self._planes_gen(cpath)
+
+    def _planes_gen(self, cpath: str) -> Iterator[_Planes]:
+        # separate generator so iter_bucket_planes can return None eagerly
+        self_ref = self  # noqa: F841 — pins the finalizer, like iter_bucket
+        yield from _iter_cols_blocks(cpath)
 
 
 def _rm_dir(path: str) -> None:
@@ -621,11 +1348,14 @@ def _assignments(P: int, M: int) -> list[list[tuple[int, int, int]]]:
 def run_exchange(parts: Sequence[Callable[[], Any]], *, num_workers: int,
                  n_out: int, spec: _Spec | None, label: str,
                  sort_route=None, sort_spec=None,
-                 mem_mb: float | None = None) -> ShuffleResult:
+                 mem_mb: float | None = None,
+                 plan: ColumnarPlan | None = None) -> ShuffleResult:
     """Execute one shuffle: spawn mappers + reducers, stream the exchange,
     return the per-bucket output. Raises :class:`WorkerCrashed` (cleaning
     up every child, shm segment, and spill file) when any child raises or
-    dies."""
+    dies. With a :class:`ColumnarPlan`, conforming batches ship as flat
+    planes (see the module docstring) — output is byte-identical either
+    way."""
     P = len(parts)
     M = max(1, int(num_workers))
     R = max(1, min(M, n_out))
@@ -651,12 +1381,12 @@ def run_exchange(parts: Sequence[Callable[[], Any]], *, num_workers: int,
     mappers = [ctx.Process(
         target=_mapper_loop, daemon=True, name=f"dlsx-map-{m}",
         args=(m, list(parts), assign[m], spec, n_out, shms[m], out_qs,
-              free_qs[m], ctrl_q, stop, map_cap, sort_route))
+              free_qs[m], ctrl_q, stop, map_cap, sort_route, plan))
         for m in range(M)]
     reducers = [ctx.Process(
         target=_reducer_loop, daemon=True, name=f"dlsx-red-{r}",
         args=(r, M, R, n_out, spec, out_qs[r], free_qs, shm_names, ctrl_q,
-              stop, red_cap, spill_dir, sort_spec))
+              stop, red_cap, spill_dir, sort_spec, plan))
         for r in range(R)]
     procs = mappers + reducers
     with warnings.catch_warnings():
@@ -750,15 +1480,21 @@ def run_exchange(parts: Sequence[Callable[[], Any]], *, num_workers: int,
     for st in red_done.values():
         bucket_rows.update(st["bucket_rows"])
     rows_list = [bucket_rows.get(b, 0) for b in range(n_out)]
+    pairs_in = sum(st["pairs"] for st in map_done.values())
+    bytes_moved = sum(st["bytes_moved"] for st in map_done.values())
+    cols_pairs = sum(st.get("cols_pairs", 0) for st in map_done.values())
+    cols_bytes = sum(st.get("cols_bytes", 0) for st in map_done.values())
+    transport = ("tuple" if plan is None or cols_pairs == 0
+                 else ("columnar" if cols_pairs == pairs_in else "mixed"))
     stats = {
         "op": label,
         "workers": M,
         "reducers": R,
         "buckets": n_out,
         "elems_in": sum(st["elems"] for st in map_done.values()),
-        "pairs_in": sum(st["pairs"] for st in map_done.values()),
+        "pairs_in": pairs_in,
         "rows_out": sum(rows_list),
-        "bytes_moved": sum(st["bytes_moved"] for st in map_done.values()),
+        "bytes_moved": bytes_moved,
         "overflow": sum(st["overflow"] for st in map_done.values()),
         "spills": spills,
         "spill_bytes": spill_bytes,
@@ -766,9 +1502,21 @@ def run_exchange(parts: Sequence[Callable[[], Any]], *, num_workers: int,
         "merge_s": round(time.perf_counter() - (map_end or t_start), 3),
         "bucket_rows": rows_list,
         "mem_budget_mb": round(budget / (1 << 20), 1),
+        # per-format split (ISSUE 12): which bytes/keys rode which
+        # transport, and how each bucket finalized — the dlstatus
+        # shuffle block's per-format rows
+        "transport": transport,
+        "columnar_pairs": cols_pairs,
+        "columnar_bytes": cols_bytes,
+        "tuple_pairs": pairs_in - cols_pairs,
+        "tuple_bytes": bytes_moved - cols_bytes,
+        "columnar_buckets": sum(
+            st.get("cols_buckets", 0) for st in red_done.values()),
+        "tuple_buckets": sum(
+            st.get("tuple_buckets", 0) for st in red_done.values()),
     }
     telemetry.emit("shuffle", edge="done", **stats)
-    return ShuffleResult(spill_dir, n_out, stats, keep_dir=False)
+    return ShuffleResult(spill_dir, n_out, stats, keep_dir=False, plan=plan)
 
 
 def _exchange_cleanup(stop, procs, shms) -> None:
@@ -797,16 +1545,15 @@ def _exchange_cleanup(stop, procs, shms) -> None:
 # ---------------------------------------------------------------------------
 
 
-def _lazy_exchange_dataset(parts, *, num_workers: int, n_out: int,
-                           spec: _Spec | None, label: str,
-                           prepare=None, sort_spec=None):
-    """A PartitionedDataset whose partitions stream the exchange's bucket
-    files; the exchange itself runs once, on first iteration (the lazy +
-    memoized contract every wide op in rdd.py keeps). ``prepare`` (also
-    deferred to first iteration) returns the ``sort_route`` pair for sort
-    mode — it may walk the source (boundary sampling)."""
-    from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
-
+def lazy_exchange(parts, *, num_workers: int, n_out: int,
+                  spec: _Spec | None, label: str,
+                  prepare=None, sort_spec=None, plan=None
+                  ) -> Callable[[], ShuffleResult]:
+    """A memoized exchange runner: the returned callable executes the
+    shuffle ONCE, on first call (the lazy + memoized contract every wide
+    op keeps), and hands back the same :class:`ShuffleResult` after that.
+    ``prepare`` (also deferred to first call) returns the ``sort_route``
+    pair for sort mode — it may walk the source (boundary sampling)."""
     memo: dict = {}
 
     def result() -> ShuffleResult:
@@ -815,8 +1562,24 @@ def _lazy_exchange_dataset(parts, *, num_workers: int, n_out: int,
                 parts, num_workers=num_workers, n_out=n_out, spec=spec,
                 label=label,
                 sort_route=prepare() if prepare is not None else None,
-                sort_spec=sort_spec)
+                sort_spec=sort_spec, plan=plan)
         return memo["r"]
+
+    return result
+
+
+def _lazy_exchange_dataset(parts, *, num_workers: int, n_out: int,
+                           spec: _Spec | None, label: str,
+                           prepare=None, sort_spec=None, plan=None):
+    """A PartitionedDataset whose partitions stream the exchange's bucket
+    files (rows); the exchange runs once via :func:`lazy_exchange`. The
+    dataframe agg path bypasses this for columnar buckets and reads
+    planes directly."""
+    from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+    result = lazy_exchange(
+        parts, num_workers=num_workers, n_out=n_out, spec=spec,
+        label=label, prepare=prepare, sort_spec=sort_spec, plan=plan)
 
     def make(bucket: int):
         return lambda: result().iter_bucket(bucket)
@@ -824,10 +1587,15 @@ def _lazy_exchange_dataset(parts, *, num_workers: int, n_out: int,
     return PartitionedDataset([make(b) for b in range(n_out)])
 
 
-def reduce_by_key(dataset, f, n_out: int, num_workers: int):
+def reduce_by_key(dataset, f, n_out: int, num_workers: int, *,
+                  combine: str | None = None, transport: str | None = None):
+    plan = None
+    if (combine in NUMERIC_COMBINES
+            and resolve_transport(transport) != "tuple"):
+        plan = reduce_pair_plan(combine)
     return _lazy_exchange_dataset(
         dataset._parts, num_workers=num_workers, n_out=n_out,
-        spec=_reduce_spec(f), label="reduce_by_key")
+        spec=_reduce_spec(f), label="reduce_by_key", plan=plan)
 
 
 def group_by_key(dataset, n_out: int, num_workers: int):
@@ -836,11 +1604,13 @@ def group_by_key(dataset, n_out: int, num_workers: int):
         spec=_group_spec(), label="group_by_key")
 
 
-def distinct(dataset, num_workers: int):
+def distinct(dataset, num_workers: int, *, transport: str | None = None):
+    plan = (distinct_pair_plan()
+            if resolve_transport(transport) != "tuple" else None)
     return _lazy_exchange_dataset(
         dataset._parts, num_workers=num_workers,
         n_out=dataset.num_partitions, spec=_distinct_spec(),
-        label="distinct")
+        label="distinct", plan=plan)
 
 
 def _sample_boundaries(parts, key_fn, n_out: int) -> list:

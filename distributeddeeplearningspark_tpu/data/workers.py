@@ -249,6 +249,14 @@ class _Arena:
                 return s
         return None
 
+    def largest_hole(self) -> int:
+        """Biggest allocation that could succeed RIGHT NOW (0 when full).
+        Advisory — frees land asynchronously — but a good sizing signal:
+        the columnar exchange splits whole-plane payloads so each slice
+        fits a plausible hole instead of collapsing a 16MB plane into the
+        pickled-queue overflow path."""
+        return max((e - s for s, e in self._free), default=0)
+
 
 def _align(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN

@@ -241,14 +241,18 @@ class PartitionedDataset:
 
         return self.map_partitions_with_index(samp)
 
-    def distinct(self, *, num_workers: int | None = None
-                 ) -> "PartitionedDataset":
+    def distinct(self, *, num_workers: int | None = None,
+                 transport: str | None = None) -> "PartitionedDataset":
         """Spark ``distinct`` (hashable elements).
 
         With workers (``num_workers=`` / ``DLS_DATA_WORKERS``): the
         distributed exchange dedups per bucket with spill-to-disk — no
         cardinality ceiling; output is hash-partitioned over the input's
-        partition count in canonical ``key_bytes`` order.
+        partition count in canonical ``key_bytes`` order. Plain
+        ``int``/``float`` element batches ride the columnar transport
+        (flat key-hash + key planes, vectorized dedup) unless
+        ``transport="tuple"`` forces the pickled path — output identical
+        either way.
 
         Serial: per-partition dedup plus a driver-side cross-partition set
         on first iteration; output keeps first-occurrence order and
@@ -261,7 +265,7 @@ class PartitionedDataset:
 
         nw = exchange.resolve_shuffle_workers(num_workers)
         if nw:
-            return exchange.distinct(self, nw)
+            return exchange.distinct(self, nw, transport=transport)
         parts = self._parts
         limit = exchange.max_groups_limit()
 
@@ -350,7 +354,9 @@ class PartitionedDataset:
 
     def reduce_by_key(self, f: Callable[[Any, Any], Any],
                       num_partitions: int | None = None, *,
-                      num_workers: int | None = None) -> "PartitionedDataset":
+                      num_workers: int | None = None,
+                      combine: str | None = None,
+                      transport: str | None = None) -> "PartitionedDataset":
         """Spark ``reduceByKey`` over (key, value) pairs. ``f`` must be
         commutative + associative (Spark's own contract).
 
@@ -359,6 +365,17 @@ class PartitionedDataset:
         combine per partition slice, bucketed partials stream to per-bucket
         reducers that spill to disk under ``DLS_SHUFFLE_MEM_MB``. No
         cardinality ceiling.
+
+        ``combine`` declares ``f``'s numeric semantics (``"sum"`` /
+        ``"min"`` / ``"max"``) so conforming batches — plain ``int`` /
+        ``float`` scalar keys AND values — can ride the **columnar
+        transport**: flat key-hash/key/value planes, vectorized
+        segment-combine, an order of magnitude past the pickled-tuple
+        ceiling. The declaration is a contract exactly like commutativity
+        is: an ``f`` that disagrees with it diverges between paths, and
+        that is the caller's bug. Undeclared (or ``transport="tuple"``)
+        keeps the pickled path; non-conforming batches fall back to it
+        per batch either way, byte-identically.
 
         Serial: values combine per-partition first (Spark's map-side
         combine), then the per-partition partials merge in a driver-side
@@ -373,10 +390,14 @@ class PartitionedDataset:
 
         if num_partitions is not None and num_partitions < 1:
             raise ValueError(f"num_partitions must be >= 1, got {num_partitions}")
+        if combine is not None and combine not in exchange.NUMERIC_COMBINES:
+            raise ValueError(
+                f"combine={combine!r} not in {exchange.NUMERIC_COMBINES}")
         nw = exchange.resolve_shuffle_workers(num_workers)
         if nw:
             return exchange.reduce_by_key(
-                self, f, num_partitions or len(self._parts), nw)
+                self, f, num_partitions or len(self._parts), nw,
+                combine=combine, transport=transport)
         parts = self._parts
         limit = exchange.max_groups_limit()
 

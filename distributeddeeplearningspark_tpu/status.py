@@ -191,14 +191,37 @@ def shuffle_from(events: list[dict]) -> dict | None:
     else:
         verdict = (f"SKEWED — bucket {rows.index(max_rows)} holds "
                    f"{skew:.1f}x the mean; pre-bucket or salt the hot key")
+    def _fmt_total(key: str) -> int:
+        return sum(int(e.get(key, 0) or 0) for e in done)
+
+    # per-format split (ISSUE 12): which bytes/keys rode which transport.
+    # Pre-columnar events carry no per-format fields — their pairs/bytes
+    # fold under "tuple" (which is what they were) so totals still tie out
+    formats = {
+        "columnar": {
+            "pairs": _fmt_total("columnar_pairs"),
+            "bytes": _fmt_total("columnar_bytes"),
+            "buckets": _fmt_total("columnar_buckets"),
+        },
+        "tuple": {
+            "pairs": sum(
+                int(e.get("tuple_pairs",
+                          e.get("pairs_in", 0)) or 0) for e in done),
+            "bytes": sum(
+                int(e.get("tuple_bytes",
+                          e.get("bytes_moved", 0)) or 0) for e in done),
+            "buckets": _fmt_total("tuple_buckets"),
+        },
+    }
     return {
         "ops": len(done),
-        "pairs_in": sum(int(e.get("pairs_in", 0) or 0) for e in done),
-        "rows_out": sum(int(e.get("rows_out", 0) or 0) for e in done),
-        "bytes_moved": sum(int(e.get("bytes_moved", 0) or 0) for e in done),
-        "spills": sum(int(e.get("spills", 0) or 0) for e in done),
+        "pairs_in": _fmt_total("pairs_in"),
+        "rows_out": _fmt_total("rows_out"),
+        "bytes_moved": _fmt_total("bytes_moved"),
+        "spills": _fmt_total("spills"),
         "spill_events": spill_events,
-        "overflow": sum(int(e.get("overflow", 0) or 0) for e in done),
+        "overflow": _fmt_total("overflow"),
+        "formats": formats,
         "last": {
             "op": last.get("op"),
             "workers": last.get("workers"),
@@ -207,6 +230,7 @@ def shuffle_from(events: list[dict]) -> dict | None:
             "merge_s": last.get("merge_s"),
             "spills": last.get("spills"),
             "mem_budget_mb": last.get("mem_budget_mb"),
+            "transport": last.get("transport", "tuple"),
             "bucket_rows_max": max_rows,
             "bucket_rows_mean": round(mean_rows, 1),
             "skew": round(skew, 3) if skew is not None else None,
@@ -576,8 +600,16 @@ def render(rep: dict) -> str:
             f"spills={sh['spills']}"
             + (f"  OVERFLOW={sh['overflow']} (raise DLS_SHUFFLE_MEM_MB)"
                if sh.get("overflow") else ""))
+        fmts = sh.get("formats") or {}
+        fmt_bits = [
+            f"{name}: keys={f['pairs']} moved={f['bytes'] / 1e6:.1f}MB"
+            + (f" buckets={f['buckets']}" if f.get("buckets") else "")
+            for name, f in fmts.items() if f.get("pairs")]
+        if fmt_bits:
+            lines.append("  by format  " + "   ".join(fmt_bits))
         lines.append(
-            f"  last op {last['op']}: workers={last['workers']} "
+            f"  last op {last['op']}: transport={last.get('transport')} "
+            f"workers={last['workers']} "
             f"buckets={last['buckets']} map={_fmt_s(last['map_s'])} "
             f"merge={_fmt_s(last['merge_s'])} spills={last['spills']}"
             + (f" budget={last['mem_budget_mb']}MB"

@@ -51,17 +51,22 @@ present):
   occupancy, prefix-cache hit rate, active slots, queue depth. The
   newest one per process is a replica's "now" in ``dlstatus
   --fleet-serve`` (:func:`.fleet.serving_fleet`).
-- ``shuffle`` — one distributed-exchange gauge (:mod:`..data.exchange`):
-  ``edge="spill"`` marks one reducer spill (``reducer``/``bucket``/
-  ``rows``/``bytes``), ``edge="done"`` the whole-shuffle summary
-  (``op``, ``workers``, ``buckets``, ``pairs_in``, ``rows_out``,
-  ``bytes_moved``, ``spills``, ``overflow``, ``map_s``, ``merge_s``,
-  ``bucket_rows``). The shuffle's map/merge wall-clock additionally lands
+- ``shuffle`` — one distributed-exchange gauge (:mod:`..data.exchange`;
+  the device agg path emits the same shape): ``edge="spill"`` marks one
+  reducer spill (``reducer``/``bucket``/``rows``/``bytes``),
+  ``edge="done"`` the whole-shuffle summary (``op``, ``workers``,
+  ``buckets``, ``pairs_in``, ``rows_out``, ``bytes_moved``, ``spills``,
+  ``overflow``, ``map_s``, ``merge_s``, ``bucket_rows``, plus the
+  per-format split: ``transport`` (``tuple``/``columnar``/``mixed``/
+  ``device``), ``columnar_pairs``/``columnar_bytes``/
+  ``tuple_pairs``/``tuple_bytes`` summing to the totals, and
+  ``columnar_buckets``/``tuple_buckets`` — how each non-empty bucket
+  finalized). The shuffle's map/merge wall-clock additionally lands
   as ``shuffle-map``/``shuffle-merge`` ``phase`` spans (informational —
   not goodput overhead: a shuffle IS the productive work of an ETL step),
   which lower into the span model like any phase. ``dlstatus`` renders
   the newest summaries as the shuffle block (bytes moved, spill count,
-  per-bucket skew, slowest-bucket verdict).
+  per-format rows, per-bucket skew, slowest-bucket verdict).
 - ``compile`` — one executable built by the compile ledger
   (:mod:`.anatomy`): ``fn`` (the instrumented callable), ``sig`` /
   ``sig_hash`` (shape/dtype signature), ``compile_s``, ``flops`` /

@@ -11,7 +11,10 @@ materializing a driver-side dict of the raw token space:
    ``DLS_SHUFFLE_MEM_MB``), then keep the top ``--vocab`` tokens per slot
    by (count, token) — most frequent token gets id 1, id 0 is OOV. The
    count table the driver touches is already reduced to distinct tokens;
-   only the top-V slice per slot is kept.
+   the top-V selection itself runs ON DEVICE by default (streaming
+   ``jax.lax.top_k`` filters, ISSUE 12 — ``--topv heap`` keeps the host
+   heap; identical vocab either way), and the summary's ``transports``
+   key logs which format/path each stage used.
 2. **Negative sampling** — each positive row yields ``1 + --neg-per-pos``
    examples: the clicked row (label 1) and K copies whose item slot is
    re-drawn from the learned item-frequency vocab (label 0), the standard
@@ -74,37 +77,77 @@ def synth_clicklog(rows: int, *, num_slots: int, num_dense: int,
 
 
 def build_vocabs(log: PartitionedDataset, *, num_slots: int, top_v: int,
-                 num_workers: int | None) -> tuple[list[dict], list[list]]:
+                 num_workers: int | None, topv: str = "device"
+                 ) -> tuple[list[dict], list[list], str]:
     """Per-slot token→id maps from exchange-reduced counts.
 
     The ``reduce_by_key`` runs through the distributed exchange when
     workers are available — raw-token cardinality never touches a driver
-    dict. The driver only walks the REDUCED count stream, keeping a
-    bounded top-``top_v`` heap per slot. Returns (vocabs, item_pools):
-    ``vocabs[j][token] -> id`` (1-based; 0 = OOV) and the per-slot token
-    list in id order (the negative-sampling pool)."""
+    dict (``combine="sum"`` is declared so numeric-conforming batches
+    would ride the columnar transport; these keys are ``(slot, token)``
+    STRING tuples, so the count stage stays on the tuple format — the
+    summary logs which). The top-``top_v`` selection then runs as the
+    DEVICE reduce phase by default (ISSUE 12): per-slot streaming
+    ``jax.lax.top_k`` filters (:class:`~...data.device_agg.TopV`, one
+    fixed-shape compiled kernel for the whole stream, ledgered by
+    ``dlstatus --anatomy``), falling back to the bounded host heap when
+    no device path is available or ``topv="heap"``. Both selections keep
+    the same ``(count, token)`` tie order, so the vocab is identical.
+
+    Returns (vocabs, item_pools, topv_used): ``vocabs[j][token] -> id``
+    (1-based; 0 = OOV) and the per-slot token list in id order (the
+    negative-sampling pool)."""
     import heapq
 
     counts = log.flat_map(
         lambda r: [((j, t), 1) for j, t in enumerate(r["tokens"])]
-    ).reduce_by_key(lambda a, b: a + b, num_workers=num_workers)
-    heaps: list[list] = [[] for _ in range(num_slots)]
-    for (slot, token), cnt in (
-            x for i in range(counts.num_partitions)
-            for x in counts.iter_partition(i)):
-        h = heaps[slot]
-        # (count, token) orders ties deterministically; heap keeps top-V
-        item = (cnt, token)
-        if len(h) < top_v:
-            heapq.heappush(h, item)
-        elif item > h[0]:
-            heapq.heapreplace(h, item)
+    ).reduce_by_key(lambda a, b: a + b, num_workers=num_workers,
+                    combine="sum")
+    stream = (x for i in range(counts.num_partitions)
+              for x in counts.iter_partition(i))
+    used = "heap"
+    if topv == "device":
+        from distributeddeeplearningspark_tpu.data import device_agg
+
+        if device_agg.available():
+            used = "device"
     vocabs, pools = [], []
-    for h in heaps:
-        ranked = [t for _, t in sorted(h, reverse=True)]
+    if used == "device":
+        from distributeddeeplearningspark_tpu.data import device_agg
+
+        block = 65536
+        filters = [device_agg.TopV(top_v, block=block)
+                   for _ in range(num_slots)]
+        bufs: list[tuple[list, list]] = [([], []) for _ in range(num_slots)]
+        for (slot, token), cnt in stream:
+            cs, ts = bufs[slot]
+            cs.append(cnt)
+            ts.append(token)
+            if len(cs) >= block:
+                filters[slot].update(cs, ts)
+                cs.clear()
+                ts.clear()
+        for slot, (cs, ts) in enumerate(bufs):
+            if cs:
+                filters[slot].update(cs, ts)
+        ranked_all = [[t for _, t in f.ranked()] for f in filters]
+    else:
+        heaps: list[list] = [[] for _ in range(num_slots)]
+        for (slot, token), cnt in stream:
+            h = heaps[slot]
+            # (count, token) orders ties deterministically; heap keeps
+            # top-V
+            item = (cnt, token)
+            if len(h) < top_v:
+                heapq.heappush(h, item)
+            elif item > h[0]:
+                heapq.heapreplace(h, item)
+        ranked_all = [[t for _, t in sorted(h, reverse=True)]
+                      for h in heaps]
+    for ranked in ranked_all:
         vocabs.append({t: i + 1 for i, t in enumerate(ranked)})
         pools.append(ranked)
-    return vocabs, pools
+    return vocabs, pools, used
 
 
 def featurize(log: PartitionedDataset, vocabs: list[dict],
@@ -153,6 +196,10 @@ def main() -> None:
     p.add_argument("--data-workers", type=int, default=None,
                    help="exchange/shuffle worker processes "
                         "(default: DLS_DATA_WORKERS)")
+    p.add_argument("--topv", choices=("device", "heap"), default="device",
+                   help="top-V vocab selection: streaming device top_k "
+                        "kernels (falls back to heap when no device) or "
+                        "the host heap")
     p.add_argument("--feed-batches", type=int, default=20,
                    help="batches timed for the feed-rate measurement")
     p.add_argument("--seed", type=int, default=0)
@@ -172,9 +219,9 @@ def main() -> None:
         num_partitions=args.partitions, seed=args.seed).cache()
 
     t0 = time.perf_counter()
-    vocabs, pools = build_vocabs(
+    vocabs, pools, topv_used = build_vocabs(
         log, num_slots=args.slots, top_v=args.vocab,
-        num_workers=args.data_workers)
+        num_workers=args.data_workers, topv=args.topv)
     vocab_s = time.perf_counter() - t0
 
     examples = featurize(
@@ -215,16 +262,25 @@ def main() -> None:
         spark.stop()
 
     shuffle_stats = None
+    count_transport = "serial" if not (
+        args.data_workers or os.environ.get("DLS_DATA_WORKERS")) else "tuple"
     if wd:
         from distributeddeeplearningspark_tpu import status, telemetry
 
         telemetry.reset()  # flush + release before reading back
         shuffle_stats = status.shuffle_from(telemetry.read_events(wd))
+        if shuffle_stats:
+            # what the exchange ACTUALLY used for the count stage
+            count_transport = shuffle_stats["last"].get(
+                "transport", count_transport)
     print(json.dumps({
         "rows": args.rows,
         "vocab_sizes": [len(v) for v in vocabs],
         "vocab_build_s": round(vocab_s, 2),
         "data_workers": args.data_workers,
+        # per-stage data-plane formats (ISSUE 12): the count shuffle's
+        # transport and where the top-V reduce ran
+        "transports": {"vocab_counts": count_transport, "topv": topv_used},
         "examples_per_sec": round(feed_rate, 1),
         "neg_per_pos": args.neg_per_pos,
         "shuffle": shuffle_stats and shuffle_stats["last"],

@@ -1,0 +1,561 @@
+"""Columnar shuffle transport + device-side segment-reduce (ISSUE 12).
+
+The contract under test is byte-identity: the columnar planes, the
+pickled-tuple path, and the device segment-reduce kernels are three data
+planes for the SAME operation — every test here pins two or three of
+them against each other, including the ugly corners (mixed-eligibility
+buckets, forced spills, dtype edges, fabricated hash collisions) where a
+format boundary could quietly reorder or retype a row.
+"""
+
+import multiprocessing as mp
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu import status, telemetry
+from distributeddeeplearningspark_tpu.data import exchange
+from distributeddeeplearningspark_tpu.data.dataframe import DataFrame
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+
+@pytest.fixture
+def _spill_here(tmp_path, monkeypatch):
+    spill_root = tmp_path / "spill"
+    spill_root.mkdir()
+    monkeypatch.setenv(exchange.SPILL_DIR_ENV, str(spill_root))
+    monkeypatch.delenv("DLS_DATA_WORKERS", raising=False)
+    monkeypatch.delenv(exchange.MEM_MB_ENV, raising=False)
+    monkeypatch.delenv(exchange.TRANSPORT_ENV, raising=False)
+    yield spill_root
+
+
+def _collect_parts(ds):
+    return [list(ds.iter_partition(i)) for i in range(ds.num_partitions)]
+
+
+def _pairs_ds(n=3000, kmod=97, nparts=4):
+    data = [((i * 2654435761) % kmod, i % 13) for i in range(n)]
+    chunks = [data[i::nparts] for i in range(nparts)]
+    return PartitionedDataset.from_generators(
+        [(lambda c=c: iter(c)) for c in chunks])
+
+
+def _agg_df(n=6000, kmod=151, nparts=3, key_dtype=np.int64):
+    k = ((np.arange(n) * 2654435761) % kmod).astype(key_dtype)
+    v = (np.arange(n) % 29 - 14).astype(np.float64)
+    chunks = []
+    for i in range(nparts):
+        sl = slice(i * n // nparts, (i + 1) * n // nparts)
+        chunks.append({"k": k[sl].copy(), "v": v[sl].copy()})
+    ds = PartitionedDataset.from_generators(
+        [(lambda c=c: iter([c])) for c in chunks])
+    return DataFrame(ds, ["k", "v"])
+
+
+def _agg_bytes(df) -> bytes:
+    chunks = [ch for p in range(df._chunks.num_partitions)
+              for ch in df._chunks.iter_partition(p)]
+    assert chunks, "empty result"
+    return b"".join(
+        np.ascontiguousarray(
+            np.concatenate([np.atleast_1d(ch[c]) for ch in chunks])).tobytes()
+        for c in sorted(chunks[0]))
+
+
+def _spy_stats(monkeypatch) -> dict:
+    """Capture the last run_exchange's stats without changing behavior."""
+    seen: dict = {}
+    orig = exchange.run_exchange
+
+    def spy(*a, **kw):
+        r = orig(*a, **kw)
+        seen.update(r.stats)
+        return r
+
+    monkeypatch.setattr(exchange, "run_exchange", spy)
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# columnar ↔ tuple identity, all five wide ops, 0/1/4 workers
+# ---------------------------------------------------------------------------
+
+def test_all_five_wide_ops_columnar_tuple_identity(_spill_here):
+    """The per-op identity sweep: serial (0), 1 and 4 workers, columnar
+    (auto) vs forced tuple — every format lands identical output. The
+    ops without a columnar plan (group_by_key, sort_by) are swept too:
+    their 'columnar' run must be a byte-identical no-op fallback."""
+    cases = {
+        "reduce_by_key": lambda ds, nw, tr: _collect_parts(
+            ds.reduce_by_key(lambda a, b: a + b, num_workers=nw,
+                             combine="sum", transport=tr)),
+        "group_by_key": lambda ds, nw, tr: _collect_parts(
+            ds.group_by_key(num_workers=nw)),
+        "sort_by": lambda ds, nw, tr: list(
+            ds.sort_by(lambda kv: kv[0], num_workers=nw).collect()),
+    }
+    for name, run in cases.items():
+        ref = run(_pairs_ds(), 0, "tuple")
+        for nw in (1, 4):
+            for tr in ("tuple", "columnar"):
+                got = run(_pairs_ds(), nw, tr)
+                assert got == ref, (name, nw, tr)
+    # distinct: the serial path keeps first-occurrence order in ONE
+    # partition by contract, so the exchange layouts compare against the
+    # tuple-transport exchange run (plus set-identity with serial)
+    def distinct_run(nw, tr):
+        return _collect_parts(_pairs_ds().map(lambda kv: kv[0])
+                              .distinct(num_workers=nw, transport=tr))
+
+    serial = set(_pairs_ds().map(lambda kv: kv[0])
+                 .distinct(num_workers=0).collect())
+    ref = distinct_run(1, "tuple")
+    assert {x for p in ref for x in p} == serial
+    for nw in (1, 4):
+        for tr in ("tuple", "columnar"):
+            assert distinct_run(nw, tr) == ref, ("distinct", nw, tr)
+    spec = {"v": "sum", "k": "count"}
+    ref = _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=0))
+    for nw in (1, 4):
+        for tr in ("tuple", "columnar"):
+            got = _agg_bytes(_agg_df().groupBy("k").agg(
+                spec, num_workers=nw, transport=tr))
+            assert got == ref, ("groupBy.agg", nw, tr)
+
+
+def test_columnar_is_the_auto_default_and_stats_say_so(
+        _spill_here, monkeypatch):
+    seen = _spy_stats(monkeypatch)
+    _agg_bytes(_agg_df().groupBy("k").agg({"v": "sum"}, num_workers=2))
+    assert seen["transport"] == "columnar"
+    assert seen["columnar_pairs"] == seen["pairs_in"] > 0
+    assert seen["tuple_pairs"] == 0
+    assert seen["columnar_bytes"] > 0
+    assert seen["columnar_buckets"] > 0 and seen["tuple_buckets"] == 0
+
+
+def test_reduce_by_key_declared_combine_goes_columnar(
+        _spill_here, monkeypatch):
+    seen = _spy_stats(monkeypatch)
+    out = _collect_parts(_pairs_ds().reduce_by_key(
+        lambda a, b: a + b, num_workers=2, combine="sum"))
+    assert seen["transport"] == "columnar"
+    ref = _collect_parts(_pairs_ds().reduce_by_key(
+        lambda a, b: a + b, num_workers=0))
+    assert out == ref
+    with pytest.raises(ValueError, match="combine"):
+        _pairs_ds().reduce_by_key(lambda a, b: a + b, combine="prod")
+
+
+# ---------------------------------------------------------------------------
+# mixed eligibility: some buckets columnar, some degrade to tuple
+# ---------------------------------------------------------------------------
+
+def _string_keys_for_bucket(bucket: int, n_out: int, count: int) -> list:
+    out = []
+    i = 0
+    while len(out) < count:
+        s = f"tok{i}"
+        if exchange.bucket_of(exchange.key_bytes((s,)), n_out) == bucket:
+            out.append(s)
+        i += 1
+    return out
+
+
+def test_mixed_eligibility_buckets_split_formats(_spill_here, monkeypatch):
+    """A dataset whose string-key chunk hashes entirely into bucket 0:
+    bucket 0 must degrade to tuple merging while the numeric buckets stay
+    columnar — and the output must equal the all-tuple run exactly."""
+    n_out = 3
+    strs = _string_keys_for_bucket(0, n_out, 40)
+
+    def mk():
+        int_chunks = [{"k": np.arange(i * 400, (i + 1) * 400,
+                                      dtype=np.int64),
+                       "v": np.full(400, float(i + 1))} for i in range(2)]
+        str_chunk = {"k": np.asarray(strs * 5),
+                     "v": np.arange(len(strs) * 5, dtype=np.float64)}
+        chunks = int_chunks + [str_chunk]
+        ds = PartitionedDataset.from_generators(
+            [(lambda c=c: iter([c])) for c in chunks])
+        return DataFrame(ds, ["k", "v"])
+
+    seen = _spy_stats(monkeypatch)
+    spec = {"v": "sum", "k": "count"}
+    got = _agg_bytes(mk().groupBy("k").agg(spec, num_workers=2))
+    assert seen["transport"] == "mixed"
+    assert seen["columnar_pairs"] > 0 and seen["tuple_pairs"] > 0
+    assert seen["columnar_buckets"] >= 1, seen
+    assert seen["tuple_buckets"] >= 1, seen
+    ref = _agg_bytes(mk().groupBy("k").agg(spec, num_workers=2,
+                                           transport="tuple"))
+    assert got == ref
+
+
+# ---------------------------------------------------------------------------
+# spill ≡ memory
+# ---------------------------------------------------------------------------
+
+def test_columnar_spill_path_equals_in_memory(_spill_here, monkeypatch):
+    spec = {"v": "sum", "k": "count"}
+    big = _agg_bytes(_agg_df(n=120_000, kmod=119_993).groupBy("k").agg(
+        spec, num_workers=2))
+    monkeypatch.setenv(exchange.MEM_MB_ENV, "4")  # floor budget → spills
+    seen = _spy_stats(monkeypatch)
+    spilled = _agg_bytes(_agg_df(n=120_000, kmod=119_993).groupBy("k").agg(
+        spec, num_workers=2))
+    assert seen["transport"] == "columnar"
+    assert seen["spills"] > 0, "4MB budget at 120k keys did not spill"
+    assert spilled == big
+
+
+# ---------------------------------------------------------------------------
+# dtype-edge keys + hash collisions
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.float32,
+                                   np.float64])
+def test_dtype_edge_keys_identity(_spill_here, dtype):
+    spec = {"v": "sum", "k": "count"}
+    ref = _agg_bytes(_agg_df(key_dtype=dtype).groupBy("k").agg(
+        spec, num_workers=2, transport="tuple"))
+    got = _agg_bytes(_agg_df(key_dtype=dtype).groupBy("k").agg(
+        spec, num_workers=2, transport="columnar"))
+    assert got == ref, dtype
+
+
+def test_int_sums_past_int32_fall_back_to_arbitrary_precision(_spill_here):
+    """Huge int values must NOT ride the int64 sum planes: the tuple
+    path's python ints are arbitrary-precision, and a wrapped int64
+    accumulator would be a silently wrong answer. Values past int32 fall
+    back per batch; in-range values stay columnar and exact."""
+    big = 2 ** 62
+    ds = PartitionedDataset.parallelize([(1, big), (1, big)], 2)
+    got = [kv for p in _collect_parts(ds.reduce_by_key(
+        lambda a, b: a + b, num_workers=2, combine="sum")) for kv in p]
+    assert got == [(1, 2 ** 63)]  # exact, not wrapped to -2**63
+    # and the declared-combine columnar path still matches for sane ints
+    ds2 = PartitionedDataset.parallelize(
+        [(i % 7, 2 ** 31 - 1) for i in range(100)], 4)
+    ref = _collect_parts(ds2.reduce_by_key(
+        lambda a, b: a + b, num_workers=2, transport="tuple"))
+    assert _collect_parts(PartitionedDataset.parallelize(
+        [(i % 7, 2 ** 31 - 1) for i in range(100)], 4).reduce_by_key(
+            lambda a, b: a + b, num_workers=2, combine="sum")) == ref
+
+
+def test_signed_zero_float_keys_fall_back(_spill_here, monkeypatch):
+    """-0.0 == 0.0 in a merge dict but pickles to different key bytes:
+    batches containing a signed zero must stay on the tuple path so both
+    transports sit on the same side of that documented caveat."""
+    seen = _spy_stats(monkeypatch)
+
+    def mk():
+        chunks = [{"k": np.asarray([-0.0, 1.0, 2.0]),
+                   "v": np.asarray([1.0, 2.0, 3.0])},
+                  {"k": np.asarray([0.0, 1.0, 2.0]),
+                   "v": np.asarray([4.0, 5.0, 6.0])}]
+        ds = PartitionedDataset.from_generators(
+            [(lambda c=c: iter([c])) for c in chunks])
+        return DataFrame(ds, ["k", "v"])
+
+    got = _agg_bytes(mk().groupBy("k").agg({"v": "sum"}, num_workers=2))
+    assert seen["transport"] == "tuple"  # every zero-bearing batch fell back
+    ref = _agg_bytes(mk().groupBy("k").agg({"v": "sum"}, num_workers=2,
+                                           transport="tuple"))
+    assert got == ref
+    # nw=1 is the sharp case: one mapper's dict merges -0.0 with 0.0 —
+    # a columnar 0.0 batch would miss that merge and emit two rows
+    got1 = _agg_bytes(mk().groupBy("k").agg({"v": "sum"}, num_workers=1))
+    ref1 = _agg_bytes(mk().groupBy("k").agg({"v": "sum"}, num_workers=1,
+                                            transport="tuple"))
+    assert got1 == ref1
+    # rdd scalar path: same guard
+    ds = PartitionedDataset.parallelize([(-0.0, 1), (0.0, 2)], 2)
+    got_r = [kv for p in _collect_parts(ds.reduce_by_key(
+        lambda a, b: a + b, num_workers=2, combine="sum")) for kv in p]
+    ref_r = [kv for p in _collect_parts(PartitionedDataset.parallelize(
+        [(-0.0, 1), (0.0, 2)], 2).reduce_by_key(
+            lambda a, b: a + b, num_workers=2,
+            transport="tuple")) for kv in p]
+    assert got_r == ref_r
+
+
+def test_bool_values_keep_their_type(_spill_here):
+    """min/max over bool values must come back as bools (the tuple
+    path's), never int64-plane 0/1 — bool values are tuple-path only."""
+    ds = PartitionedDataset.parallelize([(1, True), (1, False)], 2)
+    got = [kv for p in _collect_parts(ds.reduce_by_key(
+        min, num_workers=2, combine="min")) for kv in p]
+    assert got == [(1, False)]
+    assert type(got[0][1]) is bool
+
+
+def test_float_and_int_scalar_keys_reduce_by_key(_spill_here):
+    def mk(cast):
+        data = [(cast(i % 37), i) for i in range(2000)]
+        return PartitionedDataset.parallelize(data, 4)
+
+    for cast in (int, float):
+        ref = _collect_parts(mk(cast).reduce_by_key(
+            lambda a, b: a + b, num_workers=2, transport="tuple"))
+        got = _collect_parts(mk(cast).reduce_by_key(
+            lambda a, b: a + b, num_workers=2, combine="sum"))
+        assert got == ref, cast
+
+
+def test_hash_collisions_resolved_by_full_key_compare(
+        _spill_here, monkeypatch):
+    """Shrink the canonical hash to 8 BITS so distinct keys collide
+    constantly on the key_hash column: the columnar path must fall back
+    to full pickled-key comparison inside colliding runs and still match
+    the tuple path byte for byte (which shares the same patched hash)."""
+    def tiny_hash_key_bytes(key):
+        import hashlib as _h
+
+        kb = pickle.dumps(key, protocol=4)
+        return (b"\x00" * 7 + _h.blake2b(kb, digest_size=1).digest() + kb)
+
+    monkeypatch.setattr(exchange, "key_bytes", tiny_hash_key_bytes)
+    spec = {"v": "sum", "k": "count"}
+    # 151 distinct keys over 256 hash values → many guaranteed collisions
+    ref = _agg_bytes(_agg_df().groupBy("k").agg(
+        spec, num_workers=2, transport="tuple"))
+    got = _agg_bytes(_agg_df().groupBy("k").agg(
+        spec, num_workers=2, transport="columnar"))
+    assert got == ref
+    serial = _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=0))
+    assert got == serial
+
+
+def test_combine_colliding_orders_by_full_key_bytes(monkeypatch):
+    """Unit check of the collision fold itself: same fabricated hash for
+    every key → output order must be the pickled-bytes order, combines
+    still exact."""
+    plan = exchange.reduce_pair_plan("sum")
+    keys = np.array([300, 5, 300, 1000000, 5], dtype=np.int64)
+    vals = np.array([1, 2, 3, 4, 5], dtype=np.int64)
+    h = np.zeros(5, dtype=np.uint64)
+    out = exchange.combine_planes(exchange._Planes(h, (keys,), (vals,)),
+                                  plan)
+    # the collision fold re-derives each key's FULL key_bytes and orders
+    # by it (digest prefix + pickled tail — the tuple path's total order)
+    expect = sorted({300: 4, 5: 7, 1000000: 4}.items(),
+                    key=lambda t: exchange.key_bytes(t[0]))
+    assert out.keys[0].tolist() == [k for k, _ in expect]
+    assert out.vals[0].tolist() == [v for _, v in expect]
+
+
+# ---------------------------------------------------------------------------
+# exact plane metering (the _ByteMeter satellite)
+# ---------------------------------------------------------------------------
+
+def test_byte_meter_add_exact_charges_planes_verbatim():
+    m = exchange._ByteMeter()
+    m.add_exact(16 << 20)
+    assert m.value == float(16 << 20)
+    m.add_exact(100)
+    assert m.value == float((16 << 20) + 100)
+    # the sampled path is unchanged: 64 tiny adds charge ~estimate each,
+    # nowhere near what one exact 16MB plane charges
+    sampled = exchange._ByteMeter()
+    for _ in range(64):
+        sampled.add(b"x" * 32)
+    assert sampled.value < 1 << 16
+    m.reset()
+    assert m.value == 0.0
+
+
+def test_planes_nbytes_is_exact():
+    pl = exchange._Planes(
+        np.zeros(100, np.uint64), (np.zeros(100, np.int64),),
+        (np.zeros(100, np.float64), np.zeros(100, np.int64)))
+    assert pl.nbytes == 100 * 8 * 4
+
+
+# ---------------------------------------------------------------------------
+# device-side segment-reduce (data/device_agg.py)
+# ---------------------------------------------------------------------------
+
+def _fresh_device_agg(monkeypatch):
+    from distributeddeeplearningspark_tpu.data import device_agg
+
+    monkeypatch.setattr(device_agg, "_kernels", {})
+    monkeypatch.setattr(device_agg, "_state", {"available": None})
+    return device_agg
+
+
+@pytest.mark.parametrize("spec", [
+    {"v": "count"}, {"v": "sum"}, {"v": "min"}, {"v": "max"},
+    {"v": "mean"}, {"v": "sum", "k": "count"},
+])
+def test_device_agg_matches_exchange_bit_exact(_spill_here, monkeypatch,
+                                               spec):
+    device_agg = _fresh_device_agg(monkeypatch)
+    if not device_agg.available():
+        pytest.skip("no jax device available")
+    ref = _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=2))
+    got = _agg_bytes(_agg_df().groupBy("k").agg(spec, transport="device"))
+    assert got == ref, spec
+    serial = _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=0))
+    assert got == serial, spec
+
+
+def test_device_agg_ledger_and_warm_repeat(_spill_here, monkeypatch,
+                                           tmp_path):
+    """Acceptance: device compiles appear in the PR 9 ledger and a warm
+    repeat at the same shapes compiles nothing (no recompile flags)."""
+    device_agg = _fresh_device_agg(monkeypatch)
+    if not device_agg.available():
+        pytest.skip("no jax device available")
+    wd = tmp_path / "wd"
+    telemetry.configure(str(wd))
+    try:
+        first = _agg_bytes(_agg_df().groupBy("k").agg(
+            {"v": "sum", "k": "count"}, transport="device"))
+        events = telemetry.read_events(str(wd))
+        compiles = [e for e in events if e.get("kind") == "compile"
+                    and str(e.get("fn", "")).startswith("device_agg.")]
+        assert compiles, "no ledgered device_agg compiles"
+        assert not any(e.get("recompile") for e in compiles), compiles
+        n = len(compiles)
+        again = _agg_bytes(_agg_df().groupBy("k").agg(
+            {"v": "sum", "k": "count"}, transport="device"))
+        assert again == first
+        events = telemetry.read_events(str(wd))
+        compiles2 = [e for e in events if e.get("kind") == "compile"
+                     and str(e.get("fn", "")).startswith("device_agg.")]
+        assert len(compiles2) == n, "warm repeat recompiled"
+        # the device run lands the standard shuffle done event too
+        done = [e for e in events if e.get("kind") == "shuffle"
+                and e.get("edge") == "done"]
+        assert done and done[-1]["transport"] == "device"
+        rep = status.report(str(wd), anatomy=True)
+        by_fn = rep["anatomy"]["compile_ledger"]["by_fn"]
+        assert any(fn.startswith("device_agg.") for fn in by_fn), by_fn
+    finally:
+        telemetry.reset()
+
+
+def test_device_agg_rejects_non_numeric_keys(_spill_here, monkeypatch):
+    device_agg = _fresh_device_agg(monkeypatch)
+    if not device_agg.available():
+        pytest.skip("no jax device available")
+    ds = PartitionedDataset.from_generators([lambda: iter(
+        [{"k": np.asarray(["a", "b", "a"]),
+          "v": np.asarray([1.0, 2.0, 3.0])}])])
+    df = DataFrame(ds, ["k", "v"])
+    g = df.groupBy("k").agg({"v": "sum"}, transport="device")
+    with pytest.raises(ValueError, match="numeric"):
+        list(g._chunks.iter_partition(0))
+
+
+def test_top_v_matches_heap_semantics(monkeypatch):
+    device_agg = _fresh_device_agg(monkeypatch)
+    if not device_agg.available():
+        pytest.skip("no jax device available")
+    import heapq
+
+    rng = np.random.default_rng(3)
+    toks = np.asarray([f"t{i}" for i in range(5000)])
+    cnts = rng.integers(1, 40, size=5000)  # heavy ties → tie-break matters
+    tv = device_agg.TopV(50, block=512)
+    for lo in range(0, 5000, 700):  # uneven update blocks
+        tv.update(cnts[lo:lo + 700], toks[lo:lo + 700])
+    got = tv.ranked()
+    heap: list = []
+    for c, t in zip(cnts.tolist(), toks.tolist()):
+        item = (c, t)
+        if len(heap) < 50:
+            heapq.heappush(heap, item)
+        elif item > heap[0]:
+            heapq.heapreplace(heap, item)
+    expect = sorted(heap, reverse=True)
+    assert got == expect
+
+
+def test_segment_reduce_kernels_exact(monkeypatch):
+    device_agg = _fresh_device_agg(monkeypatch)
+    if not device_agg.available():
+        pytest.skip("no jax device available")
+    v = np.asarray([1.5, 2.5, -3.0, 7.0, 0.25], np.float64)
+    ids = np.asarray([0, 0, 1, 1, 2], np.int32)
+    assert device_agg.segment_reduce("sum", v, ids, 3).tolist() == \
+        [4.0, 4.0, 0.25]
+    assert device_agg.segment_reduce("min", v, ids, 3).tolist() == \
+        [1.5, -3.0, 0.25]
+    assert device_agg.segment_reduce("max", v, ids, 3).tolist() == \
+        [2.5, 7.0, 0.25]
+    c = np.asarray([1, 1, 1, 1, 1], np.int64)
+    assert device_agg.segment_reduce("sum", c, ids, 3).tolist() == [2, 2, 1]
+
+
+# ---------------------------------------------------------------------------
+# telemetry / dlstatus per-format rows
+# ---------------------------------------------------------------------------
+
+def test_shuffle_per_format_rows_in_dlstatus(_spill_here, tmp_path):
+    wd = tmp_path / "wd"
+    telemetry.configure(str(wd))
+    try:
+        spec = {"v": "sum"}
+        _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=2))
+        _agg_bytes(_agg_df().groupBy("k").agg(spec, num_workers=2,
+                                              transport="tuple"))
+    finally:
+        telemetry.reset()
+    sh = status.shuffle_from(telemetry.read_events(str(wd)))
+    assert sh is not None
+    fmts = sh["formats"]
+    assert fmts["columnar"]["pairs"] > 0
+    assert fmts["columnar"]["bytes"] > 0
+    assert fmts["columnar"]["buckets"] > 0
+    assert fmts["tuple"]["pairs"] > 0  # the forced-tuple second run
+    assert (fmts["columnar"]["pairs"] + fmts["tuple"]["pairs"]
+            == sh["pairs_in"])
+    assert (fmts["columnar"]["bytes"] + fmts["tuple"]["bytes"]
+            == sh["bytes_moved"])
+    assert sh["last"]["transport"] == "tuple"  # newest run was forced
+    # rendered text carries the per-format line
+    rep = status.report(str(wd))
+    text = status.render(rep)
+    assert "by format" in text and "columnar:" in text
+
+
+def test_resolve_transport_validation(monkeypatch):
+    monkeypatch.delenv(exchange.TRANSPORT_ENV, raising=False)
+    assert exchange.resolve_transport(None) == "auto"
+    assert exchange.resolve_transport("tuple") == "tuple"
+    monkeypatch.setenv(exchange.TRANSPORT_ENV, "columnar")
+    assert exchange.resolve_transport(None) == "columnar"
+    monkeypatch.setenv(exchange.TRANSPORT_ENV, "device")
+    assert exchange.resolve_transport(None) == "auto"  # env device, rdd op
+    assert exchange.resolve_transport(
+        None, allow_device=True) == "device"
+    with pytest.raises(ValueError, match="device"):
+        exchange.resolve_transport("device")  # explicit on an rdd op
+    with pytest.raises(ValueError, match="unknown"):
+        exchange.resolve_transport("arrow")
+
+
+def test_no_child_or_shm_leaks_after_columnar_runs(_spill_here):
+    _agg_bytes(_agg_df().groupBy("k").agg({"v": "sum"}, num_workers=2))
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if not [p for p in mp.active_children()
+                if p.name.startswith("dlsx-")]:
+            break
+        time.sleep(0.05)
+    assert not [p for p in mp.active_children()
+                if p.name.startswith("dlsx-")]
+    if os.path.isdir("/dev/shm"):
+        mine = [f for f in os.listdir("/dev/shm")
+                if f.startswith(f"dlsx-{os.getpid()}-")]
+        assert not mine, mine
+    import gc
+
+    gc.collect()

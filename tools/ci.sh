@@ -350,19 +350,23 @@ PYEOF
   return $rc
 }
 
-# shuffle smoke (ISSUE 8): a 10M-key groupBy().agg — the workload the
-# serial max_groups ceiling REFUSES (asserted first) — completes through
-# the 2-worker exchange under a DLS_SHUFFLE_MEM_MB budget, with the exact
-# expected result (vectorized content check + key-set check + canonical-
-# order spot checks, blake2b checksum logged for cross-round comparison),
-# >=1 reducer spill in telemetry, and the dlstatus shuffle block schema.
+# shuffle smoke (ISSUE 8 + 12): a 10M-key groupBy().agg — the workload
+# the serial max_groups ceiling REFUSES (asserted first) — completes
+# through the 2-worker exchange under a DLS_SHUFFLE_MEM_MB budget, TWICE:
+# once forced onto the tuple transport (content-verified, blake2b
+# checksum + keys/s logged) and once through the columnar transport at
+# the SAME budget, asserting the checksum matches the tuple path's, the
+# >=5x keys/s gate, >=1 reducer spill, and the dlstatus shuffle block's
+# per-format rows. Then a 1M-key device-transport stage: bit-equal
+# checksum, compiles in the PR 9 ledger, and a warm repeat that compiles
+# NOTHING (no recompile flag).
 run_shuffle_smoke() {
   local t0 rc wd out
   t0=$(date +%s)
   rc=0
   wd=$(mktemp -d /tmp/dls_shuffle_smoke.XXXXXX)
-  out=$( (WD="$wd" DLS_SHUFFLE_MEM_MB=64 python - <<'PYEOF'
-import hashlib, os, sys
+  out=$( (WD="$wd" DLS_SHUFFLE_MEM_MB=64 JAX_PLATFORMS=cpu python - <<'PYEOF'
+import hashlib, os, sys, time
 import numpy as np
 
 from distributeddeeplearningspark_tpu import telemetry
@@ -373,17 +377,56 @@ from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
 N, NCHUNK, DUP = 10_000_000, 20, 100_000
 rows = N // NCHUNK
 
-def chunk(i):
+def chunk(i, n):
     if i == NCHUNK:  # duplicate chunk: keys 0..DUP reappear, so the
-        k = np.arange(DUP, dtype=np.int64)  # reducers really combine
-    else:           # across partitions at scale, not just concatenate
-        k = np.arange(i * rows, (i + 1) * rows, dtype=np.int64)
+        k = np.arange(min(DUP, n), dtype=np.int64)  # reducers really
+    else:           # combine across partitions, not just concatenate
+        r = n // NCHUNK
+        k = np.arange(i * r, (i + 1) * r, dtype=np.int64)
     return {"k": k, "v": (k % 97).astype(np.float64)}
 
-def df():
+def df(n=N):
     ds = PartitionedDataset.from_generators(
-        [(lambda i=i: iter([chunk(i)])) for i in range(NCHUNK + 1)])
+        [(lambda i=i: iter([chunk(i, n)])) for i in range(NCHUNK + 1)])
     return DataFrame(ds, ["k", "v"])
+
+def run_and_verify(transport, n=N, workers=2, order_checks=True):
+    """One full agg pass: vectorized content check + canonical-order
+    spot checks + blake2b over the concatenated column stream (chunk
+    boundaries are layout, not content — they differ by transport)."""
+    g = df(n).groupBy("k").agg({"v": "sum", "k": "count"},
+                               num_workers=workers, transport=transport)
+    t0 = time.perf_counter()
+    parts = [[ch for ch in g._chunks.iter_partition(p)]
+             for p in range(g._chunks.num_partitions)]
+    dt = time.perf_counter() - t0
+    nrows, keys = 0, []
+    for chunks_p in parts:
+        prev_kb = None
+        for ch in chunks_p:
+            k, s, c = ch["k"], ch["sum(v)"], ch["count(k)"]
+            expect_c = 1 + (k < DUP)
+            assert np.array_equal(c, expect_c), "bad counts"
+            assert np.array_equal(
+                s, expect_c * (k % 97).astype(np.float64)), "bad sums"
+            if order_checks:
+                for i in range(0, len(k), 4096):  # canonical-order spots
+                    kb = exchange.key_bytes((int(k[i]),))
+                    assert prev_kb is None or kb > prev_kb, \
+                        "not in key_bytes order"
+                    prev_kb = kb
+            keys.append(k)
+            nrows += len(k)
+    assert nrows == n, (nrows, n)
+    allk = np.concatenate(keys)
+    assert np.array_equal(np.sort(allk), np.arange(n, dtype=np.int64)), \
+        "key set wrong"
+    flat = [ch for chunks_p in parts for ch in chunks_p]
+    h = hashlib.blake2b(digest_size=16)
+    for c in sorted(flat[0]):
+        h.update(np.ascontiguousarray(
+            np.concatenate([ch[c] for ch in flat])).tobytes())
+    return n / dt, h.hexdigest()
 
 # 1) the old ceiling refuses this workload on the serial path
 try:
@@ -393,54 +436,82 @@ try:
 except ValueError as e:
     assert "max_groups" in str(e) and "DLS_DATA_WORKERS" in str(e), str(e)
 
-# 2) the same workload completes through the 2-worker exchange under the
-#    64MB budget; verify the full result content + canonical order
 telemetry.configure(os.environ["WD"])
-g = df().groupBy("k").agg({"v": "sum", "k": "count"}, num_workers=2)
-h = hashlib.blake2b(digest_size=16)
-keys, nrows = [], 0
-for p in range(g._chunks.num_partitions):
-    prev_kb = None
-    for ch in g._chunks.iter_partition(p):
-        k, s, c = ch["k"], ch["sum(v)"], ch["count(k)"]
-        expect_c = 1 + (k < DUP)
-        assert np.array_equal(c, expect_c), "bad counts"
-        assert np.array_equal(s, expect_c * (k % 97).astype(np.float64)), \
-            "bad sums"
-        for i in range(0, len(k), 4096):  # canonical-order spot checks
-            kb = exchange.key_bytes((int(k[i]),))
-            assert prev_kb is None or kb > prev_kb, "not in key_bytes order"
-            prev_kb = kb
-        h.update(np.ascontiguousarray(k).tobytes())
-        h.update(np.ascontiguousarray(s).tobytes())
-        keys.append(k)
-        nrows += len(k)
-assert nrows == N, nrows
-allk = np.sort(np.concatenate(keys))
-assert np.array_equal(allk, np.arange(N, dtype=np.int64)), "key set wrong"
+
+# 2) tuple transport: the pre-columnar baseline, content-verified
+tuple_rate, tuple_sum = run_and_verify("tuple", order_checks=False)
+
+# 3) columnar transport, same workload, same 64MB budget: checksum must
+#    match the tuple path's, and the keys/s gate is >=5x
+ev_mark = len(telemetry.read_events(os.environ["WD"]))
+cols_rate, cols_sum = run_and_verify("columnar")
+assert cols_sum == tuple_sum, f"checksum diverged: {cols_sum} vs {tuple_sum}"
+speedup = cols_rate / tuple_rate
+assert speedup >= 5.0, \
+    f"columnar {cols_rate:.0f} keys/s is only {speedup:.1f}x tuple " \
+    f"{tuple_rate:.0f} keys/s (gate: >=5x)"
+cols_events = telemetry.read_events(os.environ["WD"])[ev_mark:]
+cols_spills = [e for e in cols_events
+               if e.get("kind") == "shuffle" and e.get("edge") == "spill"]
+assert cols_spills, "no columnar spill events under a 64MB budget at 10M keys"
+cols_done = [e for e in cols_events
+             if e.get("kind") == "shuffle" and e.get("edge") == "done"][-1]
+assert cols_done["transport"] == "columnar", cols_done["transport"]
+assert cols_done["columnar_pairs"] == N + DUP and cols_done["tuple_pairs"] == 0
+
+# 4) device transport at 1M keys: bit-equal, ledgered compiles, and a
+#    warm repeat that compiles nothing
+ND = 1_000_000
+_, cols_sum_1m = run_and_verify("columnar", n=ND, order_checks=False)
+_, dev_sum = run_and_verify("device", n=ND, workers=0, order_checks=False)
+assert dev_sum == cols_sum_1m, "device output diverged from the exchange"
+events = telemetry.read_events(os.environ["WD"])
+compiles = [e for e in events if e.get("kind") == "compile"
+            and str(e.get("fn", "")).startswith("device_agg.")]
+assert compiles, "device-agg compiles missing from the ledger"
+n_compiles = len(compiles)
+_, dev_sum2 = run_and_verify("device", n=ND, workers=0, order_checks=False)
+assert dev_sum2 == dev_sum
+events = telemetry.read_events(os.environ["WD"])
+compiles2 = [e for e in events if e.get("kind") == "compile"
+             and str(e.get("fn", "")).startswith("device_agg.")]
+assert len(compiles2) == n_compiles, \
+    f"warm device repeat recompiled ({len(compiles2)} vs {n_compiles})"
+assert not any(e.get("recompile") for e in compiles2), \
+    "device-agg compile flagged recompile"
 telemetry.reset()
 
-# 3) telemetry carries >=1 spill and the dlstatus shuffle block schema
+# 5) the dlstatus shuffle block schema, incl. the per-format rows
 from distributeddeeplearningspark_tpu import status
 
-events = telemetry.read_events(os.environ["WD"])
-spills = [e for e in events
-          if e.get("kind") == "shuffle" and e.get("edge") == "spill"]
-assert spills, "no spill events under a 64MB budget at 10M keys"
-rep = status.report(os.environ["WD"])
+rep = status.report(os.environ["WD"], anatomy=True)
 sh = rep["shuffle"]
 assert sh is not None, "dlstatus found no shuffle block"
 for key in ("ops", "pairs_in", "rows_out", "bytes_moved", "spills",
-            "spill_events", "overflow", "last"):
+            "spill_events", "overflow", "formats", "last"):
     assert key in sh, key
 for key in ("op", "workers", "buckets", "map_s", "merge_s", "spills",
-            "mem_budget_mb", "bucket_rows_max", "bucket_rows_mean",
-            "skew", "verdict"):
+            "mem_budget_mb", "transport", "bucket_rows_max",
+            "bucket_rows_mean", "skew", "verdict"):
     assert key in sh["last"], key
-assert sh["last"]["op"] == "groupBy.agg" and sh["pairs_in"] == N + DUP
-print(f"keys=10M budget=64MB spills={sh['spills']} "
-      f"moved={sh['bytes_moved'] / 1e6:.0f}MB skew={sh['last']['skew']} "
-      f"checksum={h.hexdigest()}")
+for fmt in ("columnar", "tuple"):
+    for key in ("pairs", "bytes", "buckets"):
+        assert key in sh["formats"][fmt], (fmt, key)
+assert sh["formats"]["columnar"]["pairs"] > 0
+assert sh["formats"]["tuple"]["pairs"] > 0  # the forced-tuple baseline run
+assert sh["last"]["op"] == "groupBy.agg"
+# the device compiles surface through `dlstatus --anatomy` itself
+anat = rep.get("anatomy")
+assert anat is not None, "no anatomy block despite device compiles"
+by_fn = anat["compile_ledger"]["by_fn"]
+dev_rows = {fn: r for fn, r in by_fn.items()
+            if fn.startswith("device_agg.")}
+assert dev_rows, f"device_agg missing from the anatomy ledger: {list(by_fn)}"
+assert all(r["flagged_recompiles"] == 0 for r in dev_rows.values()), dev_rows
+print(f"keys=10M budget=64MB tuple={tuple_rate / 1e3:.0f}k/s "
+      f"columnar={cols_rate / 1e3:.0f}k/s speedup={speedup:.1f}x "
+      f"spills={len(cols_spills)} checksum={cols_sum} "
+      f"device_compiles={n_compiles}")
 PYEOF
 ) ) || rc=$?
   log shuffle "${out:-shuffle smoke failed}" "${rc}" $(( $(date +%s) - t0 ))

@@ -51,9 +51,20 @@ import sys
 from typing import Any
 
 #: per-arm numeric fields guarded, with their regression direction.
+#: The shuffle fields carry their transport tag IN the name
+#: (``shuffle_columnar_keys_per_sec``, not a shared ``shuffle_keys_per_
+#: sec``) — that is the baseline scoping: a check only folds history
+#: records that measured the SAME transport arm, so pre-columnar rounds
+#: (which have no tagged fields) contribute nothing and the new arms are
+#: never judged against the tuple ceiling (they sit at
+#: insufficient-history until two tagged rounds exist).
 HIGHER_BETTER = ("images_per_sec_per_chip", "tokens_per_sec_per_chip",
                  "examples_per_sec_per_chip", "host_images_per_sec",
-                 "decode_tokens_per_sec_per_chip", "mfu", "mfu_model")
+                 "decode_tokens_per_sec_per_chip", "mfu", "mfu_model",
+                 "shuffle_tuple_keys_per_sec",
+                 "shuffle_columnar_keys_per_sec",
+                 "shuffle_device_keys_per_sec",
+                 "columnar_speedup_vs_tuple")
 LOWER_BETTER = ("step_time_ms", "compile_s")
 ZERO_EXPECTED = ("recompile_count",)
 
