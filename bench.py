@@ -1117,6 +1117,115 @@ def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
     }
 
 
+def bench_mpmd(iters: int, *, batch_size: int = 8, seq: int = 96,
+               microbatches: int = 4) -> dict:
+    """MPMD 2-stage pipeline throughput + bubble fraction (ISSUE 13).
+
+    Two in-process stage programs (exact mode, each on half the visible
+    devices) over the real socket transport; the bubble fraction comes
+    from the run's own trace spans (``telemetry.fleet.pipeline_anatomy``),
+    so ``pipeline_bubble_frac`` gets cross-round regression teeth in
+    ``tools/perf_guard.py`` — transport or scheduling regressions show up
+    as bubble growth before they show up as lost steps/sec.
+    """
+    import secrets
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+    import optax
+
+    from distributeddeeplearningspark_tpu import telemetry
+    from distributeddeeplearningspark_tpu.models import LlamaConfig
+    from distributeddeeplearningspark_tpu.parallel import mpmd as mpmd_lib
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+    from distributeddeeplearningspark_tpu.supervisor import free_port
+    from distributeddeeplearningspark_tpu.telemetry import fleet as fleet_lib
+    from distributeddeeplearningspark_tpu.train.pipeline_trainer import (
+        LlamaStageProgram,
+        PipelineStageRunner,
+        StageRunConfig,
+        theoretical_bubble,
+    )
+
+    cfg = LlamaConfig.tiny()
+    steps = max(6, iters)
+    warmup = 2
+
+    def batch_fn(step: int) -> dict:
+        rng = np.random.default_rng(1000 + step)
+        return {"input_ids": rng.integers(
+                    0, cfg.vocab_size, (batch_size, seq)).astype(np.int32),
+                "loss_mask": np.ones((batch_size, seq), np.float32)}
+
+    devs = jax.devices()
+    # each stage takes half the devices, capped so a microbatch still
+    # shards (rows-per-microbatch must divide by the stage's data width)
+    half = max(1, min(len(devs) // 2, batch_size // microbatches))
+    stage_devs = [devs[:half], devs[half:half * 2] or devs[:half]]
+    wd = tempfile.mkdtemp(prefix="dls_bench_mpmd_")
+    telemetry.configure(wd)
+    ports, key = [free_port()], secrets.token_bytes(16)
+    results: dict = {}
+    errors: dict = {}
+
+    def run_stage(stage: int) -> None:
+        try:
+            mesh = MeshSpec(data=len(stage_devs[stage])).build(
+                stage_devs[stage])
+            prog = LlamaStageProgram(cfg, stage, 2, mesh,
+                                     optax.adamw(1e-3), mode="exact")
+            tr = mpmd_lib.PipelineTransport(stage, 2, ports, key,
+                                            connect_timeout=300)
+            r = PipelineStageRunner(
+                prog, tr,
+                StageRunConfig(steps=steps, batch_size=batch_size,
+                               microbatches=microbatches, seed=0),
+                batch_fn=batch_fn if stage == 0 else None)
+            results[stage] = r.run()
+        except BaseException as e:  # noqa: BLE001 — reported below
+            errors[stage] = e
+
+    ths = [threading.Thread(target=run_stage, args=(s,)) for s in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(1800)
+    if any(t.is_alive() for t in ths):
+        # a wedged stage must be a NAMED timeout, not a downstream
+        # KeyError after teardown races the still-running writer
+        raise RuntimeError(
+            "mpmd bench stage(s) still running after 1800s "
+            f"(alive: {[i for i, t in enumerate(ths) if t.is_alive()]})")
+    if errors:
+        raise RuntimeError(f"mpmd bench stage failed: {errors}")
+    events = telemetry.read_events(wd)
+    telemetry.reset()
+    shutil.rmtree(wd, ignore_errors=True)
+    laps = [float(e["lap_s"]) for e in events
+            if e.get("kind") == "step_metrics" and e.get("process") == "p0"]
+    timed = laps[warmup:] or laps
+    pl = fleet_lib.pipeline_anatomy(events) or {}
+    return {
+        "steps_per_sec": round(len(timed) / sum(timed), 3) if timed else 0.0,
+        "pipeline_bubble_frac": pl.get("measured_bubble_frac"),
+        "theoretical_bubble_frac": (
+            pl.get("theoretical_bubble_frac")
+            or round(theoretical_bubble(microbatches, 2), 4)),
+        "stages": 2,
+        "devices_per_stage": half,
+        "microbatches": microbatches,
+        "batch_size": batch_size,
+        "seq": seq,
+        "steps": steps,
+        "mode": "exact",
+        "final_loss": (results[0]["losses"] or [None])[-1],
+        **_host_conditions(),
+    }
+
+
 def pallas_smoke() -> dict:
     """Compile-and-run flash attention fwd+bwd on the real chip (Mosaic).
 
@@ -1611,7 +1720,7 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model",
                     choices=["all", "resnet", "bert", "llama", "dlrm", "input",
-                             "kernels", "memval"],
+                             "mpmd", "kernels", "memval"],
                     default="all")
     ap.add_argument("--chip-queue", action="store_true",
                     help="run the whole chip-window backlog (CHIP_QUEUE) as "
@@ -1791,6 +1900,7 @@ def main(argv=None) -> int:
             "llama": ("llama_decode",) if args.decode else ("llama_lora",),
             "dlrm": ("dlrm",),
             "input": ("input_pipeline",),
+            "mpmd": ("mpmd_pipeline",),
             "kernels": ("pallas_kernels",),
             "memval": ("memory_validation",)}[args.model]
     runners = {
@@ -1815,6 +1925,9 @@ def main(argv=None) -> int:
             **({"seq": args.seq} if args.seq else {})),
         "input_pipeline": lambda: bench_input(
             args.iters, **({"batch_size": args.batch} if args.batch else {})),
+        "mpmd_pipeline": lambda: bench_mpmd(
+            args.iters, **({"batch_size": args.batch} if args.batch else {}),
+            **({"seq": args.seq} if args.seq else {})),
         "dlrm": lambda: bench_dlrm(
             args.iters, scatter_ab=args.scatter_ab,
             **({"batch_size": args.batch} if args.batch else {})),
@@ -1867,6 +1980,17 @@ def main(argv=None) -> int:
         name, r = "input_pipeline", results["input_pipeline"]
         value, unit = r["host_images_per_sec"], "images/sec/host"
         metric = "input_pipeline_host_images_per_sec"
+    elif "mpmd_pipeline" in results:
+        r = results["mpmd_pipeline"]
+        emit("mpmd_pipeline_steps_per_sec", r["steps_per_sec"], "steps/sec",
+             0.0, {**extra, **results},
+             headline={
+                 "metric": "mpmd_pipeline_steps_per_sec",
+                 "value": r["steps_per_sec"], "unit": "steps/sec",
+                 "note": (f"2-stage exact pipeline, bubble "
+                          f"{r['pipeline_bubble_frac']} vs bound "
+                          f"{r['theoretical_bubble_frac']}")})
+        return 0
     elif "pallas_kernels" in results:
         r = results["pallas_kernels"]
         n_ok = sum(1 for kn in ("conv_bn", "scatter_rows", "ulysses_smoke")

@@ -39,16 +39,9 @@ from distributeddeeplearningspark_tpu.parallel.mesh import AXIS_PIPE
 from distributeddeeplearningspark_tpu.parallel.pipeline import pipeline, stack_stages
 
 
-def make_pp_apply(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int | None = None):
-    """Build an ``apply_fn(variables, batch, train=..., rngs=...)`` running
-    the decoder trunk through P pipeline stages.
-
-    Drop-in for ``model.apply`` in :func:`..train.step.make_train_step`; the
-    parameter tree is the ordinary :class:`LlamaForCausalLM` one.
-    """
-    p = int(mesh.shape[AXIS_PIPE])
-    if p < 2:
-        raise ValueError(f"pipeline apply needs a pipe axis > 1 (mesh {dict(mesh.shape)})")
+def check_pp_config(cfg: LlamaConfig, p: int) -> None:
+    """The shared pipeline-compatibility ladder (single-program GPipe and
+    the MPMD multi-gang trainer enforce the same contract)."""
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires scan_layers=True "
                          "(stacked [L, ...] params are what stages reshape)")
@@ -65,16 +58,28 @@ def make_pp_apply(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int | None = N
             "losses.causal_lm (or drop the config flag)")
     if cfg.num_layers % p:
         raise ValueError(f"num_layers {cfg.num_layers} must divide by pipe {p}")
-    m = num_microbatches or p
-    stage_len = cfg.num_layers // p
+
+
+def build_stage_modules(cfg: LlamaConfig, stage_len: int):
+    """(stage_mod, embed_mod, norm_mod, head_mod) — the EXACT module stack
+    both pipeline implementations run, factored so the MPMD per-gang stage
+    program (train/pipeline_trainer.py) computes bit-for-bit the same math
+    as this module's single-program GPipe ring."""
+    from distributeddeeplearningspark_tpu.models.llama import (
+        _barrier_differentiable,
+    )
 
     layer_cls = DecoderLayer
-    if cfg.scan_param_barrier:
+    if cfg.scan_param_barrier and _barrier_differentiable():
         # same whole-stack relayout hazard as the non-PP scan (see
         # LlamaConfig.scan_param_barrier): each stage's [L/P, ...] stacked
         # weights would otherwise grow hoisted fwd+bwd layout copies.
         # Ordering as in llama.py: inside the remat region, or the barrier
-        # outputs become per-layer saved residuals.
+        # outputs become per-layer saved residuals — and like llama.py's
+        # own scan, the wrap must auto-disable on jax builds whose
+        # optimization_barrier has no autodiff rule, or every backward
+        # through a pipeline stage dies (llama.py got this guard in the
+        # jax-skew fix round; this path had been left behind).
         layer_cls = nn.map_variables(
             layer_cls, "params",
             trans_in_fn=lambda tree: jax.tree.map(
@@ -91,6 +96,23 @@ def make_pp_apply(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int | None = N
     embed_mod = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype)
     norm_mod = RMSNorm(cfg.rms_eps, cfg.dtype)
     head_mod = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype)
+    return stage_mod, embed_mod, norm_mod, head_mod
+
+
+def make_pp_apply(cfg: LlamaConfig, mesh: Mesh, num_microbatches: int | None = None):
+    """Build an ``apply_fn(variables, batch, train=..., rngs=...)`` running
+    the decoder trunk through P pipeline stages.
+
+    Drop-in for ``model.apply`` in :func:`..train.step.make_train_step`; the
+    parameter tree is the ordinary :class:`LlamaForCausalLM` one.
+    """
+    p = int(mesh.shape[AXIS_PIPE])
+    if p < 2:
+        raise ValueError(f"pipeline apply needs a pipe axis > 1 (mesh {dict(mesh.shape)})")
+    check_pp_config(cfg, p)
+    m = num_microbatches or p
+    stage_len = cfg.num_layers // p
+    stage_mod, embed_mod, norm_mod, head_mod = build_stage_modules(cfg, stage_len)
 
     def stage_fn(stage_params: Any, act):
         out, _ = stage_mod.apply({"params": stage_params}, act, None, None)

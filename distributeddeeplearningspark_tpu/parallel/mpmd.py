@@ -1,0 +1,573 @@
+"""MPMD inter-stage transport — async authkey'd socket links between gangs.
+
+PAPERS.md 2412.14374 ("Scaling Deep Learning Training with MPMD Pipeline
+Parallelism") describes the production pipeline shape: each stage is its own
+*program* on its own gang, and stages overlap compute with asynchronous
+activation/gradient sends. Inside one program that overlap is XLA's job
+(``parallel/pipeline.py``'s ppermute ring); across programs it has to be a
+real wire. This module is that wire:
+
+- **Framing**: length-prefixed binary frames — magic, version, kind, stage,
+  microbatch index, payload CRC32, payload length — carrying a pickled
+  payload (numpy activations + metadata + the PR 7 trace context). The
+  CRC and magic make torn/partial frames a *typed* :class:`FrameError`
+  instead of a desync that unpickles garbage.
+- **Auth**: the serve/fleet authkey'd-connection idiom (hex key via env,
+  HMAC challenge both ways) hand-rolled on a raw socket, because the
+  framing above — not ``multiprocessing.connection``'s — owns the stream.
+- **Async double-buffering**: each :class:`StageLink` runs a sender and a
+  receiver thread over bounded deques (default depth 2), so stage *k*
+  computes microbatch *i* while microbatch *i+1* is already in flight —
+  and a slow consumer propagates bounded backpressure (deque full → TCP
+  buffer full → sender blocks) instead of buffering unboundedly.
+- **Failure typing**: a peer process dying tears the socket; every blocked
+  and future ``send``/``recv`` raises :class:`PeerDiedError` within a
+  bounded wait. A peer that is alive but silent past ``timeout`` raises
+  :class:`TransportTimeout`. The pipeline supervisor restarts only the
+  dead stage; survivors block in :meth:`PipelineTransport.connect` until
+  it returns (docs/POD_PLAYBOOK.md "A pipeline stage died").
+- **Chain topology + resync**: stage *k* listens on ``ports[k]`` for stage
+  *k+1* and dials ``ports[k-1]``; after any (re)connect
+  :meth:`PipelineTransport.sync_step` runs a forward-min / backward-
+  broadcast wave so every stage agrees on the checkpoint step to resume
+  from — the restarted stage restores its own per-stage checkpoint, the
+  survivors roll back to the same step, and training continues.
+
+jax-free by design (numpy arrives via pickle): the framing is also the
+serve-side prefill/decode disaggregation transport named on the ROADMAP.
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import logging
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Any
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.mpmd")
+
+MAGIC = b"DLSP"
+VERSION = 1
+
+#: frame kinds. ACT/GRAD are the data plane (bounded queues, double-
+#: buffered); the rest are control (small, effectively unbounded).
+HELLO = 0
+ACT = 1
+GRAD = 2
+META = 3
+SYNC_FWD = 4
+SYNC_BWD = 5
+METRICS = 6
+DONE = 7
+
+_KIND_NAMES = {HELLO: "hello", ACT: "act", GRAD: "grad", META: "meta",
+               SYNC_FWD: "sync-fwd", SYNC_BWD: "sync-bwd",
+               METRICS: "metrics", DONE: "done"}
+
+#: header: magic, version, kind, sender stage, microbatch index,
+#: payload crc32, payload length.
+_HEADER = struct.Struct("!4sBBhiII")
+
+#: env contract exported by the PipelineSupervisor to every stage process.
+ENV_STAGE = "DLS_STAGE_ID"
+ENV_NUM_STAGES = "DLS_NUM_STAGES"
+ENV_PORTS = "DLS_PIPE_PORTS"
+ENV_AUTHKEY = "DLS_PIPE_AUTHKEY"
+ENV_SPEC = "DLS_PIPE_SPEC"
+
+
+class TransportError(RuntimeError):
+    """Base class for inter-stage transport failures."""
+
+
+class PeerDiedError(TransportError):
+    """The peer stage's socket tore (process death / connection reset).
+    Raised to every blocked and subsequent caller within a bounded wait."""
+
+
+class FrameError(TransportError):
+    """The byte stream desynced: bad magic, impossible length, CRC
+    mismatch, or a frame torn mid-payload. Unlike a clean peer death the
+    stream cannot be trusted past this point — the link is marked dead."""
+
+
+class TransportTimeout(TransportError):
+    """The peer is (as far as TCP knows) alive but nothing arrived/ drained
+    within the caller's timeout."""
+
+
+def pack_frame(kind: int, stage: int, mb: int, payload: bytes) -> bytes:
+    return _HEADER.pack(MAGIC, VERSION, kind, stage, mb,
+                        zlib.crc32(payload) & 0xFFFFFFFF, len(payload)) + payload
+
+
+def encode_payload(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=4)
+
+
+def decode_payload(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def _read_exact(sock: socket.socket, n: int, *, what: str) -> bytes:
+    """Read exactly ``n`` bytes. EOF at offset 0 returns b'' (clean close);
+    EOF mid-read raises FrameError (a torn frame)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        try:
+            k = sock.recv_into(view[got:], n - got)
+        except OSError as e:
+            raise PeerDiedError(f"socket error reading {what}: {e}") from e
+        if k == 0:
+            if got == 0:
+                return b""
+            raise FrameError(
+                f"torn frame: stream ended {got}/{n} bytes into {what}")
+        got += k
+    return bytes(buf)
+
+
+def read_frame(sock: socket.socket,
+               *, max_payload: int = 1 << 31) -> tuple[int, int, int, bytes] | None:
+    """One (kind, stage, mb, payload) frame, or None on clean EOF at a
+    frame boundary. Validates magic, version, length sanity, and payload
+    CRC — any mismatch is a :class:`FrameError`."""
+    head = _read_exact(sock, _HEADER.size, what="frame header")
+    if not head:
+        return None
+    magic, version, kind, stage, mb, crc, length = _HEADER.unpack(head)
+    if magic != MAGIC:
+        raise FrameError(f"bad frame magic {magic!r} (stream desync)")
+    if version != VERSION:
+        raise FrameError(f"frame version {version} != {VERSION}")
+    if length > max_payload:
+        raise FrameError(f"frame length {length} exceeds cap {max_payload}")
+    payload = _read_exact(sock, length, what=f"{_KIND_NAMES.get(kind, kind)} payload")
+    if length and not payload:
+        raise FrameError("torn frame: stream ended before payload")
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise FrameError(
+            f"payload checksum mismatch on {_KIND_NAMES.get(kind, kind)} "
+            f"frame (mb={mb}) — torn or corrupted in flight")
+    return kind, stage, mb, payload
+
+
+# -- authkey handshake (the serve/fleet idiom on a raw socket) ----------------
+
+
+def _challenge(sock: socket.socket, authkey: bytes, *, server: bool) -> None:
+    """Mutual HMAC-SHA256 challenge. Both sides prove possession of the
+    key; failure closes the socket with TransportError (an unauthenticated
+    peer must never reach the frame loop)."""
+    def send_nonce() -> bytes:
+        nonce = os.urandom(16)
+        sock.sendall(b"DLSPCHAL" + nonce)
+        return nonce
+
+    def answer() -> None:
+        tag = _read_exact(sock, 8, what="challenge tag")
+        if tag != b"DLSPCHAL":
+            raise TransportError(f"bad challenge tag {tag!r}")
+        nonce = _read_exact(sock, 16, what="challenge nonce")
+        if len(nonce) != 16:
+            raise TransportError("short challenge nonce")
+        sock.sendall(hmac.new(authkey, nonce, "sha256").digest())
+
+    def verify(nonce: bytes) -> None:
+        digest = _read_exact(sock, 32, what="challenge response")
+        want = hmac.new(authkey, nonce, "sha256").digest()
+        if not hmac.compare_digest(digest, want):
+            raise TransportError("authkey challenge failed")
+
+    if server:
+        nonce = send_nonce()
+        verify(nonce)
+        answer()
+    else:
+        answer()
+        nonce = send_nonce()
+        verify(nonce)
+
+
+class _BoundedChannel:
+    """Condition-guarded bounded deque shared by the worker threads and the
+    caller; death wakes every waiter with the link's typed error."""
+
+    def __init__(self, depth: int, cond: threading.Condition):
+        self.items: deque = deque()
+        self.depth = depth
+        self.cond = cond
+
+
+class StageLink:
+    """One authenticated, framed, double-buffered link to a peer stage.
+
+    ``send(kind, obj, mb)`` enqueues (bounded; blocks past ``depth`` in
+    flight = the backpressure bound) and a sender thread writes frames;
+    ``recv(kind)`` pops from that kind's bounded inbox filled by the
+    receiver thread. Control kinds (META/SYNC/METRICS/DONE) share an
+    unbounded-depth inbox — they are tiny and must never deadlock behind
+    a full data queue.
+    """
+
+    def __init__(self, sock: socket.socket, *, stage: int, peer_stage: int,
+                 depth: int = 2, hello: dict | None = None,
+                 hello_timeout: float = 60.0):
+        self.stage = stage
+        self.peer_stage = peer_stage
+        self.sock = sock
+        self.depth = max(1, int(depth))
+        self._cond = threading.Condition()
+        self._send_q: deque = deque()
+        self._inbox: dict[int, deque] = {ACT: deque(), GRAD: deque()}
+        self._ctrl: deque = deque()
+        self._err: TransportError | None = None
+        self._done_seen = False
+        self._closed = False
+        # HELLO crosses synchronously before the threads exist, so both
+        # ends learn (stage, committed step, attempt) — the resync wave's
+        # inputs — before any data frame can race it.
+        sock.settimeout(hello_timeout)
+        sock.sendall(pack_frame(HELLO, stage, -1,
+                                encode_payload(dict(hello or {}, stage=stage))))
+        first = read_frame(sock)
+        if first is None:
+            raise PeerDiedError(f"peer stage {peer_stage} closed before hello")
+        kind, pstage, _, payload = first
+        if kind != HELLO:
+            raise FrameError(f"expected hello, got {_KIND_NAMES.get(kind, kind)}")
+        self.peer_hello: dict = decode_payload(payload)
+        if int(self.peer_hello.get("stage", pstage)) != peer_stage:
+            raise TransportError(
+                f"connected to stage {self.peer_hello.get('stage')}, "
+                f"expected {peer_stage} (port map mismatch)")
+        sock.settimeout(None)
+        self._sender = threading.Thread(
+            target=self._send_loop, name=f"mpmd-s{stage}-send", daemon=True)
+        self._receiver = threading.Thread(
+            target=self._recv_loop, name=f"mpmd-s{stage}-recv", daemon=True)
+        self._sender.start()
+        self._receiver.start()
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def dead(self) -> bool:
+        return self._err is not None
+
+    def _die(self, err: TransportError) -> None:
+        with self._cond:
+            if self._err is None:
+                self._err = err
+            self._cond.notify_all()
+
+    def _raise_dead(self) -> None:
+        assert self._err is not None
+        raise type(self._err)(*self._err.args)
+
+    # -- worker threads ------------------------------------------------------
+
+    def _send_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._send_q and self._err is None and not self._closed:
+                    self._cond.wait()
+                if self._err is not None or (self._closed and not self._send_q):
+                    return
+                frame = self._send_q.popleft()
+                self._cond.notify_all()
+            try:
+                self.sock.sendall(frame)
+            except OSError as e:
+                self._die(PeerDiedError(
+                    f"peer stage {self.peer_stage} died mid-send: {e}"))
+                return
+
+    def _recv_loop(self) -> None:
+        while True:
+            try:
+                frame = read_frame(self.sock)
+            except TransportError as e:
+                if self._done_seen and isinstance(e, PeerDiedError):
+                    return  # socket torn after DONE: expected teardown
+                self._die(e if isinstance(e, (PeerDiedError, FrameError))
+                          else PeerDiedError(str(e)))
+                return
+            if frame is None:
+                if self._done_seen or self._closed:
+                    return
+                self._die(PeerDiedError(
+                    f"peer stage {self.peer_stage} closed the link"))
+                return
+            kind, _, mb, payload = frame
+            try:
+                obj = decode_payload(payload)
+            except Exception as e:  # noqa: BLE001 — checksum passed but the
+                # pickle is bad: protocol violation, not recoverable
+                self._die(FrameError(f"undecodable {_KIND_NAMES.get(kind, kind)} "
+                                     f"payload: {e}"))
+                return
+            with self._cond:
+                if kind == DONE:
+                    self._done_seen = True
+                    self._ctrl.append((kind, mb, obj))
+                elif kind in self._inbox:
+                    q = self._inbox[kind]
+                    # bounded inbox: stop draining the socket when the
+                    # consumer lags `depth` frames — TCP backpressure then
+                    # stalls the sender (bounded memory at both ends)
+                    while len(q) >= self.depth and self._err is None \
+                            and not self._closed:
+                        self._cond.wait()
+                    if self._err is not None or self._closed:
+                        return
+                    q.append((kind, mb, obj))
+                else:
+                    self._ctrl.append((kind, mb, obj))
+                self._cond.notify_all()
+
+    # -- caller API ----------------------------------------------------------
+
+    def send(self, kind: int, obj: Any, *, mb: int = -1,
+             timeout: float | None = None) -> None:
+        """Enqueue one frame (async). Blocks while ``depth`` frames are
+        already queued — the bounded-buffering contract; ``timeout``
+        bounds that wait with :class:`TransportTimeout`."""
+        frame = pack_frame(kind, self.stage, mb, encode_payload(obj))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while len(self._send_q) >= self.depth:
+                if self._err is not None:
+                    self._raise_dead()
+                if self._closed:
+                    raise TransportError("link closed")
+                wait = None if deadline is None else deadline - time.monotonic()
+                if wait is not None and wait <= 0:
+                    raise TransportTimeout(
+                        f"send queue to stage {self.peer_stage} full "
+                        f"({self.depth} frames) for {timeout:.1f}s — peer "
+                        f"not draining")
+                self._cond.wait(wait)
+            if self._err is not None:
+                self._raise_dead()
+            if self._closed:
+                raise TransportError("link closed")
+            self._send_q.append(frame)
+            self._cond.notify_all()
+
+    def recv(self, kind: int, *, timeout: float | None = 120.0
+             ) -> tuple[int, Any]:
+        """Next ``(mb, payload)`` of ``kind``. Buffered frames are delivered
+        even after the peer died (they arrived intact); then the typed
+        error surfaces."""
+        q = self._inbox.get(kind, self._ctrl)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                item = self._pop(q, kind)
+                if item is not None:
+                    self._cond.notify_all()  # wake the receiver (space freed)
+                    return item[1], item[2]
+                if self._err is not None:
+                    self._raise_dead()
+                if self._closed:
+                    raise TransportError("link closed")
+                wait = None if deadline is None else deadline - time.monotonic()
+                if wait is not None and wait <= 0:
+                    raise TransportTimeout(
+                        f"no {_KIND_NAMES.get(kind, kind)} frame from stage "
+                        f"{self.peer_stage} within {timeout:.1f}s")
+                self._cond.wait(wait)
+
+    def try_recv(self, kind: int) -> tuple[int, Any] | None:
+        """Non-blocking :meth:`recv`: ``(mb, payload)`` or None. Raises the
+        link's typed error only when dead AND nothing is buffered."""
+        q = self._inbox.get(kind, self._ctrl)
+        with self._cond:
+            item = self._pop(q, kind)
+            if item is not None:
+                self._cond.notify_all()
+                return item[1], item[2]
+            if self._err is not None:
+                self._raise_dead()
+            return None
+
+    def _pop(self, q: deque, kind: int):
+        if q is self._ctrl:
+            for i, item in enumerate(q):
+                if item[0] == kind:
+                    del q[i]
+                    return item
+            return None
+        return q.popleft() if q else None
+
+    def close(self, *, send_done: bool = True) -> None:
+        try:
+            if send_done and self._err is None:
+                self.send(DONE, {}, timeout=5.0)
+        except TransportError:
+            pass
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        # let queued frames (incl. DONE) drain before tearing the socket
+        self._sender.join(timeout=5.0)
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# -- chain topology -----------------------------------------------------------
+
+
+class PipelineTransport:
+    """Stage *k*'s two links: ``up`` (to stage k−1) and ``down`` (to k+1).
+
+    Owns the persistent listener on ``ports[stage]`` (SO_REUSEADDR — a
+    restarted stage re-binds the same port) so a dead neighbor can
+    reconnect without coordination: on :class:`PeerDiedError` the runner
+    calls :meth:`connect` again, which re-accepts/re-dials only the broken
+    side, then :meth:`sync_step` agrees on the resume step.
+    """
+
+    def __init__(self, stage: int, num_stages: int, ports: list[int],
+                 authkey: bytes, *, depth: int = 2,
+                 connect_timeout: float = 120.0):
+        if num_stages < 2:
+            raise ValueError(f"a pipeline needs >= 2 stages, got {num_stages}")
+        if len(ports) < num_stages - 1:
+            raise ValueError(
+                f"need {num_stages - 1} ports for {num_stages} stages, "
+                f"got {len(ports)}")
+        self.stage = stage
+        self.num_stages = num_stages
+        self.ports = list(ports)
+        self.authkey = authkey
+        self.depth = depth
+        self.connect_timeout = connect_timeout
+        self.up: StageLink | None = None
+        self.down: StageLink | None = None
+        self._listener: socket.socket | None = None
+        if stage < num_stages - 1:
+            self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind(("127.0.0.1", ports[stage]))
+            self._listener.listen(4)
+
+    @classmethod
+    def from_env(cls, **kw) -> "PipelineTransport":
+        return cls(
+            int(os.environ[ENV_STAGE]),
+            int(os.environ[ENV_NUM_STAGES]),
+            json.loads(os.environ[ENV_PORTS]),
+            bytes.fromhex(os.environ[ENV_AUTHKEY]),
+            **kw,
+        )
+
+    def connect(self, *, hello: dict | None = None,
+                timeout: float | None = None) -> None:
+        """(Re)establish whichever links are missing or dead.
+
+        Down (accept) before up (dial): the chain then resolves tail-first
+        — the last stage dials immediately, each accept unblocks the next
+        dial — and the same order is deadlock-free for any single-stage
+        restart (the survivors' broken sides are complementary)."""
+        deadline = time.monotonic() + (timeout or self.connect_timeout)
+        if self._listener is not None and (self.down is None or self.down.dead):
+            self.down = self._accept(deadline, hello)
+        if self.stage > 0 and (self.up is None or self.up.dead):
+            self.up = self._dial(deadline, hello)
+
+    def _accept(self, deadline: float, hello: dict | None) -> StageLink:
+        assert self._listener is not None
+        while True:
+            self._listener.settimeout(max(0.1, deadline - time.monotonic()))
+            try:
+                sock, _ = self._listener.accept()
+            except socket.timeout:
+                raise TransportTimeout(
+                    f"stage {self.stage}: stage {self.stage + 1} never "
+                    f"connected (waited {self.connect_timeout:.0f}s)")
+            try:
+                _challenge(sock, self.authkey, server=True)
+                return StageLink(sock, stage=self.stage,
+                                 peer_stage=self.stage + 1, depth=self.depth,
+                                 hello=hello)
+            except TransportError as e:
+                logger.warning("stage %d: rejected downstream connection: %s",
+                               self.stage, e)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if time.monotonic() > deadline:
+                    raise
+
+    def _dial(self, deadline: float, hello: dict | None) -> StageLink:
+        port = self.ports[self.stage - 1]
+        while True:
+            try:
+                sock = socket.create_connection(("127.0.0.1", port),
+                                                timeout=5.0)
+                _challenge(sock, self.authkey, server=False)
+                return StageLink(sock, stage=self.stage,
+                                 peer_stage=self.stage - 1, depth=self.depth,
+                                 hello=hello)
+            except (OSError, TransportError) as e:
+                if time.monotonic() > deadline:
+                    raise TransportTimeout(
+                        f"stage {self.stage}: could not reach stage "
+                        f"{self.stage - 1} on port {port} within "
+                        f"{self.connect_timeout:.0f}s: {e}")
+                time.sleep(0.2)
+
+    def reset(self) -> None:
+        """Drop both links (keeping the listener) ahead of a reconnect —
+        a resync must never read a stale pre-failure frame."""
+        for link in (self.up, self.down):
+            if link is not None:
+                link.close(send_done=False)
+        self.up = self.down = None
+
+    def sync_step(self, my_step: int, *, timeout: float = 120.0) -> int:
+        """Chain consensus on the resume step: forward min-wave, backward
+        broadcast. Every stage returns the same global minimum of the
+        committed checkpoint steps — the step all stages can restore."""
+        cur = int(my_step)
+        if self.up is not None:
+            _, payload = self.up.recv(SYNC_FWD, timeout=timeout)
+            cur = min(cur, int(payload["step"]))
+        if self.down is not None:
+            self.down.send(SYNC_FWD, {"step": cur})
+            _, payload = self.down.recv(SYNC_BWD, timeout=timeout)
+            cur = int(payload["step"])
+        if self.up is not None:
+            self.up.send(SYNC_BWD, {"step": cur})
+        return cur
+
+    def close(self) -> None:
+        for link in (self.up, self.down):
+            if link is not None:
+                link.close()
+        self.up = self.down = None
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None

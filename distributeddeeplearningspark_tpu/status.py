@@ -273,6 +273,8 @@ def report(workdir: str, *, now: float | None = None,
         **({"fleet_serve": fleet_lib.serving_fleet(events)}
            if fleet_serve else {}),
         **({"traces": fleet_lib.latency_anatomy(events)} if traces else {}),
+        **({"pipeline": fleet_lib.pipeline_anatomy(events)}
+           if traces else {}),
         **({"slo": fleet_lib.slo_report(events, target_p99_s=slo_target,
                                         budget=slo_budget)}
            if slo_target is not None else {}),
@@ -512,6 +514,40 @@ def render_anatomy(an: dict) -> list[str]:
     return lines
 
 
+def render_pipeline(pl: dict) -> list[str]:
+    """The ``--traces`` pipeline block: per-stage span anatomy + measured
+    bubble fraction vs the (P−1)/(M+P−1) theoretical bound."""
+    lines: list[str] = []
+    meas, theo = pl["measured_bubble_frac"], pl["theoretical_bubble_frac"]
+    verdict = ""
+    if meas is not None and theo is not None:
+        verdict = (" — within bound" if meas <= theo + 0.10
+                   else " — ABOVE bound+10%: transport or stage imbalance "
+                        "is eating the overlap")
+    lines.append(
+        f"pipeline: {pl['p'] or '?'} stage(s) x {pl['m'] or '?'} "
+        f"microbatch(es) [{pl.get('schedule') or '?'}], "
+        f"{pl['steps_judged']}/{pl['steps']} step(s) judged"
+        + (f", {pl['microbatch_traces']} cross-stage microbatch trace(s)"
+           if pl.get("microbatch_traces") else ""))
+    if meas is not None:
+        lines.append(
+            f"  bubble fraction: measured {meas:.3f} vs theoretical "
+            f"(P-1)/(M+P-1) = {theo if theo is not None else float('nan'):.3f}"
+            f"{verdict}")
+    lines.append(
+        f"  {'stage':>5}  {'steps':>5}  {'fwd':>8}  {'bwd':>8}  "
+        f"{'loss+opt':>8}  {'recv-wait':>9}  {'send-wait':>9}  {'bubble':>6}")
+    for stage, r in pl["stages"].items():
+        bub = f"{r['bubble_frac']:.3f}" if r["bubble_frac"] is not None else "-"
+        lines.append(
+            f"  {stage:>5}  {r['steps']:>5}  {_fmt_s(r['fwd_s']):>8}  "
+            f"{_fmt_s(r['bwd_s']):>8}  {_fmt_s(r['loss_s']):>8}  "
+            f"{_fmt_s(r['recv_wait_s']):>9}  {_fmt_s(r['send_wait_s']):>9}  "
+            f"{bub:>6}")
+    return lines
+
+
 def render_slo(s: dict) -> list[str]:
     """The ``--slo`` section: per-tenant burn rate and verdict."""
     lines: list[str] = []
@@ -553,6 +589,9 @@ def render(rep: dict) -> str:
     if rep.get("traces"):
         lines.append("")
         lines.extend(render_traces(rep["traces"]))
+    if rep.get("pipeline"):
+        lines.append("")
+        lines.extend(render_pipeline(rep["pipeline"]))
     if rep.get("slo"):
         lines.append("")
         lines.extend(render_slo(rep["slo"]))

@@ -93,7 +93,12 @@ present):
   a partial trace the reader flags ``incomplete``, never throws on.
   ``dlstatus --traces`` folds them into the latency anatomy, ``dlstatus
   --export-trace`` exports them (plus train ``phase`` spans lowered into
-  the same model) as Chrome ``trace_event`` JSON.
+  the same model) as Chrome ``trace_event`` JSON. MPMD pipeline stages
+  (:mod:`..train.pipeline_trainer`) emit the same kind: per-step
+  ``pipe-step``/``pipe-fwd``/``pipe-bwd``/``pipe-*-wait`` spans (attrs
+  ``stage``/``step``/``mb``) plus one cross-process trace per microbatch
+  whose context rides the transport frames — folded by
+  :func:`.fleet.pipeline_anatomy` into the measured bubble fraction.
 
 Worker-side events additionally carry ``host`` (the process index from the
 ``DLS_*`` env contract via :func:`~..utils.env.process_identity`, plus

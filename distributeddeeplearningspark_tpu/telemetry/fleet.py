@@ -706,3 +706,118 @@ def fleet_report(events: Iterable[dict], *, now: float | None = None
         "straggler": straggler_verdict(skew),
         "hang": localize_hang(events, now=now, rows=rows, skew=skew),
     }
+
+
+# -- MPMD pipeline anatomy (bubble accounting) --------------------------------
+
+#: span names of the pipeline trainer (train/pipeline_trainer.py): busy =
+#: the stage was computing; wait = it sat on the transport. A step's
+#: bubble is 1 − busy/wall per stage — what the (P−1)/(M+P−1) bound caps.
+PIPE_BUSY_SPANS = ("pipe-fwd", "pipe-bwd", "pipe-loss", "pipe-embed",
+                   "pipe-embed-bwd", "pipe-opt")
+PIPE_WAIT_SPANS = ("pipe-recv-wait", "pipe-send-wait")
+PIPE_STEP_SPAN = "pipe-step"
+
+
+def pipeline_anatomy(events: Iterable[dict]) -> dict[str, Any] | None:
+    """Fold pipeline spans into per-stage busy/wait anatomy and the
+    measured bubble fraction vs. the theoretical (P−1)/(M+P−1) bound —
+    the ``dlstatus --traces`` pipeline block.
+
+    Per (stage, step): ``wall`` = that stage's ``pipe-step`` span,
+    ``busy`` = Σ of its compute spans, bubble = 1 − busy/wall. The run's
+    ``measured_bubble_frac`` averages over stages and steps, EXCLUDING
+    warmup: the first observed step (jit compiles inside the first
+    fwd/bwd/loss spans) and any step whose wall exceeds 5× the median
+    (a mid-run recompile after a stage restart looks exactly like that).
+    None when the stream has no pipeline spans."""
+    from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
+
+    spans = [s for s in trace_lib.spans_of(events)
+             if str(s.get("name", "")).startswith("pipe-")
+             or s.get("name") == "microbatch"]
+    steps = [s for s in spans if s.get("name") == PIPE_STEP_SPAN
+             and s.get("t1") is not None]
+    if not steps:
+        return None
+
+    def attr(s, key, default=None):
+        return (s.get("attrs") or {}).get(key, default)
+
+    m = max((int(attr(s, "m", 0) or 0) for s in steps), default=0)
+    p = max((int(attr(s, "p", 0) or 0) for s in steps), default=0)
+    schedule = next((attr(s, "schedule") for s in steps
+                     if attr(s, "schedule")), None)
+    # (stage, step) -> {wall, busy, wait, fwd, bwd, ...}
+    cells: dict[tuple[int, int], dict[str, float]] = {}
+    for s in steps:
+        stage, step = int(attr(s, "stage", -1)), int(attr(s, "step", -1))
+        wall = max(0.0, float(s["t1"]) - float(s["t0"]))
+        cell = cells.setdefault((stage, step), {"busy": 0.0, "wait": 0.0})
+        cell["wall"] = cell.get("wall", 0.0) + wall
+    for s in spans:
+        name = s.get("name")
+        if s.get("t1") is None or name == PIPE_STEP_SPAN:
+            continue
+        stage, step = int(attr(s, "stage", -1)), int(attr(s, "step", -1))
+        cell = cells.get((stage, step))
+        if cell is None:
+            continue
+        dur = max(0.0, float(s["t1"]) - float(s["t0"]))
+        if name in PIPE_BUSY_SPANS:
+            cell["busy"] += dur
+            cell[name] = cell.get(name, 0.0) + dur
+        elif name in PIPE_WAIT_SPANS:
+            cell["wait"] += dur
+            cell[name] = cell.get(name, 0.0) + dur
+    all_steps = sorted({step for _, step in cells})
+    warmup = {all_steps[0]} if all_steps else set()
+    walls = sorted(c["wall"] for (st, sp), c in cells.items()
+                   if sp not in warmup and c.get("wall"))
+    wall_cap = 5.0 * _median(walls) if walls else float("inf")
+    judged = {k: c for k, c in cells.items()
+              if k[1] not in warmup and 0.0 < c.get("wall", 0.0) <= wall_cap}
+    skipped = len(cells) - len(judged)
+    bubbles = [max(0.0, min(1.0, 1.0 - c["busy"] / c["wall"]))
+               for c in judged.values()]
+    measured = (sum(bubbles) / len(bubbles)) if bubbles else None
+    theoretical = ((p - 1) / float(m + p - 1)) if m and p else None
+    stages: dict[str, dict] = {}
+    for stage in sorted({st for st, _ in cells}):
+        mine = [c for (st, _), c in judged.items() if st == stage]
+        if not mine:
+            mine = [c for (st, _), c in cells.items() if st == stage]
+        tot = {k: round(sum(c.get(k, 0.0) for c in mine), 6)
+               for k in ("wall", "busy", "wait", "pipe-fwd", "pipe-bwd",
+                         "pipe-loss", "pipe-embed", "pipe-embed-bwd",
+                         "pipe-opt", "pipe-recv-wait", "pipe-send-wait")}
+        stages[str(stage)] = {
+            "steps": len(mine),
+            "wall_s": tot["wall"],
+            "busy_s": tot["busy"],
+            "wait_s": tot["wait"],
+            "fwd_s": tot["pipe-fwd"],
+            "bwd_s": tot["pipe-bwd"],
+            "loss_s": tot["pipe-loss"] + tot["pipe-embed"]
+            + tot["pipe-embed-bwd"] + tot["pipe-opt"],
+            "recv_wait_s": tot["pipe-recv-wait"],
+            "send_wait_s": tot["pipe-send-wait"],
+            "bubble_frac": (round(1.0 - tot["busy"] / tot["wall"], 4)
+                            if tot["wall"] > 0 else None),
+        }
+    mbs = [s for s in spans if s.get("name") == "microbatch"
+           and s.get("t1") is not None]
+    return {
+        "m": m or None,
+        "p": p or None,
+        "schedule": schedule,
+        "steps": len(all_steps),
+        "steps_judged": len({k[1] for k in judged}),
+        "cells_skipped_warmup_or_outlier": skipped,
+        "microbatch_traces": len(mbs),
+        "measured_bubble_frac": (round(measured, 4)
+                                 if measured is not None else None),
+        "theoretical_bubble_frac": (round(theoretical, 4)
+                                    if theoretical is not None else None),
+        "stages": stages,
+    }

@@ -120,6 +120,12 @@ _SLOW_PATTERNS = (
     "test_memory.py::test_param_count_matches_model_exactly",
     "test_llama.py::test_parity_with_transformers",
     "test_checkpoint.py::test_retention",
+    # MPMD pipelines: whole-model jits on threads, plus a real
+    # process-level stage-kill drill
+    "test_mpmd.py::test_mpmd_bitwise_parity_vs_single_program_llama_pp",
+    "test_mpmd.py::test_mpmd_heterogeneous_stage_meshes",
+    "test_mpmd.py::test_mpmd_stage_geometry_change_on_restore",
+    "test_mpmd.py::test_pipeline_supervisor_stage_kill_drill",
 )
 
 

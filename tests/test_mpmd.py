@@ -1,0 +1,751 @@
+"""MPMD multi-gang pipeline: transport framing, scheduling, parity, drills.
+
+Three layers of coverage:
+
+- **Transport** (jax-free, fast tier): frame pack/read round-trip, torn and
+  corrupted frames as typed :class:`FrameError`, peer death as a typed
+  :class:`PeerDiedError` within a bounded wait, the bounded-backpressure
+  contract, authkey rejection, and the chain resume-step consensus wave.
+- **Folds** (jax-free, fast tier): the bubble-fraction accounting behind
+  ``dlstatus --traces``'s pipeline block, on hand-built span streams.
+- **Pipelines** (slow tier — whole-model jits): 2-stage bitwise parity with
+  the single-program ``llama_pp`` baseline, heterogeneous per-stage meshes
+  (fsdp stage + tensor stage), per-stage geometry-changing restore, and the
+  process-level stage-kill drill (only the dead stage restarts; the loss
+  trajectory matches an unfaulted run bitwise).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.parallel import mpmd
+from distributeddeeplearningspark_tpu.telemetry import fleet as fleet_lib
+from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
+
+
+# -- framing ------------------------------------------------------------------
+
+
+def test_frame_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    payload = mpmd.encode_payload(
+        {"act": np.arange(12, dtype=np.float32).reshape(3, 4), "step": 7})
+    a.sendall(mpmd.pack_frame(mpmd.ACT, 1, 3, payload))
+    kind, stage, mb, raw = mpmd.read_frame(b)
+    assert (kind, stage, mb) == (mpmd.ACT, 1, 3)
+    obj = mpmd.decode_payload(raw)
+    assert obj["step"] == 7
+    np.testing.assert_array_equal(obj["act"],
+                                  np.arange(12, dtype=np.float32).reshape(3, 4))
+    a.close()
+    assert mpmd.read_frame(b) is None  # clean EOF at a frame boundary
+    b.close()
+
+
+def test_torn_frame_is_typed():
+    a, b = socket.socketpair()
+    frame = mpmd.pack_frame(mpmd.GRAD, 0, 1, mpmd.encode_payload({"x": 1}))
+    a.sendall(frame[: len(frame) - 3])  # die mid-payload
+    a.close()
+    with pytest.raises(mpmd.FrameError, match="torn"):
+        mpmd.read_frame(b)
+    b.close()
+
+
+def test_bad_magic_is_typed():
+    a, b = socket.socketpair()
+    a.sendall(b"GARBAGEGARBAGEGARBAGEGARBAGE")
+    with pytest.raises(mpmd.FrameError, match="magic"):
+        mpmd.read_frame(b)
+    a.close()
+    b.close()
+
+
+def test_corrupted_payload_checksum_is_typed():
+    a, b = socket.socketpair()
+    frame = bytearray(mpmd.pack_frame(mpmd.ACT, 0, 0,
+                                      mpmd.encode_payload({"x": 123})))
+    frame[-1] ^= 0xFF  # flip one payload byte; header CRC now disagrees
+    a.sendall(bytes(frame))
+    with pytest.raises(mpmd.FrameError, match="checksum"):
+        mpmd.read_frame(b)
+    a.close()
+    b.close()
+
+
+# -- StageLink ----------------------------------------------------------------
+
+
+def _link_pair(depth=2):
+    a, b = socket.socketpair()
+    out = {}
+
+    def make(sock, stage, peer):
+        out[stage] = mpmd.StageLink(sock, stage=stage, peer_stage=peer,
+                                    depth=depth, hello={"step": stage * 10})
+
+    t0 = threading.Thread(target=make, args=(a, 0, 1))
+    t1 = threading.Thread(target=make, args=(b, 1, 0))
+    t0.start(); t1.start(); t0.join(5); t1.join(5)
+    return out[0], out[1]
+
+
+def test_link_hello_and_data_roundtrip():
+    l0, l1 = _link_pair()
+    assert l0.peer_hello["step"] == 10 and l1.peer_hello["step"] == 0
+    l0.send(mpmd.ACT, {"v": np.ones(4)}, mb=2)
+    mb, obj = l1.recv(mpmd.ACT, timeout=5.0)
+    assert mb == 2 and obj["v"].shape == (4,)
+    l1.send(mpmd.GRAD, {"g": 1}, mb=2)
+    assert l0.recv(mpmd.GRAD, timeout=5.0) == (2, {"g": 1})
+    l0.close(); l1.close()
+
+
+def test_peer_death_typed_within_bounded_wait():
+    l0, l1 = _link_pair()
+    # receiver blocked, peer process "dies" (socket torn without DONE)
+    got: dict = {}
+
+    def wait():
+        t0 = time.monotonic()
+        try:
+            l0.recv(mpmd.GRAD, timeout=30.0)
+        except mpmd.TransportError as e:
+            got["err"] = e
+            got["waited"] = time.monotonic() - t0
+
+    th = threading.Thread(target=wait)
+    th.start()
+    time.sleep(0.1)
+    # SIGKILL shape: the kernel tears the socket (shutdown, not a python
+    # close — CPython defers close while a thread is blocked reading)
+    l1.sock.shutdown(socket.SHUT_RDWR)
+    th.join(10.0)
+    assert isinstance(got.get("err"), mpmd.PeerDiedError)
+    assert got["waited"] < 5.0  # bounded: death is detected, not timed out
+    with pytest.raises(mpmd.PeerDiedError):
+        l0.send(mpmd.ACT, {}, mb=0)  # subsequent calls fail typed too
+    l0.close(send_done=False)
+
+
+def test_buffered_frames_survive_peer_death():
+    l0, l1 = _link_pair()
+    l1.send(mpmd.GRAD, {"g": 7}, mb=0)
+    time.sleep(0.3)  # let it land in l0's inbox
+    l1.sock.shutdown(socket.SHUT_RDWR)
+    assert l0.recv(mpmd.GRAD, timeout=5.0) == (0, {"g": 7})  # intact frame
+    with pytest.raises(mpmd.PeerDiedError):
+        l0.recv(mpmd.GRAD, timeout=5.0)  # then the death surfaces
+    l0.close(send_done=False)
+
+
+def test_send_backpressure_is_bounded():
+    l0, l1 = _link_pair(depth=1)
+    # the peer never drains: depth-1 send queue + depth-1 remote inbox +
+    # the TCP buffers absorb a few frames, then send must BLOCK (and time
+    # out typed), never buffer unboundedly
+    big = {"x": np.zeros(1 << 20, np.uint8)}  # 1 MiB >> socket buffers
+    with pytest.raises(mpmd.TransportTimeout):
+        for _ in range(8):
+            l0.send(mpmd.ACT, big, mb=0, timeout=0.3)
+    assert len(l0._send_q) <= 1  # the bound held
+    l0.close(send_done=False); l1.close(send_done=False)
+
+
+def test_done_makes_teardown_clean():
+    l0, l1 = _link_pair()
+    l0.close(send_done=True)   # sends DONE then tears the socket
+    time.sleep(0.3)
+    assert not l1.dead          # EOF after DONE is an expected teardown
+    l1.close(send_done=False)
+
+
+# -- chain topology + resync --------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_transport_chain_sync_step_consensus():
+    ports = [_free_port(), _free_port()]
+    key = os.urandom(16)
+    steps = {0: 12, 1: 8, 2: 12}
+    agreed: dict = {}
+    errs: dict = {}
+
+    def run(stage):
+        try:
+            tr = mpmd.PipelineTransport(stage, 3, ports, key,
+                                        connect_timeout=20)
+            tr.connect(hello={"step": steps[stage]})
+            agreed[stage] = tr.sync_step(steps[stage], timeout=20)
+            tr.close()
+        except Exception as e:  # noqa: BLE001 — surfaced via assert below
+            errs[stage] = e
+
+    ths = [threading.Thread(target=run, args=(s,)) for s in range(3)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(30)
+    assert not errs, errs
+    assert agreed == {0: 8, 1: 8, 2: 8}  # min over committed steps
+
+
+def test_transport_rejects_wrong_authkey():
+    ports = [_free_port()]
+    server = mpmd.PipelineTransport(0, 2, ports, b"right-key",
+                                    connect_timeout=5)
+    result: dict = {}
+
+    def accept():
+        try:
+            server.connect()
+            result["ok"] = True
+        except mpmd.TransportError as e:
+            result["err"] = e
+
+    th = threading.Thread(target=accept)
+    th.start()
+    with pytest.raises(mpmd.TransportError):
+        bad = mpmd.PipelineTransport(1, 2, ports, b"wrong-key",
+                                     connect_timeout=3)
+        bad.connect()
+    th.join(10)
+    server.close()
+    assert "ok" not in result  # the unauthenticated dial never linked
+
+
+# -- bubble accounting fold ---------------------------------------------------
+
+
+def _pipe_span(name, t0, t1, *, stage, step, trace="t0", extra=None):
+    rec = trace_lib.span(trace, trace_lib.new_span_id(), name, t0, t1,
+                         stage=stage, step=step, **(extra or {}))
+    return {"ts": t0, "kind": "span", "process": f"p{stage}", **rec}
+
+
+def _step_cell(stage, step, t0, *, busy, wall, m=4, p=2):
+    """One stage-step: a pipe-step span of ``wall`` with a pipe-fwd span
+    of ``busy`` inside it."""
+    return [
+        _pipe_span("pipe-step", t0, t0 + wall, stage=stage, step=step,
+                   extra={"m": m, "p": p, "schedule": "gpipe"}),
+        _pipe_span("pipe-fwd", t0, t0 + busy, stage=stage, step=step,
+                   extra={"mb": 0}),
+    ]
+
+
+def test_pipeline_anatomy_hand_computed_bubble():
+    events = []
+    # step 0 = warmup (huge wall, would dominate): must be skipped
+    events += _step_cell(0, 0, 0.0, busy=1.0, wall=30.0)
+    events += _step_cell(1, 0, 0.0, busy=1.0, wall=30.0)
+    # steps 1..2: stage 0 busy 0.8/1.0 (bubble .2), stage 1 busy 0.6/1.0
+    for s in (1, 2):
+        events += _step_cell(0, s, 100.0 + s, busy=0.8, wall=1.0)
+        events += _step_cell(1, s, 100.0 + s, busy=0.6, wall=1.0)
+    rep = fleet_lib.pipeline_anatomy(events)
+    assert rep is not None
+    assert rep["m"] == 4 and rep["p"] == 2 and rep["schedule"] == "gpipe"
+    assert rep["theoretical_bubble_frac"] == pytest.approx(1 / 5)
+    # mean of (0.2, 0.4) over both stages and both judged steps
+    assert rep["measured_bubble_frac"] == pytest.approx(0.3, abs=1e-6)
+    assert rep["steps_judged"] == 2
+    assert rep["cells_skipped_warmup_or_outlier"] == 2
+    assert rep["stages"]["0"]["bubble_frac"] == pytest.approx(0.2, abs=1e-4)
+    assert rep["stages"]["1"]["bubble_frac"] == pytest.approx(0.4, abs=1e-4)
+
+
+def test_pipeline_anatomy_skips_midrun_recompile_outlier():
+    events = []
+    events += _step_cell(0, 0, 0.0, busy=0.5, wall=10.0)      # warmup
+    for s in range(1, 6):
+        events += _step_cell(0, s, 100.0 + s, busy=0.9, wall=1.0)
+    # a restarted stage's first step back recompiles: 20x the median wall
+    events += _step_cell(0, 6, 200.0, busy=1.0, wall=20.0)
+    rep = fleet_lib.pipeline_anatomy(events)
+    assert rep["measured_bubble_frac"] == pytest.approx(0.1, abs=1e-6)
+    assert rep["cells_skipped_warmup_or_outlier"] == 2  # warmup + outlier
+
+
+def test_pipeline_anatomy_none_without_pipe_spans():
+    events = [{"ts": 1.0, "kind": "step_metrics", "process": "p0",
+               "step": 1, "steps": 1, "lap_s": 0.1}]
+    assert fleet_lib.pipeline_anatomy(events) is None
+
+
+def test_dlstatus_pipeline_block_rendered_and_json(tmp_path):
+    from distributeddeeplearningspark_tpu import status
+
+    wd = tmp_path / "run"
+    w = telemetry.EventWriter(wd, process="p0", host=0)
+    recs = []
+    for ev in (_step_cell(0, 0, 0.0, busy=1.0, wall=5.0)
+               + _step_cell(0, 1, 10.0, busy=0.75, wall=1.0)
+               + _step_cell(0, 2, 11.0, busy=0.85, wall=1.0)):
+        recs.append({k: v for k, v in ev.items()
+                     if k not in ("ts", "kind", "process")})
+    w.emit_many("span", recs)
+    w.step_metrics(2, steps=1, lap_s=1.0, metrics={"loss": 3.0})
+    w.close()
+    rep = status.report(str(wd), traces=True)
+    pl = rep["pipeline"]
+    for key in ("m", "p", "schedule", "steps", "steps_judged",
+                "measured_bubble_frac", "theoretical_bubble_frac", "stages"):
+        assert key in pl, key
+    assert pl["measured_bubble_frac"] == pytest.approx(0.2, abs=1e-4)
+    text = status.render(rep)
+    assert "pipeline: 2 stage(s) x 4 microbatch(es)" in text
+    assert "bubble fraction: measured 0.200" in text
+    assert "(P-1)/(M+P-1) = 0.200" in text
+    # strict-JSON round trip (the --json contract)
+    json.loads(json.dumps(status._json_safe(rep), default=str))
+
+
+def test_theoretical_bubble():
+    from distributeddeeplearningspark_tpu.train.pipeline_trainer import (
+        theoretical_bubble,
+    )
+
+    assert theoretical_bubble(4, 2) == pytest.approx(1 / 5)
+    assert theoretical_bubble(8, 4) == pytest.approx(3 / 11)
+
+
+# -- supervisor env contract --------------------------------------------------
+
+
+def test_pipeline_supervisor_stage_env_contract(tmp_path):
+    from distributeddeeplearningspark_tpu.supervisor import (
+        PipelineSupervisor,
+        StagePlan,
+    )
+
+    sup = PipelineSupervisor(
+        [StagePlan(env={"XLA_FLAGS": "a"}), StagePlan(env={"XLA_FLAGS": "b"})],
+        env={mpmd.ENV_SPEC: json.dumps({"steps": 1})},
+        telemetry_dir=str(tmp_path))
+    env0 = sup._stage_env(0)
+    env1 = sup._stage_env(1)
+    assert env0[mpmd.ENV_STAGE] == "0" and env1[mpmd.ENV_STAGE] == "1"
+    assert env0[mpmd.ENV_NUM_STAGES] == "2"
+    ports = json.loads(env0[mpmd.ENV_PORTS])
+    assert len(ports) == 1 and ports == json.loads(env1[mpmd.ENV_PORTS])
+    assert env0[mpmd.ENV_AUTHKEY] == env1[mpmd.ENV_AUTHKEY]
+    # stage-targetable identity: DLS_FAULT=die_host@N + DLS_FAULT_HOST=k
+    # kills exactly stage k's gang
+    assert env0["DLS_HOST_ID"] == "0" and env1["DLS_HOST_ID"] == "1"
+    assert env0["DLS_PROCESS_ID"] == "0" and env1["DLS_PROCESS_ID"] == "1"
+    assert env0["XLA_FLAGS"] == "a" and env1["XLA_FLAGS"] == "b"
+    assert env0[telemetry.WORKDIR_ENV] == str(tmp_path)
+    assert StagePlan().command()[-1].endswith("pipeline_trainer")
+
+
+def test_pipeline_supervisor_needs_two_stages():
+    from distributeddeeplearningspark_tpu.supervisor import (
+        PipelineSupervisor,
+        StagePlan,
+    )
+
+    with pytest.raises(ValueError, match=">= 2 stages"):
+        PipelineSupervisor([StagePlan()])
+
+
+def test_pipeline_supervisor_hang_watchdog_plumbing(tmp_path):
+    from distributeddeeplearningspark_tpu.supervisor import (
+        PipelineSupervisor,
+        StagePlan,
+    )
+
+    sup = PipelineSupervisor(
+        [StagePlan(argv=["true"]), StagePlan(argv=["true"])],
+        telemetry_dir=str(tmp_path), hang_timeout_s=5.0)
+    env0 = sup._stage_env(0)
+    assert env0["DLS_HEARTBEAT_FILE"] == sup._hb_path(0)
+    now = time.time()
+    sup._launch_wall[0] = now
+    assert not sup._hb_stale(0, now)           # just launched: in grace
+    assert sup._hb_stale(0, now - 60.0)        # silent past the timeout
+    with open(sup._hb_path(0), "w") as f:      # a heartbeat resets it
+        f.write("1")
+    assert not sup._hb_stale(0, now - 60.0)
+    import shutil
+
+    shutil.rmtree(sup._hb_dir, ignore_errors=True)
+
+
+def test_pipeline_supervisor_requires_spec_for_builtin_worker(monkeypatch):
+    from distributeddeeplearningspark_tpu.supervisor import (
+        PipelineSupervisor,
+        StagePlan,
+    )
+
+    monkeypatch.delenv(mpmd.ENV_SPEC, raising=False)
+    # built-in worker without its run spec: fail at construction with the
+    # var named, not after max_restarts KeyError crash-loops per stage
+    with pytest.raises(ValueError, match="DLS_PIPE_SPEC"):
+        PipelineSupervisor([StagePlan(), StagePlan()])
+    # a custom argv does not need the spec; a per-stage env satisfies it
+    PipelineSupervisor([StagePlan(argv=["true"]), StagePlan(argv=["true"])])
+    PipelineSupervisor([StagePlan(env={mpmd.ENV_SPEC: "{}"}),
+                        StagePlan(env={mpmd.ENV_SPEC: "{}"})])
+
+
+# -- stage program validation -------------------------------------------------
+
+
+def test_stage_program_validation(eight_devices):
+    import optax
+
+    from distributeddeeplearningspark_tpu.models import LlamaConfig
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+    from distributeddeeplearningspark_tpu.train.pipeline_trainer import (
+        LlamaStageProgram,
+    )
+
+    cfg = LlamaConfig.tiny()
+    mesh_t = MeshSpec(data=1, tensor=2).build(eight_devices[:2])
+    with pytest.raises(ValueError, match="sharded"):
+        LlamaStageProgram(cfg, 0, 2, mesh_t, optax.sgd(0.1), mode="exact")
+    mesh_d = MeshSpec(data=2).build(eight_devices[:2])
+    with pytest.raises(ValueError, match="full_batch"):
+        LlamaStageProgram(cfg, 0, 2, mesh_d, optax.sgd(0.1), mode="exact",
+                          loss_mode="per_microbatch")
+    with pytest.raises(ValueError, match="mode"):
+        LlamaStageProgram(cfg, 0, 2, mesh_d, optax.sgd(0.1), mode="magic")
+    with pytest.raises(ValueError, match="divide"):
+        LlamaStageProgram(cfg, 0, 3, mesh_d, optax.sgd(0.1))
+
+
+# -- end-to-end pipelines (slow tier: whole-model jits) -----------------------
+
+
+def _llama_batch_fn(cfg, b, t):
+    def batch_fn(step):
+        rng = np.random.default_rng(100 + step)
+        # distinct tokens per batch: the embedding-grad scatter-add order
+        # is then immaterial, one fewer confound in the bitwise pin
+        ids = rng.permutation(cfg.vocab_size)[: b * t].reshape(b, t)
+        return {"input_ids": ids.astype(np.int32),
+                "loss_mask": np.ones((b, t), np.float32)}
+
+    return batch_fn
+
+
+def _run_pipeline_threads(make_stage, num_stages, *, steps, batch_size,
+                          microbatches, batch_fn, seed=7, ckpt_dirs=None,
+                          timeout=900):
+    """Drive ``num_stages`` stage runners on threads over real sockets."""
+    from distributeddeeplearningspark_tpu.train.pipeline_trainer import (
+        PipelineStageRunner,
+        StageRunConfig,
+    )
+
+    ports = [_free_port() for _ in range(num_stages - 1)]
+    key = os.urandom(16)
+    results: dict = {}
+    errors: dict = {}
+
+    def run(stage):
+        try:
+            program, ckpt = make_stage(stage)
+            tr = mpmd.PipelineTransport(stage, num_stages, ports, key,
+                                        connect_timeout=120)
+            run_cfg = StageRunConfig(steps=steps, batch_size=batch_size,
+                                     microbatches=microbatches, seed=seed,
+                                     checkpoint_every=(
+                                         None if ckpt is None else
+                                         ckpt_dirs["every"]))
+            r = PipelineStageRunner(
+                program, tr, run_cfg,
+                batch_fn=batch_fn if stage == 0 else None, checkpointer=ckpt)
+            results[stage] = r.run()
+        except BaseException as e:  # noqa: BLE001 — reported via assert
+            import traceback
+
+            traceback.print_exc()
+            errors[stage] = e
+
+    ths = [threading.Thread(target=run, args=(s,)) for s in range(num_stages)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout)
+    assert not errors, errors
+    assert set(results) == set(range(num_stages))
+    return results
+
+
+def test_mpmd_bitwise_parity_vs_single_program_llama_pp(eight_devices):
+    """The flagship pin: a 2-stage × 2-device-per-stage MPMD pipeline
+    (separate meshes, socket transport, per-stage optimizers) produces the
+    SAME per-step losses and the SAME updated params, bit for bit, as the
+    single-program ``llama_pp`` GPipe baseline on a pipe=2 × data=2 mesh."""
+    import jax
+    import optax
+
+    from distributeddeeplearningspark_tpu.data.feed import put_global
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        llama_rules,
+    )
+    from distributeddeeplearningspark_tpu.models.llama_pp import make_pp_apply
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+    from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+    from distributeddeeplearningspark_tpu.train.pipeline_trainer import (
+        LlamaStageProgram,
+    )
+
+    cfg = LlamaConfig.tiny()
+    steps, b, t, m, seed = 3, 8, 32, 4, 7
+    batch_fn = _llama_batch_fn(cfg, b, t)
+    tx = optax.adamw(1e-3)
+
+    mesh_pp = MeshSpec(data=2, pipe=2).build(eight_devices[:4])
+    model = LlamaForCausalLM(cfg)
+    state, shardings = step_lib.init_state(
+        model, tx, batch_fn(0), mesh_pp,
+        llama_rules(cfg, fsdp=False, pipeline=True), seed=seed)
+    ts = step_lib.jit_train_step(
+        step_lib.make_train_step(make_pp_apply(cfg, mesh_pp, m), tx,
+                                 losses.causal_lm), mesh_pp, shardings)
+    base_losses = []
+    for s in range(steps):
+        state, met = ts(state, put_global(batch_fn(s), mesh_pp))
+        base_losses.append(float(jax.device_get(met["loss"])))
+    base = jax.device_get(state.params)
+
+    def make_stage(stage):
+        mesh = MeshSpec(data=2).build(
+            eight_devices[2 * stage:2 * stage + 2])
+        return LlamaStageProgram(cfg, stage, 2, mesh, optax.adamw(1e-3),
+                                 mode="exact"), None
+
+    results = _run_pipeline_threads(make_stage, 2, steps=steps,
+                                    batch_size=b, microbatches=m,
+                                    batch_fn=batch_fn, seed=seed)
+    mp_losses = results[0]["losses"]
+    assert [np.float32(x).tobytes() for x in base_losses] == \
+        [np.float32(x).tobytes() for x in mp_losses], (base_losses, mp_losses)
+
+    s0 = jax.device_get(results[0]["state"].params)
+    s1 = jax.device_get(results[1]["state"].params)
+
+    def flat(tree):
+        return {"/".join(str(getattr(p, "key", p)) for p in path): np.asarray(v)
+                for path, v in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+    fb, f0, f1 = flat(base), flat(s0), flat(s1)
+    for k, v in fb.items():
+        if k.startswith("layers/"):
+            got = np.concatenate([f0[k], f1[k]], axis=0)
+        elif k.startswith("token_embed/"):
+            got = f0[k]
+        else:
+            got = f1[k]
+        assert v.tobytes() == got.tobytes(), f"params diverged at {k}"
+
+
+def test_mpmd_heterogeneous_stage_meshes(eight_devices):
+    """The MPMD headline: stage 0 on a wide-fsdp mesh (embedding-heavy),
+    stage 1 on a tensor-parallel mesh (MLP/head-heavy), per-microbatch
+    1F1B loss — different layouts per stage, loss still matching a pure-DP
+    reference to fp tolerance."""
+    import jax
+    import optax
+
+    from distributeddeeplearningspark_tpu.data.feed import put_global
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        llama_rules,
+    )
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+    from distributeddeeplearningspark_tpu.parallel.sharding import (
+        ShardingRules,
+    )
+    from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+    from distributeddeeplearningspark_tpu.train.pipeline_trainer import (
+        LlamaStageProgram,
+    )
+
+    cfg = LlamaConfig.tiny()
+    steps, b, t, m, seed = 2, 8, 32, 4, 7
+    batch_fn = _llama_batch_fn(cfg, b, t)
+    tx = optax.adamw(1e-3)
+    model = LlamaForCausalLM(cfg)
+    mesh_dp = MeshSpec(data=4).build(eight_devices[:4])
+    state, sh = step_lib.init_state(model, tx, batch_fn(0), mesh_dp,
+                                    ShardingRules(), seed=seed)
+    ts = step_lib.jit_train_step(
+        step_lib.make_train_step(model.apply, tx, losses.causal_lm),
+        mesh_dp, sh)
+    ref = []
+    for s in range(steps):
+        state, met = ts(state, put_global(batch_fn(s), mesh_dp))
+        ref.append(float(jax.device_get(met["loss"])))
+
+    def make_stage(stage):
+        if stage == 0:
+            mesh = MeshSpec(data=1, fsdp=2).build(eight_devices[0:2])
+            rules = ShardingRules(fsdp=True, fsdp_min_size=1 << 10)
+        else:
+            mesh = MeshSpec(data=1, tensor=2).build(eight_devices[2:4])
+            rules = llama_rules(cfg, fsdp=False)
+        return LlamaStageProgram(cfg, stage, 2, mesh, optax.adamw(1e-3),
+                                 mode="sharded",
+                                 loss_mode="per_microbatch",
+                                 rules=rules), None
+
+    results = _run_pipeline_threads(make_stage, 2, steps=steps,
+                                    batch_size=b, microbatches=m,
+                                    batch_fn=batch_fn, seed=seed)
+    np.testing.assert_allclose(ref, results[0]["losses"], rtol=1e-5,
+                               atol=1e-6)
+    # the layouts really were heterogeneous
+    specs0 = {str(l.sharding.spec) for l in
+              jax.tree_util.tree_leaves(results[0]["state"].params)}
+    specs1 = {str(l.sharding.spec) for l in
+              jax.tree_util.tree_leaves(results[1]["state"].params)}
+    assert any("fsdp" in s for s in specs0), specs0
+    assert any("tensor" in s for s in specs1), specs1
+
+
+def test_mpmd_stage_geometry_change_on_restore(eight_devices, tmp_path):
+    """A stage can come back on a DIFFERENT mesh: train 2 steps on
+    (data=2, data=2) checkpointing, then restart with stage 1 on a
+    tensor=2 mesh restoring through the reshard path — training continues
+    and the remaining losses match the uninterrupted run."""
+    import optax
+
+    from distributeddeeplearningspark_tpu.checkpoint import Checkpointer
+    from distributeddeeplearningspark_tpu.models import (
+        LlamaConfig,
+        llama_rules,
+    )
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+    from distributeddeeplearningspark_tpu.train.pipeline_trainer import (
+        LlamaStageProgram,
+    )
+
+    cfg = LlamaConfig.tiny()
+    b, t, m, seed = 8, 32, 4, 7
+    batch_fn = _llama_batch_fn(cfg, b, t)
+
+    def exact_stage(stage):
+        mesh = MeshSpec(data=2).build(eight_devices[2 * stage:2 * stage + 2])
+        return LlamaStageProgram(cfg, stage, 2, mesh, optax.adamw(1e-3),
+                                 mode="exact")
+
+    # uninterrupted 4-step reference
+    ref = _run_pipeline_threads(lambda s: (exact_stage(s), None), 2,
+                                steps=4, batch_size=b, microbatches=m,
+                                batch_fn=batch_fn, seed=seed)
+    # session 1: 2 steps, checkpointed per stage
+    dirs = {s: str(tmp_path / f"stage{s}") for s in range(2)}
+
+    def with_ckpt(builder):
+        def make(stage):
+            return builder(stage), Checkpointer(dirs[stage],
+                                                async_save=False)
+        return make
+
+    _run_pipeline_threads(with_ckpt(exact_stage), 2, steps=2, batch_size=b,
+                          microbatches=m, batch_fn=batch_fn, seed=seed,
+                          ckpt_dirs={"every": 2})
+
+    # session 2: stage 1 restarts on a DIFFERENT mesh (sharded/tensor) and
+    # restores the exact-mode checkpoint through reshard-on-restore
+    def changed_stage(stage):
+        if stage == 0:
+            return exact_stage(stage)
+        mesh = MeshSpec(data=1, tensor=2).build(eight_devices[2:4])
+        return LlamaStageProgram(cfg, 1, 2, mesh, optax.adamw(1e-3),
+                                 mode="sharded",
+                                 loss_mode="full_batch",
+                                 rules=llama_rules(cfg, fsdp=False))
+
+    res = _run_pipeline_threads(with_ckpt(changed_stage), 2, steps=4,
+                                batch_size=b, microbatches=m,
+                                batch_fn=batch_fn, seed=seed,
+                                ckpt_dirs={"every": 2})
+    # the restored run reports the WHOLE trajectory (steps 1-2 ride the
+    # checkpoint's data_state); steps 3-4 ran with a tensor-parallel
+    # stage 1 — same training to fp tolerance
+    assert len(res[0]["losses"]) == 4
+    np.testing.assert_allclose(ref[0]["losses"], res[0]["losses"],
+                               rtol=1e-5, atol=1e-6)
+    specs1 = {str(l.sharding.spec) for l in
+              __import__("jax").tree_util.tree_leaves(
+                  res[1]["state"].params)}
+    assert any("tensor" in s for s in specs1), specs1
+
+
+def test_pipeline_supervisor_stage_kill_drill(tmp_path):
+    """Process-level chaos: DLS_FAULT=die_host@5 targeted at stage 1's
+    gang kills it mid-run; ONLY stage 1 restarts (stage 0 resyncs over the
+    transport without restarting), the run completes, and the end-to-end
+    loss trajectory matches an unfaulted run bitwise."""
+    from distributeddeeplearningspark_tpu.supervisor import (
+        PipelineSupervisor,
+        StagePlan,
+    )
+
+    spec = {"steps": 6, "batch_size": 8, "microbatches": 4, "seq": 32,
+            "checkpoint_every": 2, "seed": 0, "mode": "exact",
+            "mesh": {"data": 2}}
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    base_env = {
+        "DLS_PIPE_SPEC": json.dumps(spec),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+
+    def run(tag, fault):
+        wd = str(tmp_path / tag)
+        env = dict(base_env)
+        if fault:
+            env.update({"DLS_FAULT": "die_host@5", "DLS_FAULT_HOST": "1",
+                        "DLS_FAULT_ONCE": "1"})
+        sup = PipelineSupervisor([StagePlan(), StagePlan()], env=env,
+                                 telemetry_dir=wd, wall_timeout_s=900,
+                                 restart_backoff_s=0.1)
+        res = sup.run()
+        assert res.ok, {k: [a.returncodes for a in v]
+                        for k, v in res.attempts.items()}
+        with open(os.path.join(wd, "DONE")) as f:
+            done = json.load(f)
+        return res, done, wd
+
+    _, clean, _ = run("clean", fault=False)
+    res, faulted, wd = run("fault", fault=True)
+    assert res.restarts_of(1) == 1 and res.restarts_of(0) == 0, \
+        {k: len(v) for k, v in res.attempts.items()}
+    assert faulted["step"] == 6
+    assert [np.float32(x).tobytes() for x in clean["losses"]] == \
+        [np.float32(x).tobytes() for x in faulted["losses"]]
+    events = telemetry.read_events(wd)
+    rec = [(e.get("event"), e.get("stage")) for e in events
+           if e.get("kind") == "recovery"]
+    assert ("stage-restart", 1) in rec, rec
+    assert ("pipeline-resync", 0) in rec, rec  # the survivor resync'd
+    ends = [(e.get("stage"), e.get("classification")) for e in events
+            if e.get("kind") == "attempt" and e.get("edge") == "end"]
+    assert (1, "stage-crash") in ends and (0, "clean") in ends, ends
+    # the pipeline block is populated from the same workdir
+    from distributeddeeplearningspark_tpu import status
+
+    pl = status.report(wd, traces=True)["pipeline"]
+    assert pl and pl["p"] == 2 and pl["measured_bubble_frac"] is not None

@@ -696,6 +696,128 @@ PYEOF
   return $rc
 }
 
+# mpmd smoke (ISSUE 13): the MPMD stage-pipeline end to end — (1) a
+# 2-stage x 2-fake-device pipeline over the socket transport matches the
+# single-program llama_pp baseline BITWISE (per-step losses), (2) a
+# supervised process-level run reports its bubble fraction via the trace
+# spans and lands under the (P-1)/(M+P-1) bound + 10%, and (3) the
+# stage-kill chaos drill (DLS_FAULT=die_host targeted at stage 1's gang)
+# recovers with ONLY that stage restarting and a loss trajectory that
+# matches the clean run bitwise.
+run_mpmd_smoke() {
+  local t0 rc wd out
+  t0=$(date +%s)
+  rc=0
+  wd=$(mktemp -d /tmp/dls_mpmd_smoke.XXXXXX)
+  out=$(WD="$wd" python - <<'PYEOF'
+import json, os, secrets, subprocess, sys, threading
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") \
+    + " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
+import numpy as np, optax
+
+from distributeddeeplearningspark_tpu.data.feed import put_global
+from distributeddeeplearningspark_tpu.models import (
+    LlamaConfig, LlamaForCausalLM, llama_rules)
+from distributeddeeplearningspark_tpu.models.llama_pp import make_pp_apply
+from distributeddeeplearningspark_tpu.parallel import mpmd
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+from distributeddeeplearningspark_tpu.supervisor import free_port
+from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+from distributeddeeplearningspark_tpu.train.pipeline_trainer import (
+    LlamaStageProgram, PipelineStageRunner, StageRunConfig)
+
+cfg = LlamaConfig.tiny()
+STEPS, B, T, M, SEED = 3, 8, 32, 4, 7
+def batch_fn(step):
+    rng = np.random.default_rng(100 + step)
+    ids = rng.permutation(cfg.vocab_size)[:B*T].reshape(B, T)
+    return {"input_ids": ids.astype(np.int32),
+            "loss_mask": np.ones((B, T), np.float32)}
+
+# 1) bitwise parity vs the single-program llama_pp train step
+devs = jax.devices()
+mesh_pp = MeshSpec(data=2, pipe=2).build(devs[:4])
+tx = optax.adamw(1e-3)
+state, sh = step_lib.init_state(
+    LlamaForCausalLM(cfg), tx, batch_fn(0), mesh_pp,
+    llama_rules(cfg, fsdp=False, pipeline=True), seed=SEED)
+ts = step_lib.jit_train_step(
+    step_lib.make_train_step(make_pp_apply(cfg, mesh_pp, M), tx,
+                             losses.causal_lm), mesh_pp, sh)
+base = []
+for s in range(STEPS):
+    state, met = ts(state, put_global(batch_fn(s), mesh_pp))
+    base.append(float(jax.device_get(met["loss"])))
+
+ports, key = [free_port()], secrets.token_bytes(16)
+results, errors = {}, {}
+def run_stage(stage):
+    try:
+        mesh = MeshSpec(data=2).build(devs[2*stage:2*stage+2])
+        prog = LlamaStageProgram(cfg, stage, 2, mesh, optax.adamw(1e-3),
+                                 mode="exact")
+        tr = mpmd.PipelineTransport(stage, 2, ports, key, connect_timeout=120)
+        r = PipelineStageRunner(
+            prog, tr, StageRunConfig(steps=STEPS, batch_size=B,
+                                     microbatches=M, seed=SEED),
+            batch_fn=batch_fn if stage == 0 else None)
+        results[stage] = r.run()
+    except BaseException as e:
+        import traceback; traceback.print_exc(); errors[stage] = e
+ths = [threading.Thread(target=run_stage, args=(s,)) for s in range(2)]
+[t.start() for t in ths]; [t.join(900) for t in ths]
+assert not errors, errors
+mp = results[0]["losses"]
+assert [np.float32(x).tobytes() for x in base] == \
+    [np.float32(x).tobytes() for x in mp], (base, mp)
+
+# 2) supervised process pipeline: bubble reported, under bound + 10%.
+# seq 96: per-microbatch compute must dominate socket transport on the
+# shared CI box, or the measured bubble reads transport noise, not
+# schedule (docs/PERFORMANCE.md "Sizing the microbatch")
+wd = os.environ["WD"]
+def example(*extra):
+    p = subprocess.run(
+        [sys.executable, "examples/train_llama_mpmd.py", "--steps", "8",
+         "--microbatches", "4", "--seq", "96", *extra],
+        capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr[-800:]
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+clean = example("--workdir", os.path.join(wd, "clean"))
+e = clean["extra"]
+assert e["ok"] and e["final_step"] == 8, e
+bub, theo = e["pipeline_bubble_frac"], e["theoretical_bubble_frac"]
+assert bub is not None and theo is not None, e
+assert bub < theo + 0.10, f"bubble {bub} over bound {theo}+0.10"
+assert e["microbatch_traces"] >= 8, e  # cross-stage trace context landed
+
+# 3) stage-kill drill: only stage 1 restarts, trajectory bitwise clean
+drill = example("--workdir", os.path.join(wd, "drill"),
+                "--kill-stage", "1", "--kill-at", "5")
+d = drill["extra"]
+assert d["ok"], d
+assert d["restarts_per_stage"] == {"0": 0, "1": 1}, d["restarts_per_stage"]
+assert [np.float32(x).tobytes() for x in e["losses"]] == \
+    [np.float32(x).tobytes() for x in d["losses"]], (e["losses"], d["losses"])
+
+print(f"parity=bitwise({STEPS} steps) bubble={bub:.3f} bound={theo:.3f} "
+      f"traces={e['microbatch_traces']} drill_restarts={d['restarts_per_stage']}")
+PYEOF
+) || rc=$?
+  log mpmd "${out:-mpmd smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[mpmd] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$wd"
+  return $rc
+}
+
 # perf-guard smoke (ISSUE 10): the regression sentinel must pass on the
 # repo's own BENCH history (rc 0) and must trip — nonzero rc, metric
 # named — when fed a synthetic 20%-slower record as the current round.
@@ -748,6 +870,7 @@ case "${1:-both}" in
         run_tier slow "slow" || overall=$?
         run_shuffle_smoke || overall=$?
         run_elastic_smoke || overall=$?
+        run_mpmd_smoke || overall=$?
         run_perf_guard_smoke || overall=$? ;;
   # the recovery drills (kill-mid-finalize, poisoned restore, hang, NaN
   # spike) end-to-end — slow-marked, so the fast tier never pays for gangs
@@ -782,6 +905,10 @@ case "${1:-both}" in
   # completion on the survivor) + dlstatus geometry change + bitwise
   # fsdp→tensor restore (docs/POD_PLAYBOOK.md "We lost a host")
   elastic) run_elastic_smoke || overall=$? ;;
+  # MPMD pipeline: 2-stage bitwise parity vs llama_pp, bubble under the
+  # (P-1)/(M+P-1) bound + 10%, stage-kill drill restarts ONLY the dead
+  # stage (docs/PERFORMANCE.md "MPMD pipelines")
+  mpmd) run_mpmd_smoke || overall=$? ;;
   # regression sentinel: BENCH history passes, synthetic 20%-slower
   # record trips rc!=0 with the metric named (tools/perf_guard.py)
   perf-guard) run_perf_guard_smoke || overall=$? ;;
@@ -789,6 +916,6 @@ case "${1:-both}" in
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|anatomy|elastic|perf-guard|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|anatomy|elastic|mpmd|perf-guard|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall

@@ -64,13 +64,17 @@ HIGHER_BETTER = ("images_per_sec_per_chip", "tokens_per_sec_per_chip",
                  "shuffle_tuple_keys_per_sec",
                  "shuffle_columnar_keys_per_sec",
                  "shuffle_device_keys_per_sec",
-                 "columnar_speedup_vs_tuple")
-LOWER_BETTER = ("step_time_ms", "compile_s")
+                 "columnar_speedup_vs_tuple",
+                 "steps_per_sec")
+#: pipeline_bubble_frac: idle fraction of the MPMD stage pipeline —
+#: growth means the transport or the 1F1B/GPipe schedule regressed even
+#: when wall-clock noise hides it in steps/sec.
+LOWER_BETTER = ("step_time_ms", "compile_s", "pipeline_bubble_frac")
 ZERO_EXPECTED = ("recompile_count",)
 
 #: bench arms whose records carry the fields above (bench.py `want` names).
 ARMS = ("resnet50", "bert_base_mlm", "llama_lora", "llama_decode", "dlrm",
-        "input_pipeline")
+        "input_pipeline", "mpmd_pipeline")
 
 #: compile times swing with host load far more than steady-state step time.
 COMPILE_BAND_FACTOR = 3.0
