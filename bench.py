@@ -1067,6 +1067,40 @@ def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
         shuffle_sums[arm] = digest
     assert len(set(shuffle_sums.values())) == 1, (
         f"transport arms diverged: {shuffle_sums}")
+
+    # Shuffle recovery overhead (ISSUE 14): the SAME 200k-key corpus
+    # through the columnar exchange with a mapper AND a reducer SIGKILLed
+    # mid-run (die_shuffle_worker, role=both) — lineage retry must finish
+    # it digest-identical, and the wall-clock delta vs a clean pass is
+    # the price of self-healing (retained-frame replay + slice
+    # recompute). BOTH arms pin DLS_SHUFFLE_MAX_RETRIES=3 so they run
+    # the same retain-mode transport regardless of the ambient env — the
+    # pct must mean "recovery cost", not "whatever transport the host
+    # happened to configure", or perf_guard's history series would mix
+    # incomparable values. LOWER_BETTER in tools/perf_guard.py.
+    drill_env = {"DLS_SHUFFLE_MAX_RETRIES": "3"}
+    fault_env = {"DLS_FAULT": "die_shuffle_worker@2",
+                 "DLS_FAULT_SHUFFLE_ROLE": "both",
+                 "DLS_FAULT_SHUFFLE_ID": "0"}
+    saved_env = {k: os.environ.get(k) for k in {**drill_env, **fault_env}}
+    os.environ.update(drill_env)
+    try:
+        clean_rate, clean_sum = _agg_rate("columnar", nproc)
+        os.environ.update(fault_env)
+        faulted_rate, faulted_sum = _agg_rate("columnar", nproc)
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert clean_sum == shuffle_sums["columnar"], (
+        "retain-mode clean pass diverged from the transport arms")
+    assert faulted_sum == clean_sum, (
+        "faulted shuffle diverged from the clean run")
+    recovery_overhead_pct = round(
+        max(0.0, (clean_rate / max(faulted_rate, 1e-9) - 1.0)) * 100.0, 1)
+
     return {
         # keep this key's historical meaning (JPEG-decode path) so the series
         # stays comparable across rounds; the record path reports separately
@@ -1089,6 +1123,9 @@ def bench_input(iters: int, batch_size: int = 256, *, n_images: int = 256,
         # digest-asserted)
         "shuffle_keys_per_sec": shuffle_arms,
         "shuffle_cardinality": shuffle_card,
+        # faulted (mapper+reducer killed) vs clean wall-clock on the same
+        # corpus — the cost of shuffle self-healing (ISSUE 14)
+        "shuffle_recovery_overhead_pct": recovery_overhead_pct,
         "shuffle_tuple_keys_per_sec": shuffle_arms["tuple"],
         "shuffle_columnar_keys_per_sec": shuffle_arms["columnar"],
         "shuffle_device_keys_per_sec": shuffle_arms["device"],

@@ -79,6 +79,63 @@ exists only to measure the difference. The numeric combines themselves
 can additionally be lowered onto the accelerator via
 :mod:`~.device_agg` (``groupBy().agg(transport="device")``), whose
 jitted ``jax.ops.segment_*`` kernels ride the PR 9 compile ledger.
+
+**Task-level fault tolerance (ISSUE 14).** The exchange survives task
+failure instead of reporting it — the Spark lineage-retry model, safe
+here because mapper tasks are pure callables over partition slices:
+
+- **Tasks, not assignments.** The ``(part, slot, k)`` slices go through a
+  shared task queue that mappers *pull* from, so a dead worker's
+  unfinished slices flow to a respawned or surviving mapper with no
+  rebalancing code. Every shipped payload frame carries a deterministic
+  identity ``(part, slot, seq)`` — per-slice state (byte meters, dtype
+  pins, batch buffers, the frame counter) resets at slice entry, so a
+  replayed slice ships *byte-identical* frames at the same ids no matter
+  which worker runs it. Reducers deduplicate on that identity, which is
+  what makes retry AND speculative execution safe: first finish wins,
+  duplicates drop, output stays byte-identical to a fault-free run (the
+  blake2b checksum discipline is the oracle).
+- **Retention IS the transport (retain mode).** With retries enabled
+  (``DLS_SHUFFLE_MAX_RETRIES`` > 0, default 3) every frame is written
+  to the spill dir as an atomically-renamed ``ret-*`` file named by its
+  identity, and reducers SWEEP the directory for unseen frames — one
+  producer copy either way (page cache instead of shm pages), and,
+  decisively, **no shared data queue exists**: a SIGKILLed producer
+  cannot tear a frame mid-pipe-write or die holding a queue lock the
+  survivors then block on (both observed with shared ``mp.Queue``
+  transport under the chaos drill). Control traffic rides per-attempt
+  driver-owned queues whose messages stay under the pipe's 4KB
+  atomic-write bound. A dead reducer's replacement rebuilds its buckets
+  purely from retained files (never touching the dead consumer's
+  possibly-torn pipe); retained files are deleted when the exchange
+  completes. ``DLS_SHUFFLE_MAX_RETRIES=0`` keeps the legacy
+  shm-arena/queue transport byte-for-byte with zero retention and zero
+  recovery — the measurement baseline and today's fail-fast behavior
+  (docs/PERFORMANCE.md "Retention cost").
+- **Legacy mode never recovers, so arenas are attempt-0-only.** With
+  the budget at 0 the first failure escalates before any respawn could
+  happen; every child that exists forked at exchange start, so the shm
+  arenas, free queues, and reducer data queues all belong to those
+  original attempts and need no cross-attempt versioning.
+- **Reducer termination by count.** Map completion is driven by
+  driver-side slice accounting, not per-mapper queue sentinels: each
+  ``slice-done`` reports per-reducer unique-frame counts (deterministic
+  across replays), the driver sends each reducer an ``eof`` total once
+  all slices are done, and a reducer finalizes when its unique-frame set
+  reaches that total — late, lost, or duplicated frames all converge.
+- **Policy.** A retry budget (``DLS_SHUFFLE_MAX_RETRIES``) bounds total
+  recovery actions, escalating to the same typed
+  :class:`~.workers.WorkerCrashed` as before when exhausted; per-worker
+  failure scoring blacklists a worker slot after
+  ``DLS_SHUFFLE_BLACKLIST_AFTER`` strikes (a blacklisted mapper's work
+  redistributes; a blacklisted reducer escalates — its buckets are
+  pinned); and speculative execution re-enqueues a slice whose runtime
+  lags ``DLS_SHUFFLE_SPECULATE_FACTOR`` × the median completed-slice
+  duration (first finish wins via dedup). Every retry / speculation /
+  blacklist decision is a ``shuffle`` telemetry event rendered by
+  ``dlstatus``, and ``DLS_FAULT=die_shuffle_worker@N`` (faults.py) kills
+  a mapper at its Nth element / a reducer at its Nth merged frame for
+  deterministic drills (``tools/ci.sh shuffle-chaos``).
 """
 
 from __future__ import annotations
@@ -102,9 +159,9 @@ from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
 
-from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu import faults, telemetry
 from distributeddeeplearningspark_tpu.data.workers import (
-    _POLL_S, _Arena, _align, WorkerCrashed, fork_available,
+    _POLL_S, _Arena, _align, WorkerCrashed, env_num, fork_available,
     resolve_num_workers)
 
 #: env knob: total shuffle memory budget (MB) split over mapper arenas,
@@ -130,6 +187,32 @@ _DEFAULT_MAX_GROUPS = 1_000_000
 #: combines, data/device_agg.py).
 TRANSPORT_ENV = "DLS_SHUFFLE_TRANSPORT"
 TRANSPORTS = ("auto", "tuple", "columnar", "device")
+#: env knob: total recovery actions (mapper/reducer respawns, slice
+#: re-executions after a raise) one exchange may spend before escalating
+#: to the typed :class:`~.workers.WorkerCrashed`. 0 = today's fail-fast
+#: behavior exactly (and disables frame retention — the perf baseline).
+MAX_RETRIES_ENV = "DLS_SHUFFLE_MAX_RETRIES"
+_DEFAULT_MAX_RETRIES = 3
+#: env knob: failure strikes before a worker slot is blacklisted.
+BLACKLIST_ENV = "DLS_SHUFFLE_BLACKLIST_AFTER"
+_DEFAULT_BLACKLIST_AFTER = 2
+#: env knob: speculative-execution lag factor — a running slice whose
+#: elapsed time exceeds factor × median completed-slice duration (and
+#: the 1s floor) is cloned to the task queue; first finish wins, frame
+#: dedup makes the clone safe. <= 0 disables speculation.
+SPECULATE_ENV = "DLS_SHUFFLE_SPECULATE_FACTOR"
+_DEFAULT_SPECULATE_FACTOR = 4.0
+#: speculation never triggers below this elapsed time — tiny test slices
+#: must not clone themselves just because the median is microseconds.
+_SPECULATE_FLOOR_S = 1.0
+#: last-resort driver stall window: with no control-queue progress for
+#: this long, undone slices with no registered runner are re-enqueued.
+#: Custody loss (a task popped by a worker that died before its
+#: slice-start landed) is normally repaired by the failure handler
+#: itself; this net only exists so an unforeseen loss degrades into one
+#: duplicate round per minute instead of a hang. Duplicates are harmless
+#: by dedup.
+_RESEED_S = 60.0
 #: declared numeric combines a ColumnarPlan can vectorize. "count" is a
 #: sum of int64 count planes, "mean" derives from (sum, count) at read
 #: time — both reduce to these three.
@@ -205,6 +288,25 @@ def mem_budget_bytes(explicit_mb: float | None = None) -> int:
         except ValueError:
             explicit_mb = _DEFAULT_MEM_MB
     return max(4 << 20, int(explicit_mb * (1 << 20)))
+
+
+def max_shuffle_retries(explicit: int | None = None) -> int:
+    """The exchange's recovery budget: explicit value, else
+    ``DLS_SHUFFLE_MAX_RETRIES``, else 3. 0 restores fail-fast."""
+    if explicit is not None:
+        return max(0, int(explicit))
+    return env_num(MAX_RETRIES_ENV, _DEFAULT_MAX_RETRIES, lo=0)
+
+
+def blacklist_after() -> int:
+    """Failure strikes before a worker slot is blacklisted (min 1)."""
+    return env_num(BLACKLIST_ENV, _DEFAULT_BLACKLIST_AFTER, lo=1)
+
+
+def speculate_factor() -> float:
+    """Speculation lag factor (``DLS_SHUFFLE_SPECULATE_FACTOR``, default
+    4.0); <= 0 disables speculative execution."""
+    return env_num(SPECULATE_ENV, _DEFAULT_SPECULATE_FACTOR, cast=float)
 
 
 def key_bytes(key: Any) -> bytes:
@@ -758,35 +860,65 @@ def _drain_frees(ring: _Arena, free_q) -> None:
         pass
 
 
-def _mapper_loop(mid: int, parts, assignment, spec: _Spec, n_out: int,
-                 shm, out_qs, free_q, ctrl_q, stop_evt, cap_bytes: int,
+def _clip_tb(tb: str, limit: int = 2000) -> str:
+    """Bound a forwarded traceback so the whole control message stays
+    under the pipe's 4KB atomic-write size: a producer SIGKILLed mid-write
+    must never leave a torn frame in a stream someone still reads. The
+    TAIL survives — that is where the raising line lives."""
+    return tb if len(tb) <= limit else "…" + tb[-limit:]
+
+
+def _mapper_loop(wid: int, epoch: int, parts, spec: _Spec, n_out: int,
+                 n_red: int, shm, arena_size: int, out_qs, free_q, ctl_q,
+                 task_q, stop_evt, cancel_evt, all_done_evt, done_flags,
+                 cap_bytes: int, retain: bool, spill_dir: str,
                  sort_route=None, plan: ColumnarPlan | None = None) -> None:
-    """Child body: walk assigned (partition, slot, k) slices, combine into a
-    bounded dict, flush bucketed payloads through the arena/queues. With a
-    :class:`ColumnarPlan`, conforming batches accumulate as planes instead
-    (exact-byte metered) and flush via vectorized sort + segment-combine +
-    hash-bucket split; non-conforming batches walk the tuple dict path."""
+    """Child body: PULL (partition, slot, k) slices off the shared task
+    queue, combine each into a bounded dict, flush bucketed payload
+    frames. In retain mode (retries enabled, the default) frames go to
+    disk as atomically-renamed ``ret-*`` files that reducers sweep — no
+    shared data queue a SIGKILLed producer could tear or lock-poison; in
+    legacy mode (``DLS_SHUFFLE_MAX_RETRIES=0``) they ship through the shm
+    arena / reducer queues exactly as before. With a
+    :class:`ColumnarPlan`, conforming batches accumulate as planes
+    instead (exact-byte metered) and flush via vectorized sort +
+    segment-combine + hash-bucket split; non-conforming batches walk the
+    tuple dict path.
+
+    ``ctl_q`` is this attempt's PRIVATE control queue (single producer):
+    a SIGKILL mid-write can only poison this attempt's own stream, and
+    every control message stays under the pipe's 4KB atomic-write bound
+    so the driver can keep draining it after the death.
+
+    EVERY piece of per-slice state — combine store, byte meters, batch
+    buffers, dtype pin, frame sequence counter — lives inside
+    ``run_slice``: a replayed or speculatively cloned slice ships
+    byte-identical frames at the same ``(part, slot, seq)`` ids no matter
+    which worker runs it or what that worker ran before, which is the
+    whole basis of reducer-side dedup (module docstring, ISSUE 14)."""
     os.environ["DLS_NATIVE_THREADS"] = "1"  # same capping rationale as workers
-    ring = _Arena(shm.size)
-    buf = shm.buf
-    alloc_id = 0
-    R = len(out_qs)
+    # retain mode ships through the filesystem — no arena exists (shm is
+    # None); the legacy transport gets its ring over the shm slab
+    ring = _Arena(shm.size) if shm is not None else None
+    buf = shm.buf if shm is not None else None
+    alloc_id = [0]
+    R = n_red
     stats = {"elems": 0, "pairs": 0, "bytes_moved": 0, "overflow": 0,
              "flushes": 0, "busy_s": 0.0, "cols_pairs": 0, "cols_bytes": 0}
-    store: dict = {}
-    meter = _ByteMeter()
-    cols: list[_Planes] = []        # columnar batches awaiting a flush
-    cols_meter = _ByteMeter()       # their EXACT bytes (add_exact — a
-    #                                 plane's size is known, never sampled)
-    pend_k: list = []               # rdd pair-mode vectorization buffer
-    pend_v: list = []
-    pin_sig: list = [None]          # first columnar batch pins the dtypes
-    #: one shipped payload must fit the arena with room to breathe; planes
-    #: above this split by rows (each slice is independently decodable)
-    ship_cap = max(_MIN_CAP, shm.size // 4)
+    #: one shipped payload must fit the (would-be) arena with room to
+    #: breathe; planes above this split by rows (each slice is
+    #: independently decodable). Static, derived from the configured
+    #: ``arena_size`` in BOTH modes — splitting by the arena's live hole
+    #: size (or by which transport happens to run) would make frame
+    #: boundaries depend on runtime state and break replay identity.
+    ship_cap = max(_MIN_CAP, arena_size // 4)
+    fault_at = None
+
+    def halted() -> bool:
+        return stop_evt.is_set() or cancel_evt.is_set()
 
     def put(q, rec) -> bool:
-        while not stop_evt.is_set():
+        while not halted():
             try:
                 q.put(rec, timeout=_POLL_S)
                 return True
@@ -798,181 +930,283 @@ def _mapper_loop(mid: int, parts, assignment, spec: _Spec, n_out: int,
         deadline = time.perf_counter() + _ALLOC_WAIT_S
         while True:
             _drain_frees(ring, free_q)
-            off = ring.try_alloc(alloc_id, need)
+            off = ring.try_alloc(alloc_id[0], need)
             if off is not None or need > ring.size:
                 return off
-            if stop_evt.is_set() or time.perf_counter() > deadline:
+            if halted() or time.perf_counter() > deadline:
                 return None
             try:
                 ring.free(free_q.get(timeout=_POLL_S))
             except queue_lib.Empty:
                 pass
 
-    def ship(bucket: int, payload: bytes, columnar: bool = False) -> bool:
-        nonlocal alloc_id
-        stats["bytes_moved"] += len(payload)
-        if columnar:
-            stats["cols_bytes"] += len(payload)
-        off = alloc(_align(len(payload)))
-        if off is None:
-            stats["overflow"] += 1
-            return put(out_qs[bucket % R], ("pkl", mid, bucket, payload))
-        buf[off:off + len(payload)] = payload
-        ok = put(out_qs[bucket % R],
-                 ("shm", mid, bucket, alloc_id, off, len(payload)))
-        alloc_id += 1
-        return ok
+    def run_slice(part_idx: int, slot: int, k: int):
+        """One slice, deterministically. Returns ``(per-reducer unique
+        frame counts, slice stats)`` or ``None`` when halted mid-slice."""
+        store: dict = {}
+        meter = _ByteMeter()
+        cols: list[_Planes] = []      # columnar batches awaiting a flush
+        cols_meter = _ByteMeter()     # their EXACT bytes (add_exact — a
+        #                               plane's size is known, never sampled)
+        pend_k: list = []             # rdd pair-mode vectorization buffer
+        pend_v: list = []
+        pin_sig: list = [None]        # first columnar batch pins the dtypes
+        seq = [0]
+        counts = [0] * R
+        sl = {"elems": 0, "pairs": 0, "cols_pairs": 0, "bytes": 0,
+              "cols_bytes": 0}
 
-    def add_tuple_pair(key, v) -> None:
-        if key in store:
-            store[key] = spec.combine(store[key], v)
-            meter.add(v)
-        else:
-            store[key] = spec.seed(v)
-            meter.add(v, 120)
+        def ship(bucket: int, payload: bytes, columnar: bool = False) -> bool:
+            stats["bytes_moved"] += len(payload)
+            sl["bytes"] += len(payload)
+            if columnar:
+                stats["cols_bytes"] += len(payload)
+                sl["cols_bytes"] += len(payload)
+            hdr = (part_idx, slot, seq[0])
+            seq[0] += 1
+            r = bucket % R
+            counts[r] += 1
+            if retain:
+                # the retained file IS the transport: reducers sweep
+                # their retention subdir, so no shared data queue exists
+                # for a killed producer to tear mid-write or lock-poison
+                # for the survivors — and the lineage-replay copy costs
+                # nothing extra (one write either way)
+                _retain_frame(spill_dir, r, bucket, hdr, payload)
+                return not halted()
+            off = alloc(_align(len(payload)))
+            if off is None:
+                stats["overflow"] += 1
+                return put(out_qs[r], ("pkl", wid, bucket, payload, hdr))
+            buf[off:off + len(payload)] = payload
+            ok = put(out_qs[r], ("shm", wid, bucket, alloc_id[0], off,
+                                 len(payload), hdr))
+            alloc_id[0] += 1
+            return ok
 
-    def drain_pend() -> None:
-        """Vectorize the buffered rdd pairs, or route the batch through
-        the tuple dict when it does not conform / breaks the pinned
-        dtype signature (np.concatenate across mismatched planes would
-        silently promote — int keys becoming floats is a wrong answer,
-        not a slow one)."""
-        if not pend_k:
-            return
-        pl = plan.pair_planes(pend_k, pend_v)
-        if pl is not None and (pin_sig[0] is None
-                               or pl.dtype_sig() == pin_sig[0]):
-            pin_sig[0] = pin_sig[0] or pl.dtype_sig()
-            cols.append(pl)
-            cols_meter.add_exact(pl.nbytes)
-            stats["cols_pairs"] += len(pl)
-        else:
-            for key, v in zip(pend_k, pend_v):
-                add_tuple_pair(key, v)
-        pend_k.clear()
-        pend_v.clear()
+        def add_tuple_pair(key, v) -> None:
+            if key in store:
+                store[key] = spec.combine(store[key], v)
+                meter.add(v)
+            else:
+                store[key] = spec.seed(v)
+                meter.add(v, 120)
 
-    def flush() -> bool:
-        if plan is not None and plan.pre_planes is None:
-            drain_pend()
-        if not store and not cols:
-            return True
-        stats["flushes"] += 1
-        if cols:
-            combined = combine_planes(_Planes.concat(cols), plan)
-            cols.clear()
-            cols_meter.reset()
-            # size payload slices to what the arena can actually place:
-            # its largest current hole (advisory — frees land async), the
-            # static cap as the floor/ceiling
-            _drain_frees(ring, free_q)
-            cap_now = min(ship_cap, max(_MIN_CAP, ring.largest_hole()))
-            for b, sub in _bucket_split(combined, n_out):
-                row_bytes = max(1, sub.nbytes // max(1, len(sub)))
-                step = max(1, cap_now // row_bytes)
-                for lo in range(0, len(sub), step):
-                    payload = pickle.dumps(
-                        sub.cut(lo, min(lo + step, len(sub))).payload(),
-                        protocol=_PICKLE_PROTO)
-                    if not ship(b, payload, columnar=True):
-                        return False
-        if store:
-            buckets: dict[int, list] = {}
-            for key, acc in store.items():
-                kb = key_bytes(key)
-                buckets.setdefault(bucket_of(kb, n_out), []).append(
-                    (kb, key, acc))
-            store.clear()
-            meter.reset()
-            for b in sorted(buckets):
-                if not ship(b, pickle.dumps(buckets[b],
-                                            protocol=_PICKLE_PROTO)):
-                    return False
-        return True
-
-    try:
-        for part_idx, slot, k in assignment:
-            t0 = time.perf_counter()
-            for j, elem in enumerate(parts[part_idx]()):
-                if k > 1 and j % k != slot:
-                    continue
-                if stop_evt.is_set():
-                    return
-                stats["elems"] += 1
-                if sort_route is not None:
-                    # sort mode: no combine — route each element straight
-                    # to its range bucket, tagged with (key, part, idx)
-                    kv = sort_route[0](elem)
-                    b = sort_route[1](kv)
-                    store.setdefault(b, []).append((kv, part_idx, j, elem))
-                    meter.add(elem, 64)
-                    stats["pairs"] += 1
-                    if meter.value >= cap_bytes:
-                        stats["flushes"] += 1
-                        for bb in sorted(store):
-                            if not ship(bb, pickle.dumps(
-                                    store[bb], protocol=_PICKLE_PROTO)):
-                                return
-                        store.clear()
-                        meter.reset()
-                    continue
-                if plan is not None and plan.pre_planes is not None:
-                    pl = plan.pre_planes(elem)
-                    if pl is not None and (pin_sig[0] is None
-                                           or pl.dtype_sig() == pin_sig[0]):
-                        pin_sig[0] = pin_sig[0] or pl.dtype_sig()
-                        cols.append(pl)
-                        cols_meter.add_exact(pl.nbytes)
-                        stats["pairs"] += len(pl)
-                        stats["cols_pairs"] += len(pl)
-                        if meter.value + cols_meter.value >= cap_bytes:
-                            if not flush():
-                                return
-                        continue
-                pairs = spec.pre(elem) if spec.pre is not None else (elem,)
-                if plan is not None and plan.pre_planes is None:
-                    for key, v in pairs:
-                        stats["pairs"] += 1
-                        pend_k.append(key)
-                        pend_v.append(v)
-                        if len(pend_k) >= _PAIR_BATCH:
-                            drain_pend()
-                    if meter.value + cols_meter.value >= cap_bytes:
-                        if not flush():
-                            return
-                    continue
-                for key, v in pairs:
-                    stats["pairs"] += 1
-                    if spec.tag_values:
-                        v = (part_idx, j, v)
+        def drain_pend() -> None:
+            """Vectorize the buffered rdd pairs, or route the batch through
+            the tuple dict when it does not conform / breaks the pinned
+            dtype signature (np.concatenate across mismatched planes would
+            silently promote — int keys becoming floats is a wrong answer,
+            not a slow one)."""
+            if not pend_k:
+                return
+            pl = plan.pair_planes(pend_k, pend_v)
+            if pl is not None and (pin_sig[0] is None
+                                   or pl.dtype_sig() == pin_sig[0]):
+                pin_sig[0] = pin_sig[0] or pl.dtype_sig()
+                cols.append(pl)
+                cols_meter.add_exact(pl.nbytes)
+                stats["cols_pairs"] += len(pl)
+                sl["cols_pairs"] += len(pl)
+            else:
+                for key, v in zip(pend_k, pend_v):
                     add_tuple_pair(key, v)
-                    if meter.value + cols_meter.value >= cap_bytes:
-                        if not flush():
-                            return
-            # flush at every partition boundary: mapper state never spans
-            # partitions, so flush points depend only on the partition's
-            # own content and the cap
-            if sort_route is not None:
-                for bb in sorted(store):
-                    if not ship(bb, pickle.dumps(store[bb],
-                                                 protocol=_PICKLE_PROTO)):
-                        return
+            pend_k.clear()
+            pend_v.clear()
+
+        def flush() -> bool:
+            if plan is not None and plan.pre_planes is None:
+                drain_pend()
+            if not store and not cols:
+                return True
+            stats["flushes"] += 1
+            if cols:
+                combined = combine_planes(_Planes.concat(cols), plan)
+                cols.clear()
+                cols_meter.reset()
+                for b, sub in _bucket_split(combined, n_out):
+                    row_bytes = max(1, sub.nbytes // max(1, len(sub)))
+                    step = max(1, ship_cap // row_bytes)
+                    for lo in range(0, len(sub), step):
+                        payload = pickle.dumps(
+                            sub.cut(lo, min(lo + step, len(sub))).payload(),
+                            protocol=_PICKLE_PROTO)
+                        if not ship(b, payload, columnar=True):
+                            return False
+            if store:
+                buckets: dict[int, list] = {}
+                for key, acc in store.items():
+                    kb = key_bytes(key)
+                    buckets.setdefault(bucket_of(kb, n_out), []).append(
+                        (kb, key, acc))
                 store.clear()
                 meter.reset()
-            elif not flush():
-                return
-            stats["busy_s"] += time.perf_counter() - t0
-        for q in out_qs:
-            if not put(q, ("done", mid, None)):
-                return
-        put(ctrl_q, ("mapper-done", mid, stats))
+                for b in sorted(buckets):
+                    if not ship(b, pickle.dumps(buckets[b],
+                                                protocol=_PICKLE_PROTO)):
+                        return False
+            return True
+
+        t0 = time.perf_counter()
+        for j, elem in enumerate(parts[part_idx]()):
+            if k > 1 and j % k != slot:
+                continue
+            if halted():
+                return None
+            stats["elems"] += 1
+            sl["elems"] += 1
+            if fault_at is not None and stats["elems"] >= fault_at:
+                faults.crash()
+            if sort_route is not None:
+                # sort mode: no combine — route each element straight
+                # to its range bucket, tagged with (key, part, idx)
+                kv = sort_route[0](elem)
+                b = sort_route[1](kv)
+                store.setdefault(b, []).append((kv, part_idx, j, elem))
+                meter.add(elem, 64)
+                stats["pairs"] += 1
+                sl["pairs"] += 1
+                if meter.value >= cap_bytes:
+                    stats["flushes"] += 1
+                    for bb in sorted(store):
+                        if not ship(bb, pickle.dumps(
+                                store[bb], protocol=_PICKLE_PROTO)):
+                            return None
+                    store.clear()
+                    meter.reset()
+                continue
+            if plan is not None and plan.pre_planes is not None:
+                pl = plan.pre_planes(elem)
+                if pl is not None and (pin_sig[0] is None
+                                       or pl.dtype_sig() == pin_sig[0]):
+                    pin_sig[0] = pin_sig[0] or pl.dtype_sig()
+                    cols.append(pl)
+                    cols_meter.add_exact(pl.nbytes)
+                    stats["pairs"] += len(pl)
+                    sl["pairs"] += len(pl)
+                    stats["cols_pairs"] += len(pl)
+                    sl["cols_pairs"] += len(pl)
+                    if meter.value + cols_meter.value >= cap_bytes:
+                        if not flush():
+                            return None
+                    continue
+            pairs = spec.pre(elem) if spec.pre is not None else (elem,)
+            if plan is not None and plan.pre_planes is None:
+                for key, v in pairs:
+                    stats["pairs"] += 1
+                    sl["pairs"] += 1
+                    pend_k.append(key)
+                    pend_v.append(v)
+                    if len(pend_k) >= _PAIR_BATCH:
+                        drain_pend()
+                if meter.value + cols_meter.value >= cap_bytes:
+                    if not flush():
+                        return None
+                continue
+            for key, v in pairs:
+                stats["pairs"] += 1
+                sl["pairs"] += 1
+                if spec.tag_values:
+                    v = (part_idx, j, v)
+                add_tuple_pair(key, v)
+                if meter.value + cols_meter.value >= cap_bytes:
+                    if not flush():
+                        return None
+        # flush at the slice boundary: mapper state never spans slices,
+        # so flush points depend only on the slice's own content and the
+        # cap — the determinism replay identity rests on
+        if sort_route is not None:
+            for bb in sorted(store):
+                if not ship(bb, pickle.dumps(store[bb],
+                                             protocol=_PICKLE_PROTO)):
+                    return None
+            store.clear()
+            meter.reset()
+        elif not flush():
+            return None
+        stats["busy_s"] += time.perf_counter() - t0
+        return counts, sl
+
+    try:
+        # inside the forwarding try: a malformed spec must surface as this
+        # child's typed traceback, not an inscrutable nonzero-exit "death"
+        fault_at = faults.shuffle_fault("mapper", wid, epoch)
+        while not halted():
+            try:
+                task = task_q.get(timeout=_POLL_S)
+            except queue_lib.Empty:
+                if all_done_evt.is_set():
+                    break
+                continue
+            slice_idx, part_idx, slot, k = task
+            if done_flags[slice_idx]:
+                continue  # finished elsewhere (speculation / reseed dup)
+            if not put(ctl_q, ("slice-start", wid, epoch, slice_idx)):
+                break
+            try:
+                out = run_slice(part_idx, slot, k)
+            except BaseException:  # noqa: BLE001 — the SLICE failed (user
+                # combine raised, bad record); the worker itself is fine —
+                # report and keep pulling, the driver budgets the retries
+                if not put(ctl_q, ("slice-err", wid, epoch, slice_idx,
+                                   _clip_tb(traceback.format_exc()))):
+                    break
+                continue
+            if out is None:
+                break
+            if not put(ctl_q, ("slice-done", wid, epoch, slice_idx,
+                               out[0], out[1])):
+                break
+        put(ctl_q, ("mapper-done", wid, epoch, stats))
     except BaseException:  # noqa: BLE001 — forward ANY failure, typed
-        put(ctrl_q, ("err", ("mapper", mid), traceback.format_exc()))
+        put(ctl_q, ("err", ("mapper", wid, epoch),
+                    _clip_tb(traceback.format_exc())))
 
 
 def _spill_path(spill_dir: str, rid: int, bucket: int, n: int,
                 fmt: str = "pkl") -> str:
     return os.path.join(spill_dir, f"r{rid}-b{bucket}-run{n}.{fmt}")
+
+
+def _retain_dir(spill_dir: str, r: int) -> str:
+    """Per-reducer retention subdirectory (``bucket % R`` owner): each
+    reducer sweeps ONLY its own frames, so the poll cost scales with its
+    share of the shuffle, not with the whole spill directory."""
+    return os.path.join(spill_dir, f"ret{r}")
+
+
+def _retain_path(spill_dir: str, r: int, bucket: int, hdr: tuple) -> str:
+    """Retained-frame file: named by the frame's deterministic identity
+    alone — a replayed slice re-writes the SAME name with the SAME bytes,
+    so the atomic rename makes retention idempotent across attempts."""
+    part, slot, seq = hdr
+    return os.path.join(_retain_dir(spill_dir, r),
+                        f"ret-b{bucket}-p{part}-s{slot}-q{seq}.pkl")
+
+
+def _retain_frame(spill_dir: str, r: int, bucket: int, hdr: tuple,
+                  payload: bytes) -> str:
+    """Persist one frame — this IS the retain-mode transport (write to
+    temp + atomic rename, so a sweeping reader never sees a torn file),
+    and what a respawned reducer rebuilds from."""
+    path = _retain_path(spill_dir, r, bucket, hdr)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    return path
+
+
+def _parse_retained(fname: str) -> tuple[int, tuple] | None:
+    """``ret-b{b}-p{p}-s{s}-q{q}.pkl`` → ``(bucket, (part, slot, seq))``,
+    or None for any other file in the spill dir."""
+    if not fname.startswith("ret-") or not fname.endswith(".pkl"):
+        return None
+    try:
+        b, p, s, q = fname[4:-4].split("-")
+        return int(b[1:]), (int(p[1:]), int(s[1:]), int(q[1:]))
+    except (ValueError, IndexError):
+        return None
 
 
 def out_path(spill_dir: str, bucket: int) -> str:
@@ -1028,17 +1262,32 @@ def _iter_run(path: str) -> Iterator:
                 return
 
 
-def _reducer_loop(rid: int, M: int, R: int, n_out: int, spec: _Spec | None,
-                  in_q, free_qs, shm_names, ctrl_q, stop_evt,
+def _reducer_loop(rid: int, R: int, n_out: int, spec: _Spec | None,
+                  in_q, free_qs, shm_prefix, ctl_q, stop_evt,
                   cap_bytes: int, spill_dir: str, sort_spec=None,
-                  plan: ColumnarPlan | None = None) -> None:
-    """Child body: merge arriving bucket payloads under a byte budget,
-    spill sorted runs past it, k-way-merge runs into one output file per
-    owned bucket. A bucket receiving only plane payloads stays columnar
-    end to end (exact-byte metered, columnar spill runs, vectorized
-    merge, ``.cols`` output); the first tuple payload for a bucket
-    degrades THAT bucket to the tuple dict path — output bytes are
-    identical either way, the formats differ only in speed."""
+                  plan: ColumnarPlan | None = None, attempt: int = 0,
+                  retain: bool = False) -> None:
+    """Child body: merge arriving bucket payload frames under a byte
+    budget, spill sorted runs past it, k-way-merge runs into one output
+    file per owned bucket. A bucket receiving only plane payloads stays
+    columnar end to end (exact-byte metered, columnar spill runs,
+    vectorized merge, ``.cols`` output); the first tuple payload for a
+    bucket degrades THAT bucket to the tuple dict path — output bytes are
+    identical either way, the formats differ only in speed.
+
+    Fault tolerance (ISSUE 14): frames dedupe by their ``(part, slot,
+    seq)`` identity (mapper replays and speculative clones ship
+    byte-identical duplicates); the loop ends when the unique-frame count
+    reaches the ``eof`` total the driver computed from winning
+    ``slice-done`` reports. In retain mode frames arrive by SWEEPING the
+    retained ``ret-*`` files (``in_q`` then carries only the driver's
+    ``eof``); in legacy mode (retries=0) they stream through
+    shm-arena/queue transport as before. A respawned attempt first
+    discards the dead attempt's spill runs and partial out files — their
+    merge provenance is unknown — then rebuilds purely from retained
+    files; it never touches the dead consumer's queue (whose pipe a
+    SIGKILL mid-``recv`` can leave torn). ``ctl_q`` is this attempt's
+    private notify channel to the driver."""
     os.environ["DLS_NATIVE_THREADS"] = "1"
     shms: dict[int, shared_memory.SharedMemory] = {}
     # keyed mode: bucket -> {key: [kb, acc]} (tuple) | [_Planes] (cols);
@@ -1049,26 +1298,25 @@ def _reducer_loop(rid: int, M: int, R: int, n_out: int, spec: _Spec | None,
     cols_bytes: dict[int, int] = {}     # bucket -> exact resident plane B
     runs: dict[int, list] = {}          # bucket -> [(fmt, path)]
     meter = _ByteMeter()
-    done = set()
+    seen: set = set()                   # merged frame ids (part, slot, seq)
+    expected = [None]                   # unique-frame total, from "eof"
+    fault_at = None
     stats = {"spills": 0, "spill_bytes": 0, "bucket_rows": {}, "merge_s": 0.0,
              "cols_buckets": 0, "tuple_buckets": 0}
 
     def notify(msg) -> None:
         try:
-            ctrl_q.put(msg, timeout=_POLL_S)
+            ctl_q.put(msg, timeout=_POLL_S)
         except queue_lib.Full:
             pass
 
-    def payload_of(rec) -> bytes:
-        kind, mid = rec[0], rec[1]
-        if kind == "pkl":
-            return rec[3]
-        _, _, _bucket, alloc_id, off, size = rec
-        if mid not in shms:
-            shms[mid] = shared_memory.SharedMemory(name=shm_names[mid])
-        data = bytes(shms[mid].buf[off:off + size])
+    def arena_bytes(wid: int, alloc_id: int, off: int, size: int) -> bytes:
+        if wid not in shms:
+            shms[wid] = shared_memory.SharedMemory(
+                name=f"{shm_prefix}-m{wid}")
+        data = bytes(shms[wid].buf[off:off + size])
         try:  # copy taken — release the mapper's arena slot immediately
-            free_qs[mid].put_nowait(alloc_id)
+            free_qs[wid].put_nowait(alloc_id)
         except Exception:  # noqa: BLE001 — mapper may be gone at teardown
             pass
         return data
@@ -1209,61 +1457,147 @@ def _reducer_loop(rid: int, M: int, R: int, n_out: int, spec: _Spec | None,
         stats["bucket_rows"][bucket] = rows
         stats["merge_s"] += time.perf_counter() - t0
 
+    def ingest(bucket: int, payload: bytes) -> None:
+        """Merge one deduplicated frame payload into its bucket store."""
+        items = pickle.loads(payload)
+        if sort_spec is not None:
+            lst = stores.setdefault(bucket, [])
+            lst.extend(items)
+            for e in items:
+                meter.add(e[3], 64)
+        elif (isinstance(items, tuple) and items
+              and items[0] == "cols"):
+            pl = _Planes.from_payload(items)
+            mode = modes.get(bucket)
+            if mode is None:
+                modes[bucket] = "cols"
+                sigs[bucket] = pl.dtype_sig()
+                stores[bucket] = [pl]
+                cols_bytes[bucket] = pl.nbytes
+            elif mode == "cols":
+                if pl.dtype_sig() != sigs[bucket]:
+                    # two mappers pinned different scalar types for
+                    # keys landing here — concatenation would promote
+                    # (wrong bytes); the tuple path merges them right
+                    degrade(bucket)
+                    merge_entries(bucket,
+                                  plan.entries_from_planes(pl))
+                else:
+                    stores.setdefault(bucket, []).append(pl)
+                    cols_bytes[bucket] = (cols_bytes.get(bucket, 0)
+                                          + pl.nbytes)
+            else:
+                merge_entries(bucket, plan.entries_from_planes(pl))
+        else:
+            if modes.get(bucket) == "cols":
+                degrade(bucket)
+            modes.setdefault(bucket, "tuple")
+            merge_entries(bucket, items)
+        while resident() >= cap_bytes and stores:
+            spill_largest()
+        if fault_at is not None and len(seen) >= fault_at:
+            faults.crash()
+
+    def handle_rec(rec) -> None:
+        kind = rec[0]
+        if kind == "eof":
+            expected[0] = rec[1]
+            return
+        if kind == "shm":
+            _, wid, bucket, aid, off, size, hdr = rec
+            # read + free BEFORE the dedup check: a duplicate's arena slot
+            # must still be released or speculation would leak the ring
+            data = arena_bytes(wid, aid, off, size)
+            if hdr in seen:
+                return
+            seen.add(hdr)
+            ingest(bucket, data)
+        elif kind == "pkl":
+            _, wid, bucket, payload, hdr = rec
+            if hdr in seen:
+                return
+            seen.add(hdr)
+            ingest(bucket, payload)
+
+    swept: set = set()  # filenames already handled (or never relevant)
+    my_ret_dir = _retain_dir(spill_dir, rid)
+
+    def sweep_retained() -> bool:
+        """Merge retained frames not yet seen — the retain-mode data path
+        (every attempt, not just respawns). Only THIS reducer's retention
+        subdir is listed, and handled (or never-relevant: in-flight
+        ``.tmp``s — a tmp becomes visible under its FINAL name) filenames
+        memoize into ``swept``, so each sweep parses only new arrivals.
+        Retention writes are atomic renames, so any listed file is
+        whole; merge order needs no sort — dedup is identity-keyed and
+        the final output order is canonicalized by the bucket merge."""
+        progressed = False
+        for fname in os.listdir(my_ret_dir):
+            if fname in swept:
+                continue
+            parsed = _parse_retained(fname)
+            if parsed is None:
+                swept.add(fname)
+                continue
+            bucket, hdr = parsed
+            if bucket % R != rid or hdr in seen:
+                swept.add(fname)
+                continue
+            try:
+                with open(os.path.join(my_ret_dir, fname), "rb") as f:
+                    data = f.read()
+            except OSError:  # pragma: no cover - teardown race
+                continue
+            swept.add(fname)
+            seen.add(hdr)
+            ingest(bucket, data)
+            progressed = True
+        return progressed
+
     try:
-        while len(done) < M:
+        # inside the forwarding try: a malformed spec must surface as this
+        # child's typed traceback, not an inscrutable nonzero-exit "death"
+        fault_at = faults.shuffle_fault("reducer", rid, attempt)
+        if attempt > 0:
+            # rebuild from scratch: the dead attempt's spill runs and any
+            # partial out files merged an unknown subset of frames
+            for fname in os.listdir(spill_dir):
+                if fname.startswith(f"r{rid}-b"):
+                    try:
+                        os.remove(os.path.join(spill_dir, fname))
+                    except OSError:
+                        pass
+            for b in range(rid, n_out, R):
+                for p in (out_path(spill_dir, b), cols_out_path(spill_dir, b)):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+        while expected[0] is None or len(seen) < expected[0]:
             if stop_evt.is_set():
                 return
-            try:
-                rec = in_q.get(timeout=_POLL_S)
-            except queue_lib.Empty:
-                continue
-            if rec[0] == "done":
-                done.add(rec[1])
-                continue
-            bucket = rec[2]
-            items = pickle.loads(payload_of(rec))
-            if sort_spec is not None:
-                lst = stores.setdefault(bucket, [])
-                lst.extend(items)
-                for e in items:
-                    meter.add(e[3], 64)
-            elif (isinstance(items, tuple) and items
-                  and items[0] == "cols"):
-                pl = _Planes.from_payload(items)
-                mode = modes.get(bucket)
-                if mode is None:
-                    modes[bucket] = "cols"
-                    sigs[bucket] = pl.dtype_sig()
-                    stores[bucket] = [pl]
-                    cols_bytes[bucket] = pl.nbytes
-                elif mode == "cols":
-                    if pl.dtype_sig() != sigs[bucket]:
-                        # two mappers pinned different scalar types for
-                        # keys landing here — concatenation would promote
-                        # (wrong bytes); the tuple path merges them right
-                        degrade(bucket)
-                        merge_entries(bucket,
-                                      plan.entries_from_planes(pl))
-                    else:
-                        stores.setdefault(bucket, []).append(pl)
-                        cols_bytes[bucket] = (cols_bytes.get(bucket, 0)
-                                              + pl.nbytes)
-                else:
-                    merge_entries(bucket, plan.entries_from_planes(pl))
+            if retain:
+                progressed = sweep_retained()
+                try:
+                    handle_rec(in_q.get_nowait())  # driver "eof" only
+                    progressed = True
+                except queue_lib.Empty:
+                    pass
+                if not progressed:
+                    time.sleep(0.05)
             else:
-                if modes.get(bucket) == "cols":
-                    degrade(bucket)
-                modes.setdefault(bucket, "tuple")
-                merge_entries(bucket, items)
-            while resident() >= cap_bytes and stores:
-                spill_largest()
+                try:
+                    handle_rec(in_q.get(timeout=_POLL_S))
+                except queue_lib.Empty:
+                    pass
         for bucket in range(rid, n_out, R):
             if stop_evt.is_set():
                 return
             merge_bucket(bucket)
         notify(("reducer-done", rid, stats))
     except BaseException:  # noqa: BLE001
-        notify(("err", ("reducer", rid), traceback.format_exc()))
+        notify(("err", ("reducer", rid, attempt),
+                _clip_tb(traceback.format_exc())))
     finally:
         for s in shms.values():
             try:
@@ -1351,11 +1685,13 @@ def run_exchange(parts: Sequence[Callable[[], Any]], *, num_workers: int,
                  mem_mb: float | None = None,
                  plan: ColumnarPlan | None = None) -> ShuffleResult:
     """Execute one shuffle: spawn mappers + reducers, stream the exchange,
-    return the per-bucket output. Raises :class:`WorkerCrashed` (cleaning
-    up every child, shm segment, and spill file) when any child raises or
-    dies. With a :class:`ColumnarPlan`, conforming batches ship as flat
-    planes (see the module docstring) — output is byte-identical either
-    way."""
+    return the per-bucket output. Task failures self-heal (lineage retry,
+    speculation, blacklisting — module docstring, ISSUE 14) under the
+    ``DLS_SHUFFLE_MAX_RETRIES`` budget; past it — or with the budget set
+    to 0 — raises the typed :class:`WorkerCrashed` (cleaning up every
+    child, shm segment, and spill file) exactly as before. With a
+    :class:`ColumnarPlan`, conforming batches ship as flat planes (see
+    the module docstring) — output is byte-identical either way."""
     P = len(parts)
     M = max(1, int(num_workers))
     R = max(1, min(M, n_out))
@@ -1363,94 +1699,393 @@ def run_exchange(parts: Sequence[Callable[[], Any]], *, num_workers: int,
     arena_bytes = max(_MIN_ARENA, budget // (4 * M))
     map_cap = max(_MIN_CAP, budget // (4 * M))
     red_cap = max(_MIN_CAP, budget // (2 * R))
+    retries_left = max_shuffle_retries()
+    retries_budget = retries_left
+    retain = retries_left > 0
+    strikes_k = blacklist_after()
+    spec_factor = speculate_factor()
+    # validate any declared shuffle fault HERE, driver-side: a typo'd
+    # drill must fail loudly before a single child spawns, not burn the
+    # retry budget on children that die at startup and get misread as
+    # OOM kills (the children re-check inside their forwarding try)
+    faults.shuffle_fault("mapper", 0, 0)
     base = os.environ.get(SPILL_DIR_ENV) or None
     if base:
         os.makedirs(base, exist_ok=True)
     spill_dir = tempfile.mkdtemp(prefix="dlsx-", dir=base)
+    if retain:  # per-reducer retention subdirs, created before any child
+        for r in range(R):
+            os.makedirs(_retain_dir(spill_dir, r))
     ctx = mp.get_context("fork")
     stop = ctx.Event()
-    ctrl_q = ctx.Queue()
-    out_qs = [ctx.Queue(maxsize=_QUEUE_AHEAD) for _ in range(R)]
-    free_qs = [ctx.Queue() for _ in range(M)]
-    shms = [shared_memory.SharedMemory(
-        create=True, size=arena_bytes,
-        name=f"dlsx-{os.getpid()}-{uuid.uuid4().hex[:8]}-m{m}")
-        for m in range(M)]
-    shm_names = [s.name for s in shms]
-    assign = _assignments(P, M)
-    mappers = [ctx.Process(
-        target=_mapper_loop, daemon=True, name=f"dlsx-map-{m}",
-        args=(m, list(parts), assign[m], spec, n_out, shms[m], out_qs,
-              free_qs[m], ctrl_q, stop, map_cap, sort_route, plan))
-        for m in range(M)]
-    reducers = [ctx.Process(
-        target=_reducer_loop, daemon=True, name=f"dlsx-red-{r}",
-        args=(r, M, R, n_out, spec, out_qs[r], free_qs, shm_names, ctrl_q,
-              stop, red_cap, spill_dir, sort_spec, plan))
-        for r in range(R)]
-    procs = mappers + reducers
-    with warnings.catch_warnings():
-        # children run pure numpy/pickle, never JAX — same rationale as
-        # WorkerPool's fork-under-JAX warning filter
-        warnings.filterwarnings(
-            "ignore", message=r".*os\.fork\(\) was called.*",
-            category=RuntimeWarning)
-        for p in procs:
-            p.start()
+    task_q = ctx.Queue()
+    all_done_evt = ctx.Event()
+    # the shm-arena data plane exists only in legacy mode; retain mode
+    # ships frames through the filesystem, so its exchanges carry no data
+    # queues, free queues, or arenas at all
+    out_qs = ([] if retain
+              else [ctx.Queue(maxsize=_QUEUE_AHEAD) for _ in range(R)])
+    free_qs = [] if retain else [ctx.Queue() for _ in range(M)]
+    #: the canonical slice list — task ids index into it
+    slices = sorted((t for a in _assignments(P, M) for t in a),
+                    key=lambda t: (t[0], t[1]))
+    n_slices = len(slices)
+    done_flags = ctx.RawArray("b", max(1, n_slices))
+    shm_prefix = f"dlsx-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    # LIVE lists, shared with the finalizer: respawned children and their
+    # fresh epoch arenas append here, so interpreter-exit teardown reaps
+    # them too (not just the processes alive at registration time)
+    live_procs: list = []
+    live_shms: list = []
+    attempts: list[dict] = []
+    red_q: list = [None] * R         # newest attempt's control/data queue
+    red_attempt = [0] * R
+
+    def _new_arena(wid: int):
+        s = shared_memory.SharedMemory(
+            create=True, size=arena_bytes, name=f"{shm_prefix}-m{wid}")
+        live_shms.append(s)
+        return s
+
+    def _start(proc) -> None:
+        with warnings.catch_warnings():
+            # children run pure numpy/pickle, never JAX — same rationale
+            # as WorkerPool's fork-under-JAX warning filter
+            warnings.filterwarnings(
+                "ignore", message=r".*os\.fork\(\) was called.*",
+                category=RuntimeWarning)
+            proc.start()
+        live_procs.append(proc)
+
+    def spawn_mapper(wid: int, epoch: int) -> dict:
+        # retain mode moves frames through the filesystem — no arena to
+        # allocate (ship_cap still derives from arena_bytes so frame
+        # boundaries match across modes and attempts); epoch > 0 only
+        # happens in retain mode, so arenas never need versioning
+        shm = None if retain else _new_arena(wid)
+        cancel = ctx.Event()
+        # per-attempt control queue, single producer: a SIGKILL mid-write
+        # can only poison THIS attempt's stream — the shared-queue version
+        # of this deadlocked every surviving producer on the write lock
+        ctl = ctx.Queue()
+        p = ctx.Process(
+            target=_mapper_loop, daemon=True, name=f"dlsx-map-{wid}e{epoch}",
+            args=(wid, epoch, list(parts), spec, n_out, R, shm, arena_bytes,
+                  out_qs, free_qs[wid] if free_qs else None, ctl, task_q,
+                  stop, cancel, all_done_evt, done_flags, map_cap, retain,
+                  spill_dir, sort_route, plan))
+        _start(p)
+        att = {"role": "mapper", "wid": wid, "epoch": epoch, "proc": p,
+               "ctl": ctl, "cancel": cancel, "finished": False}
+        attempts.append(att)
+        return att
+
+    def spawn_reducer(rid: int, attempt: int) -> dict:
+        if retain:
+            # retain mode: data arrives by sweeping retained files; this
+            # queue carries ONLY the driver's "eof" (driver is the sole
+            # producer). A replacement never touches the dead consumer's
+            # queue — a SIGKILL mid-recv can leave its pipe torn.
+            in_q = ctx.Queue()
+        else:
+            in_q = out_qs[rid]
+        ctl = ctx.Queue()
+        red_q[rid] = in_q
+        p = ctx.Process(
+            target=_reducer_loop, daemon=True,
+            name=f"dlsx-red-{rid}a{attempt}",
+            args=(rid, R, n_out, spec, in_q, free_qs, shm_prefix, ctl,
+                  stop, red_cap, spill_dir, sort_spec, plan, attempt,
+                  retain))
+        _start(p)
+        att = {"role": "reducer", "wid": rid, "epoch": attempt, "proc": p,
+               "ctl": ctl, "finished": False}
+        attempts.append(att)
+        return att
+
+    for m in range(M):
+        spawn_mapper(m, 0)
+    for r in range(R):
+        spawn_reducer(r, 0)
+    for i, t in enumerate(slices):
+        task_q.put((i,) + t)
     finalizer = weakref.finalize(
-        run_exchange, _exchange_cleanup, stop, list(procs), list(shms))
+        run_exchange, _exchange_cleanup, stop, live_procs, live_shms)
 
     t_start = time.perf_counter()
-    map_done: dict[int, dict] = {}
+    sl_done = [False] * n_slices
+    sl_counts: list = [None] * n_slices   # winning per-reducer frame counts
+    sl_stats: list = [None] * n_slices    # winning per-slice input stats
+    sl_running: dict[int, dict] = {}      # slice -> {(wid, epoch): t0}
+    sl_speculated = [False] * n_slices
+    sl_durations: list[float] = []
+    n_done = 0
+    strikes: dict[tuple, int] = {}
+    blacklisted: set[tuple] = set()
+    recovery = {"retries": 0, "mapper_retries": 0, "reducer_retries": 0,
+                "speculations": 0, "blacklists": 0}
+    map_stats: list[dict] = []
     red_done: dict[int, dict] = {}
     spills = 0
     spill_bytes = 0
     map_end: float | None = None
+    eof_totals: list | None = None
+    pending_eof: dict[int, tuple] = {}    # rid -> (queue, total)
+    last_progress = t_start
+
+    def _active_mappers() -> list:
+        return [a for a in attempts if a["role"] == "mapper"
+                and not a["finished"] and a["proc"].is_alive()]
+
+    def _find_attempt(role: str, wid: int, epoch: int) -> dict | None:
+        for a in attempts:
+            if (a["role"], a["wid"], a["epoch"]) == (role, wid, epoch):
+                return a
+        return None
+
+    def charge_retry(role: str, wid: int, reason: str, *,
+                     slice_idx: int | None = None,
+                     exitcode: int | None = None) -> None:
+        """Burn one unit of the retry budget, or escalate — the typed
+        WorkerCrashed of the fail-fast days — when it is spent."""
+        nonlocal retries_left
+        if retries_left <= 0:
+            suffix = ("" if retries_budget == 0 else
+                      f" [retry budget {MAX_RETRIES_ENV}="
+                      f"{retries_budget} exhausted]")
+            raise WorkerCrashed(f"shuffle {role} {wid} {reason}{suffix}",
+                                worker=wid, exitcode=exitcode)
+        retries_left -= 1
+        recovery["retries"] += 1
+        recovery[f"{role}_retries"] += 1
+        telemetry.emit(
+            "shuffle", edge="retry", op=label, role=role, worker=wid,
+            reason=("died" if exitcode is not None else "raised"),
+            exitcode=exitcode, slice=slice_idx, retries_left=retries_left)
+
+    def strike(role: str, wid: int) -> bool:
+        """Score one failure; True when the slot just got blacklisted."""
+        k = strikes[(role, wid)] = strikes.get((role, wid), 0) + 1
+        if k >= strikes_k and (role, wid) not in blacklisted:
+            blacklisted.add((role, wid))
+            recovery["blacklists"] += 1
+            telemetry.emit("shuffle", edge="blacklist", op=label,
+                           role=role, worker=wid, strikes=k)
+            return True
+        return False
+
+    def clear_runners(wid: int, epoch: int) -> None:
+        """Deregister a failed attempt's running slices — enqueueing is
+        reseed_unclaimed's job, the SINGLE re-enqueue point, so one
+        failure adds at most one copy of any slice to the queue."""
+        for runners in sl_running.values():
+            runners.pop((wid, epoch), None)
+
+    def reseed_unclaimed() -> None:
+        """Repair task custody after a worker failure: the slices the
+        failed attempt was running, plus any task it POPPED before its
+        slice-start landed (gone from the queue with no runner
+        registered). Re-enqueue every undone slice with no runner — a
+        duplicate of a task still sitting unclaimed in the queue is
+        harmless (done_flags skip + frame dedup) and bounded at one copy
+        per failure; this never runs on the hot path."""
+        for si in range(n_slices):
+            if not sl_done[si] and not sl_running.get(si):
+                task_q.put((si,) + slices[si])
+
+    def assert_mappers_remain(wid: int, reason: str,
+                              exitcode=None) -> None:
+        if not _active_mappers() and n_done < n_slices:
+            raise WorkerCrashed(
+                f"shuffle mapper {wid} blacklisted after "
+                f"{strikes[('mapper', wid)]} failures and no usable "
+                f"mappers remain ({n_slices - n_done} slices unfinished); "
+                f"last failure: {reason}", worker=wid, exitcode=exitcode)
+
+    def on_mapper_failure(wid: int, epoch: int, reason: str, *,
+                          exitcode=None, slice_idx=None) -> None:
+        charge_retry("mapper", wid, reason, exitcode=exitcode,
+                     slice_idx=slice_idx)
+        crossed = strike("mapper", wid)
+        clear_runners(wid, epoch)
+        if crossed:
+            for a in attempts:   # a blacklisted slot stops taking work
+                if (a["role"] == "mapper" and a["wid"] == wid
+                        and not a["finished"]):
+                    a["cancel"].set()
+                    a["finished"] = True
+            assert_mappers_remain(wid, reason, exitcode)
+        else:
+            att = _find_attempt("mapper", wid, epoch)
+            if exitcode is not None or (att is not None and att["finished"]):
+                # the process is gone (death, or infra-err exit): respawn
+                # a replacement attempt — it runs the retained-file
+                # transport, so no transport state needs recreating
+                spawn_mapper(wid, epoch + 1)
+        reseed_unclaimed()
+
+    def on_reducer_failure(rid: int, attempt: int, reason: str, *,
+                           exitcode=None) -> None:
+        if not retain:  # pragma: no cover - retain is False only when
+            # retries are 0, and charge_retry escalates first
+            raise WorkerCrashed(f"shuffle reducer {rid} {reason}",
+                                worker=rid, exitcode=exitcode)
+        charge_retry("reducer", rid, reason, exitcode=exitcode)
+        if strike("reducer", rid):
+            raise WorkerCrashed(
+                f"shuffle reducer {rid} blacklisted after "
+                f"{strikes[('reducer', rid)]} failures — its buckets "
+                f"cannot move to another slot; last failure: {reason}",
+                worker=rid, exitcode=exitcode)
+        red_attempt[rid] += 1
+        spawn_reducer(rid, red_attempt[rid])
+        if eof_totals is not None:
+            pending_eof[rid] = (red_q[rid], eof_totals[rid])
+
+    def finish_map(now: float) -> None:
+        nonlocal map_end, eof_totals
+        map_end = now
+        telemetry.emit("phase", name="shuffle-map", edge="end",
+                       dur_s=map_end - t_start, op=label)
+        telemetry.emit("phase", name="shuffle-merge", edge="begin",
+                       op=label)
+        all_done_evt.set()
+        eof_totals = [sum(c[r] for c in sl_counts if c is not None)
+                      for r in range(R)]
+        for r in range(R):
+            pending_eof[r] = (red_q[r], eof_totals[r])
+
+    def maybe_speculate(now: float) -> None:
+        if (spec_factor <= 0 or not sl_durations or n_done >= n_slices
+                or len(_active_mappers()) < 2):
+            return
+        med = sorted(sl_durations)[len(sl_durations) // 2]
+        lag = max(_SPECULATE_FLOOR_S, spec_factor * med)
+        for si, runners in sl_running.items():
+            if sl_done[si] or sl_speculated[si] or not runners:
+                continue
+            started = min(runners.values())
+            if now - started > lag:
+                sl_speculated[si] = True
+                recovery["speculations"] += 1
+                telemetry.emit(
+                    "shuffle", edge="speculate", op=label, slice=si,
+                    part=slices[si][0], slot=slices[si][1],
+                    elapsed_s=round(now - started, 3),
+                    median_s=round(med, 3))
+                task_q.put((si,) + slices[si])
+
+    def maybe_reseed(now: float) -> None:
+        """Last-resort custody net: failure handlers already call
+        reseed_unclaimed() for every known loss path; this long-window
+        sweep only exists so an UNFORESEEN loss degrades into one
+        duplicate round per _RESEED_S instead of a silent hang."""
+        nonlocal last_progress
+        if n_done >= n_slices or now - last_progress < _RESEED_S:
+            return
+        last_progress = now
+        reseed_unclaimed()
+
     telemetry.emit("phase", name="shuffle-map", edge="begin", op=label)
     try:
-        # wait for BOTH roles: a reducer can observe the out_q "done"
-        # sentinels and finish before the mapper's ctrl "mapper-done"
-        # lands (two queues, two feeder threads — no cross-queue order);
-        # exiting on reducers alone would drop that mapper's stats and
-        # leave the shuffle-map phase open
-        while len(red_done) < R or len(map_done) < M:
-            try:
-                msg = ctrl_q.get(timeout=_POLL_S)
-            except queue_lib.Empty:
-                for i, p in enumerate(procs):
-                    is_map = i < M
-                    wid = i if is_map else i - M
-                    finished = (wid in map_done) if is_map else (wid in red_done)
-                    if not finished and not p.is_alive():
-                        # drain race: its last message may be in flight
-                        try:
-                            msg = ctrl_q.get(timeout=_POLL_S)
-                            break
-                        except queue_lib.Empty:
-                            pass
-                        role = "mapper" if is_map else "reducer"
-                        raise WorkerCrashed(
-                            f"shuffle {role} {wid} died (exit code "
-                            f"{p.exitcode}) mid-exchange — killed (OOM/"
-                            f"SIGKILL) or crashed in native code",
-                            worker=wid, exitcode=p.exitcode)
-                else:
+        if n_slices == 0 and map_end is None:
+            finish_map(time.perf_counter())
+        while n_done < n_slices or len(red_done) < R:
+            now = time.perf_counter()
+            for rid in list(pending_eof):
+                q, total = pending_eof[rid]
+                try:
+                    q.put_nowait(("eof", total))
+                    del pending_eof[rid]
+                except queue_lib.Full:
+                    pass
+            msg = None
+            for att in attempts:
+                if att["finished"]:
                     continue
+                try:
+                    msg = att["ctl"].get_nowait()
+                    break
+                except queue_lib.Empty:
+                    continue
+            if msg is None:
+                dead = None
+                for att in attempts:
+                    if att["finished"] or att["proc"].is_alive():
+                        continue
+                    # drain race: its last message may still be in flight
+                    try:
+                        msg = att["ctl"].get(timeout=_POLL_S)
+                    except queue_lib.Empty:
+                        dead = att
+                    break
+                if dead is not None:
+                    dead["finished"] = True
+                    rc = dead["proc"].exitcode
+                    rc = -1 if rc is None else rc
+                    reason = (f"died (exit code {rc}) mid-exchange — "
+                              f"killed (OOM/SIGKILL) or crashed in "
+                              f"native code")
+                    if dead["role"] == "mapper":
+                        if n_done < n_slices:
+                            on_mapper_failure(dead["wid"], dead["epoch"],
+                                              reason, exitcode=rc)
+                        # else: a straggler (speculation loser) dying
+                        # after every slice completed costs nothing
+                    else:
+                        on_reducer_failure(dead["wid"], dead["epoch"],
+                                           reason, exitcode=rc)
+                    continue
+                if msg is None:
+                    maybe_speculate(now)
+                    maybe_reseed(now)
+                    time.sleep(0.02)
+                    continue
+            last_progress = now
             kind = msg[0]
-            if kind == "err":
-                role, wid = msg[1]
-                raise WorkerCrashed(
-                    f"shuffle {role} {wid} raised:\n{msg[2]}", worker=wid)
-            if kind == "mapper-done":
-                map_done[msg[1]] = msg[2]
-                if len(map_done) == M and map_end is None:
-                    map_end = time.perf_counter()
-                    telemetry.emit("phase", name="shuffle-map", edge="end",
-                                   dur_s=map_end - t_start, op=label)
-                    telemetry.emit("phase", name="shuffle-merge",
-                                   edge="begin", op=label)
+            if kind == "slice-start":
+                _, wid, ep, si = msg
+                sl_running.setdefault(si, {})[(wid, ep)] = now
+            elif kind == "slice-done":
+                _, wid, ep, si, counts, sl = msg
+                started = sl_running.get(si, {}).pop((wid, ep), None)
+                if not sl_done[si]:
+                    sl_done[si] = True
+                    done_flags[si] = 1
+                    n_done += 1
+                    sl_counts[si] = counts
+                    sl_stats[si] = sl
+                    if started is not None:
+                        sl_durations.append(now - started)
+                    if n_done == n_slices:
+                        finish_map(now)
+            elif kind == "slice-err":
+                _, wid, ep, si, tb = msg
+                sl_running.get(si, {}).pop((wid, ep), None)
+                if not sl_done[si]:
+                    # the trailing reseed_unclaimed re-enqueues the slice
+                    on_mapper_failure(wid, ep, f"raised:\n{tb}",
+                                      slice_idx=si)
+            elif kind == "err":
+                role, wid, ep = msg[1]
+                att = _find_attempt(role, wid, ep)
+                if att is not None:
+                    att["finished"] = True
+                if role == "mapper":
+                    on_mapper_failure(wid, ep, f"raised:\n{msg[2]}")
+                else:
+                    on_reducer_failure(wid, ep, f"raised:\n{msg[2]}")
+            elif kind == "mapper-done":
+                _, wid, ep, st = msg
+                att = _find_attempt("mapper", wid, ep)
+                if att is not None:
+                    att["finished"] = True
+                map_stats.append(st)
             elif kind == "reducer-done":
                 red_done[msg[1]] = msg[2]
+                for a in attempts:
+                    if a["role"] == "reducer" and a["wid"] == msg[1]:
+                        a["finished"] = True
             elif kind == "spill":
                 spills += 1
                 spill_bytes += msg[4]
@@ -1469,21 +2104,56 @@ def run_exchange(parts: Sequence[Callable[[], Any]], *, num_workers: int,
         telemetry.emit(
             "phase", edge="end", op=label, aborted=True,
             name="shuffle-map" if map_end is None else "shuffle-merge")
-        _exchange_cleanup(stop, procs, shms)
+        _exchange_cleanup(stop, live_procs, live_shms)
         finalizer.detach()
         _rm_dir(spill_dir)
         raise
+
+    # reducers are done; give clean-exiting mappers a beat to land their
+    # stats, then cancel stragglers (speculation losers still grinding a
+    # slice someone else already won)
+    grace = time.time() + 2.0
+    while (any(a["role"] == "mapper" and not a["finished"]
+               for a in attempts) and time.time() < grace):
+        progressed = False
+        for a in attempts:
+            if a["role"] != "mapper" or a["finished"]:
+                continue
+            try:
+                msg = a["ctl"].get_nowait()
+            except queue_lib.Empty:
+                if not a["proc"].is_alive():
+                    a["finished"] = True
+                continue
+            if msg[0] == "mapper-done":
+                a["finished"] = True
+                map_stats.append(msg[3])
+                progressed = True
+        if not progressed:
+            time.sleep(0.05)
+    for a in attempts:
+        if a["role"] == "mapper" and not a["finished"]:
+            a["cancel"].set()
+            a["finished"] = True
     finalizer.detach()
-    _exchange_cleanup(stop, procs, shms)
+    _exchange_cleanup(stop, live_procs, live_shms)
+    if retain:  # retained frames served their purpose; the result dir
+        for r in range(R):   # keeps only bucket output
+            shutil.rmtree(_retain_dir(spill_dir, r), ignore_errors=True)
 
     bucket_rows: dict[int, int] = {}
     for st in red_done.values():
         bucket_rows.update(st["bucket_rows"])
     rows_list = [bucket_rows.get(b, 0) for b in range(n_out)]
-    pairs_in = sum(st["pairs"] for st in map_done.values())
-    bytes_moved = sum(st["bytes_moved"] for st in map_done.values())
-    cols_pairs = sum(st.get("cols_pairs", 0) for st in map_done.values())
-    cols_bytes = sum(st.get("cols_bytes", 0) for st in map_done.values())
+    # input-side totals come from the WINNING slice reports, so they are
+    # deterministic across retries and speculation (a replayed slice's
+    # numbers count once, no matter how many attempts ran it); transport-
+    # dependent counters (overflow) still sum over every attempt
+    win = [s for s in sl_stats if s is not None]
+    pairs_in = sum(s["pairs"] for s in win)
+    bytes_moved = sum(s["bytes"] for s in win)
+    cols_pairs = sum(s["cols_pairs"] for s in win)
+    cols_bytes = sum(s["cols_bytes"] for s in win)
     transport = ("tuple" if plan is None or cols_pairs == 0
                  else ("columnar" if cols_pairs == pairs_in else "mixed"))
     stats = {
@@ -1491,11 +2161,11 @@ def run_exchange(parts: Sequence[Callable[[], Any]], *, num_workers: int,
         "workers": M,
         "reducers": R,
         "buckets": n_out,
-        "elems_in": sum(st["elems"] for st in map_done.values()),
+        "elems_in": sum(s["elems"] for s in win),
         "pairs_in": pairs_in,
         "rows_out": sum(rows_list),
         "bytes_moved": bytes_moved,
-        "overflow": sum(st["overflow"] for st in map_done.values()),
+        "overflow": sum(st["overflow"] for st in map_stats),
         "spills": spills,
         "spill_bytes": spill_bytes,
         "map_s": round((map_end or t_start) - t_start, 3),
@@ -1514,6 +2184,12 @@ def run_exchange(parts: Sequence[Callable[[], Any]], *, num_workers: int,
             st.get("cols_buckets", 0) for st in red_done.values()),
         "tuple_buckets": sum(
             st.get("tuple_buckets", 0) for st in red_done.values()),
+        # recovery rollup (ISSUE 14): what self-healing cost this run
+        "retries": recovery["retries"],
+        "mapper_retries": recovery["mapper_retries"],
+        "reducer_retries": recovery["reducer_retries"],
+        "speculations": recovery["speculations"],
+        "blacklists": recovery["blacklists"],
     }
     telemetry.emit("shuffle", edge="done", **stats)
     return ShuffleResult(spill_dir, n_out, stats, keep_dir=False, plan=plan)

@@ -30,18 +30,28 @@ stack depends on:
   ``DLS_DATA_WORKER_RING_MB``) the consumer adaptively copies-and-releases
   so the worker never stalls. Backpressure is the arena plus a bounded
   metadata queue: a slow consumer parks the workers, memory stays capped.
-- **Crash propagation: a dead worker is never a silent stall.** A worker
-  that raises forwards its traceback; a worker that *dies* (OOM-kill,
-  segfault) is detected by liveness polling. Either way the consumer
-  raises a typed :class:`WorkerCrashed` within a bounded wait — the PR 1
-  supervisor then classifies the run as a training CRASH (nonzero exit
-  with the error on stderr), not a hang, because the exception propagates
-  out of ``Trainer.fit`` like any other training error. A worker that is
-  alive but *stuck* (``fn`` blocked on dead NFS, a lock taken pre-fork) is
-  indistinguishable from a slow map and is deliberately NOT timed out —
-  any per-example deadline would misfire on legitimately slow work; it
-  surfaces instead through the per-worker utilization gauges and the
-  supervisor's own hang detection, whose job that is.
+- **Crash recovery: a dead worker respawns; a raising one propagates.**
+  A worker that *dies* (OOM-kill, segfault) is detected by liveness
+  polling and — within the ``DLS_DATA_WORKER_MAX_RETRIES`` budget
+  (default 2, ISSUE 14) — replaced in place: a fresh process takes over
+  the same residue class with a fresh arena and queues (the dead
+  consumer pipe may hold a frame its feeder tore mid-write), fast-
+  forwarded past the examples already delivered, so ordered
+  byte-identical delivery resumes exactly where the stream left off (the
+  determinism contract above is what makes the replay safe — lost
+  in-flight examples regenerate bit-equal). Each respawn emits a
+  ``recovery`` telemetry event. Past the budget — or when a worker
+  *raises* (user decode code, deterministic on this input; a retry would
+  just raise again) — the consumer raises a typed :class:`WorkerCrashed`
+  within a bounded wait, and the PR 1 supervisor classifies the run as a
+  training CRASH (nonzero exit with the error on stderr), not a hang,
+  because the exception propagates out of ``Trainer.fit`` like any other
+  training error. A worker that is alive but *stuck* (``fn`` blocked on
+  dead NFS, a lock taken pre-fork) is indistinguishable from a slow map
+  and is deliberately NOT timed out — any per-example deadline would
+  misfire on legitimately slow work; it surfaces instead through the
+  per-worker utilization gauges and the supervisor's own hang detection,
+  whose job that is.
 
 Workers are started with the ``fork`` start method: the map ``fn`` and the
 source partition are ordinary closures (lambdas over tokenizers, transform
@@ -84,12 +94,17 @@ from typing import Any, Callable, Iterable, Iterator
 
 import numpy as np
 
+from distributeddeeplearningspark_tpu import telemetry
 from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
 
 #: env knob: default worker count when ``num_workers=None`` (0 = in-process).
 WORKERS_ENV = "DLS_DATA_WORKERS"
 #: env knob: shared-memory ring size per worker, in MB.
 RING_MB_ENV = "DLS_DATA_WORKER_RING_MB"
+#: env knob: how many SIGKILL'd workers one pool may respawn before a
+#: death escalates to the typed WorkerCrashed (0 = today's fail-fast).
+INPUT_RETRIES_ENV = "DLS_DATA_WORKER_MAX_RETRIES"
+_DEFAULT_INPUT_RETRIES = 2
 
 _DEFAULT_RING_MB = 32
 #: metadata-queue bound = max mapped examples in flight per worker beyond
@@ -146,6 +161,27 @@ def _ring_bytes(override: int | None) -> int:
 
 def fork_available() -> bool:
     return "fork" in mp.get_all_start_methods()
+
+
+def env_num(name: str, default, lo=None, cast=int):
+    """The shared env-knob parse contract (used here and by
+    data/exchange.py's retry/blacklist/speculation knobs): empty or
+    malformed values fall back to the default silently — tuning knobs
+    must never crash a run, unlike fault SPECS (faults.parse), where a
+    typo'd drill must fail loudly — and ``lo`` clamps the floor."""
+    try:
+        v = cast(os.environ.get(name, "") or default)
+    except ValueError:
+        v = default
+    return v if lo is None else max(lo, v)
+
+
+def input_worker_retries(explicit: int | None = None) -> int:
+    """The pool's respawn budget: explicit value, else
+    ``DLS_DATA_WORKER_MAX_RETRIES``, else 2."""
+    if explicit is not None:
+        return max(0, int(explicit))
+    return env_num(INPUT_RETRIES_ENV, _DEFAULT_INPUT_RETRIES, lo=0)
 
 
 class WorkerCrashed(RuntimeError):
@@ -263,9 +299,12 @@ def _align(n: int) -> int:
 
 
 def _worker_loop(wid: int, num_workers: int, source_factory, fn,
-                 shm, out_q, free_q, stats, stop_evt) -> None:
+                 shm, out_q, free_q, stats, stop_evt, skip: int = 0) -> None:
     """Child body (fork-inherited state): iterate the source, map this
-    worker's residue class, publish through the ring + metadata queue."""
+    worker's residue class, publish through the ring + metadata queue.
+    ``skip`` fast-forwards a respawned replacement past the first ``skip``
+    class elements the consumer already received — the source walk still
+    happens (cheap, page-cached) but the map and the transport don't."""
     # cap the native kernels' per-call thread fan-out to this one process:
     # N workers each spawning hardware_concurrency threads oversubscribe
     # the host N× (measured 52 → 77 img/s at 4 workers on 2 cores when
@@ -321,10 +360,14 @@ def _worker_loop(wid: int, num_workers: int, source_factory, fn,
                 pass
 
     try:
+        ci = -1  # this worker's class-element ordinal, for skip
         for j, item in enumerate(source_factory()):
             if stop_evt.is_set():
                 return
             if j % num_workers != wid:
+                continue
+            ci += 1
+            if ci < skip:
                 continue
             t0 = time.perf_counter()
             ex = fn(item) if fn is not None else item
@@ -388,7 +431,8 @@ class WorkerPool:
     def __init__(self, source_factory: Callable[[], Iterable[Any]],
                  fn: Callable[[Any], Any] | None, num_workers: int, *,
                  ring_bytes: int | None = None, max_ahead: int | None = None,
-                 copy: bool = False, label: str = ""):
+                 copy: bool = False, label: str = "",
+                 max_retries: int | None = None):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
         if not fork_available():  # pragma: no cover - platform-dependent
@@ -404,19 +448,24 @@ class WorkerPool:
         #: views (one-element lists so release finalizers can decrement)
         self._outstanding = [[0] for _ in range(num_workers)]
         self._closed = False
+        self._source_factory = source_factory
+        self._fn = fn
+        self._respawns_left = input_worker_retries(max_retries)
         ctx = mp.get_context("fork")
         rb = _ring_bytes(ring_bytes)
         self._ring_bytes = rb
-        ahead = max_ahead if max_ahead is not None else _DEFAULT_MAX_AHEAD
+        self._ahead = (max_ahead if max_ahead is not None
+                       else _DEFAULT_MAX_AHEAD)
         self._stats = ctx.RawArray("d", num_workers * _ST_STRIDE)
         self._stop = ctx.Event()
         self._shms = [shared_memory.SharedMemory(
             create=True, size=rb,
             name=f"dlsw-{os.getpid()}-{uuid.uuid4().hex[:8]}-{w}")
             for w in range(num_workers)]
-        self._out_qs = [ctx.Queue(maxsize=max(2, ahead))
+        self._out_qs = [ctx.Queue(maxsize=max(2, self._ahead))
                         for _ in range(num_workers)]
         self._free_qs = [ctx.Queue() for _ in range(num_workers)]
+        self._retired_qs: list = []   # pre-respawn queues, closed at close()
         self._procs = [
             ctx.Process(
                 target=_worker_loop, daemon=True, name=f"dls-worker-{w}",
@@ -435,9 +484,14 @@ class WorkerPool:
                 category=RuntimeWarning)
             for p in self._procs:
                 p.start()
+        # LIVE lists shared with the finalizer: respawned workers and
+        # their fresh arenas append here, so interpreter-exit teardown
+        # reaps them too — not just the children alive at registration
+        self._all_procs = list(self._procs)
+        self._all_shms = list(self._shms)
         self._finalizer = weakref.finalize(
-            self, WorkerPool._cleanup, self._stop, list(self._procs),
-            list(self._shms))
+            self, WorkerPool._cleanup, self._stop, self._all_procs,
+            self._all_shms)
         _LIVE_POOLS.add(self)
 
     # -- consumer side ------------------------------------------------------
@@ -466,21 +520,81 @@ class WorkerPool:
             self.close()
 
     def _next_record(self, w: int):
-        q = self._out_qs[w]
         while True:
+            q = self._out_qs[w]
             try:
                 return q.get(timeout=_POLL_S)
             except queue_lib.Empty:
-                if not self._procs[w].is_alive():
-                    try:  # drain race: the record may have landed meanwhile
-                        return q.get_nowait()
-                    except queue_lib.Empty:
-                        rc = self._procs[w].exitcode
-                        raise WorkerCrashed(
-                            f"input worker {w} died (exit code {rc}) without "
-                            f"reporting an error — killed (OOM/SIGKILL) or "
-                            f"crashed in native code", worker=w,
-                            exitcode=rc) from None
+                if self._procs[w].is_alive():
+                    continue
+            except Exception:  # noqa: BLE001 — a frame the dying feeder
+                # tore mid-write surfacing on the PRIMARY get (unpickle/
+                # EOF error). Survivable only when the producer is dead —
+                # a live worker handing up garbage is a real bug. (A tear
+                # that splits the frame HEADER can still wedge recv
+                # inside this get; that residual window closes only by
+                # never sharing a pipe with a killable producer, which is
+                # the exchange's retained-file design, not the pool's.)
+                if self._procs[w].is_alive():
+                    raise
+            try:  # drain race: a whole record may have landed meanwhile
+                return q.get_nowait()
+            except queue_lib.Empty:
+                pass
+            except Exception:  # noqa: BLE001 — the torn frame again
+                pass
+            rc = self._procs[w].exitcode
+            if self._respawns_left > 0:
+                self._respawn(w, rc)
+                continue
+            raise WorkerCrashed(
+                f"input worker {w} died (exit code {rc}) without "
+                f"reporting an error — killed (OOM/SIGKILL) or "
+                f"crashed in native code (respawn budget "
+                f"{INPUT_RETRIES_ENV} exhausted)", worker=w,
+                exitcode=rc) from None
+
+    def _respawn(self, w: int, exitcode: int | None) -> None:
+        """Replace a dead worker in place (ISSUE 14): fresh arena and
+        queues — the dead worker's pipe may hold a frame its feeder tore
+        mid-write, and in-flight examples regenerate deterministically —
+        same residue class, fast-forwarded past the ``consumed[w]``
+        examples already delivered, so ordered byte-identical delivery
+        resumes exactly where the stream left off."""
+        self._respawns_left -= 1
+        telemetry.emit("recovery", event="input-worker-respawn", worker=w,
+                       exitcode=exitcode, skipped=self._consumed[w],
+                       respawns_left=self._respawns_left,
+                       label=self.label or None)
+        ctx = mp.get_context("fork")
+        shm = shared_memory.SharedMemory(
+            create=True, size=self._ring_bytes,
+            name=f"dlsw-{os.getpid()}-{uuid.uuid4().hex[:8]}-{w}")
+        out_q = ctx.Queue(maxsize=max(2, self._ahead))
+        free_q = ctx.Queue()
+        # rebase the (single-writer, but its writer is dead) produced cell
+        # on what the consumer actually took, so `ahead` stays truthful
+        self._stats[w * _ST_STRIDE + _ST_PRODUCED] = self._consumed[w]
+        self._stats[w * _ST_STRIDE + _ST_RING_USED] = 0
+        p = ctx.Process(
+            target=_worker_loop, daemon=True, name=f"dls-worker-{w}",
+            args=(w, self.n, self._source_factory, self._fn, shm, out_q,
+                  free_q, self._stats, self._stop, self._consumed[w]))
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=r".*os\.fork\(\) was called.*",
+                category=RuntimeWarning)
+            p.start()
+        self._retired_qs.extend((self._out_qs[w], self._free_qs[w]))
+        # old arena stays in _all_shms for unlink at close; views the
+        # consumer still holds keep its pages alive until they die
+        self._shms[w] = shm
+        self._out_qs[w] = out_q
+        self._free_qs[w] = free_q
+        self._outstanding[w] = [0]  # old tokens decrement their own list
+        self._procs[w] = p
+        self._all_procs.append(p)
+        self._all_shms.append(shm)
 
     def _materialize(self, w: int, rec) -> Any:
         if rec[0] == "pkl":
@@ -576,8 +690,8 @@ class WorkerPool:
             return
         self._closed = True
         self._finalizer.detach()
-        WorkerPool._cleanup(self._stop, self._procs, self._shms)
-        for q in (*self._out_qs, *self._free_qs):
+        WorkerPool._cleanup(self._stop, self._all_procs, self._all_shms)
+        for q in (*self._out_qs, *self._free_qs, *self._retired_qs):
             try:
                 q.close()
                 q.cancel_join_thread()

@@ -16,6 +16,20 @@ through every failure mode by the supervisor tests::
                                 # and keep that host dead on every later
                                 # attempt (a dead machine stays dead); the
                                 # victim is DLS_FAULT_HOST (default 1)
+    DLS_FAULT=die_shuffle_worker@N  # SIGKILL a shuffle exchange child
+                                # (data/exchange.py) mid-task: a mapper at
+                                # its Nth processed element, a reducer at
+                                # its Nth merged payload frame. The victim
+                                # is named by DLS_FAULT_SHUFFLE_ROLE
+                                # (mapper|reducer|both, default mapper)
+                                # and DLS_FAULT_SHUFFLE_ID (worker slot,
+                                # default 0); only epoch/attempt 0 faults,
+                                # so the respawned replacement runs clean
+                                # (DLS_FAULT_ALL_ATTEMPTS=1 keeps killing,
+                                # for testing that the retry budget gives
+                                # up). Scoped: faults.get() returns None
+                                # for it — only the exchange children
+                                # consult shuffle_fault().
 
 Determinism rules:
 
@@ -50,7 +64,8 @@ import time
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.faults")
 
-KINDS = ("crash", "hang", "nan", "truncate_ckpt", "die_host")
+KINDS = ("crash", "hang", "nan", "truncate_ckpt", "die_host",
+         "die_shuffle_worker")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,6 +145,10 @@ def get() -> Fault | None:
     if not spec:
         return None
     fault = parse(spec)
+    if fault.kind == "die_shuffle_worker":
+        # shuffle-scoped: the exchange children consult shuffle_fault();
+        # a trainer must never act on it
+        return None
     if fault.kind == "die_host":
         # persists across attempts (a dead host stays dead) unless the
         # drill opts back into the one-shot discipline
@@ -147,6 +166,45 @@ def get() -> Fault | None:
         if jax.process_index() != int(rank):
             return None
     return fault
+
+
+def shuffle_fault(role: str, wid: int, attempt: int) -> int | None:
+    """The element/frame threshold at which THIS shuffle child should
+    SIGKILL itself, or None (the common case). ``role`` is "mapper" or
+    "reducer", ``wid`` the worker slot, ``attempt`` the epoch/attempt
+    ordinal — retries run clean unless ``DLS_FAULT_ALL_ATTEMPTS=1``.
+    Malformed specs raise, same as :func:`parse`: a typo'd drill must
+    fail loudly, not run fault-free and "pass"."""
+    spec = os.environ.get("DLS_FAULT")
+    if not spec:
+        return None
+    fault = parse(spec)
+    if fault.kind != "die_shuffle_worker":
+        return None
+    # validate the WHOLE gating env before any early return: the
+    # exchange driver's pre-spawn check (shuffle_fault("mapper", 0, 0))
+    # must catch a typo in ANY of these vars, not just the ones its
+    # probe arguments happen to route through
+    raw = os.environ.get("DLS_FAULT_SHUFFLE_ROLE", "mapper").strip().lower()
+    roles = (("mapper", "reducer") if raw == "both"
+             else tuple(r.strip() for r in raw.split(",")))
+    for r in roles:
+        if r not in ("mapper", "reducer"):
+            raise ValueError(
+                f"bad DLS_FAULT_SHUFFLE_ROLE {raw!r}: expected "
+                f"mapper|reducer|both (or a comma list)")
+    raw_id = os.environ.get("DLS_FAULT_SHUFFLE_ID", "0")
+    try:
+        victim = int(raw_id)
+    except ValueError:
+        raise ValueError(
+            f"bad DLS_FAULT_SHUFFLE_ID {raw_id!r}: expected a worker slot "
+            f"ordinal (int >= 0)")
+    if attempt > 0 and os.environ.get("DLS_FAULT_ALL_ATTEMPTS") != "1":
+        return None
+    if role not in roles or wid != victim:
+        return None
+    return fault.step
 
 
 # -- the injections ----------------------------------------------------------

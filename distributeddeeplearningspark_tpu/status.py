@@ -177,6 +177,12 @@ def shuffle_from(events: list[dict]) -> dict | None:
             if e.get("kind") == "shuffle" and e.get("edge") == "done"]
     spill_events = sum(e.get("kind") == "shuffle"
                        and e.get("edge") == "spill" for e in events)
+    retry_events = [e for e in events if e.get("kind") == "shuffle"
+                    and e.get("edge") == "retry"]
+    spec_events = sum(e.get("kind") == "shuffle"
+                      and e.get("edge") == "speculate" for e in events)
+    bl_events = sum(e.get("kind") == "shuffle"
+                    and e.get("edge") == "blacklist" for e in events)
     if not done:
         return None
     last = done[-1]
@@ -222,6 +228,17 @@ def shuffle_from(events: list[dict]) -> dict | None:
         "spill_events": spill_events,
         "overflow": _fmt_total("overflow"),
         "formats": formats,
+        # self-healing rollup (ISSUE 14): every retry/speculation/
+        # blacklist decision the exchanges took, folded from their edges
+        "recovery": {
+            "retries": len(retry_events),
+            "mapper_retries": sum(
+                e.get("role") == "mapper" for e in retry_events),
+            "reducer_retries": sum(
+                e.get("role") == "reducer" for e in retry_events),
+            "speculations": spec_events,
+            "blacklists": bl_events,
+        },
         "last": {
             "op": last.get("op"),
             "workers": last.get("workers"),
@@ -646,6 +663,15 @@ def render(rep: dict) -> str:
             for name, f in fmts.items() if f.get("pairs")]
         if fmt_bits:
             lines.append("  by format  " + "   ".join(fmt_bits))
+        rec = sh.get("recovery") or {}
+        if any(rec.values()):
+            lines.append(
+                f"  recovery: retries={rec['retries']} "
+                f"(mapper {rec['mapper_retries']}, "
+                f"reducer {rec['reducer_retries']})  "
+                f"speculations={rec['speculations']}  "
+                f"blacklisted={rec['blacklists']} — self-healed; "
+                f"escalations would have raised WorkerCrashed instead")
         lines.append(
             f"  last op {last['op']}: transport={last.get('transport')} "
             f"workers={last['workers']} "

@@ -43,6 +43,9 @@ from distributeddeeplearningspark_tpu.session import Session  # noqa: E402
 
 _SLOW_PATTERNS = (
     "test_supervisor.py",          # multi-process gangs + SIGKILL drills
+    # multi-second subprocess drill (abandons a recovering exchange and
+    # asserts interpreter-exit reaps respawned children + epoch arenas)
+    "test_exchange_recovery.py::test_interpreter_exit_mid_recovery",
     # chaos drills that compile whole-model steps; the pure-python drills
     # (restore-fallback, fault parsing) stay in the fast tier
     "test_chaos.py::test_rollback_without_checkpointer",

@@ -1,0 +1,422 @@
+"""Shuffle task-level fault tolerance (data/exchange.py — ISSUE 14).
+
+The contracts under test:
+
+- lineage retry: a mapper that raises or is SIGKILLed mid-exchange has
+  its slices re-executed (respawned or surviving worker) and the output
+  is BYTE-IDENTICAL to a fault-free run — reducers dedupe replayed
+  frames by their deterministic (part, slot, seq) identity; same for a
+  dead reducer rebuilt from retained spill-dir frames, including with
+  spilled runs already on disk;
+- speculation: a slice lagging the median re-executes on an idle worker,
+  first finish wins, dedup keeps the bytes identical;
+- policy: per-worker strikes blacklist a slot after K failures (work
+  redistributes), and the DLS_SHUFFLE_MAX_RETRIES budget bounds total
+  recovery — exhaustion (or budget 0) escalates to the same typed
+  WorkerCrashed as the fail-fast days, with full teardown;
+- telemetry: every retry/speculation/blacklist decision is a ``shuffle``
+  event, rendered by the dlstatus shuffle block's recovery line;
+- no orphans: recovered exchanges — including respawned children —
+  leak no process, shm segment, or spill file, even on interpreter exit
+  mid-recovery (the weakref.finalize lists are LIVE, so
+  dynamically-added children are reaped too).
+"""
+
+import multiprocessing as mp
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.data import exchange
+from distributeddeeplearningspark_tpu.data.workers import (
+    WorkerCrashed, fork_available)
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+pytestmark = pytest.mark.skipif(
+    not fork_available(), reason="exchange needs the fork start method")
+
+
+@pytest.fixture(autouse=True)
+def _spill_here(tmp_path, monkeypatch):
+    spill_root = tmp_path / "spill"
+    spill_root.mkdir()
+    monkeypatch.setenv(exchange.SPILL_DIR_ENV, str(spill_root))
+    monkeypatch.delenv("DLS_DATA_WORKERS", raising=False)
+    monkeypatch.delenv(exchange.MEM_MB_ENV, raising=False)
+    for var in ("DLS_FAULT", "DLS_FAULT_SHUFFLE_ROLE", "DLS_FAULT_SHUFFLE_ID",
+                "DLS_FAULT_ALL_ATTEMPTS", exchange.MAX_RETRIES_ENV,
+                exchange.BLACKLIST_ENV, exchange.SPECULATE_ENV):
+        monkeypatch.delenv(var, raising=False)
+    yield spill_root
+
+
+def _assert_no_leaks(spill_root):
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if not [p for p in mp.active_children()
+                if p.name.startswith("dlsx-")]:
+            break
+        time.sleep(0.05)
+    assert not [p for p in mp.active_children()
+                if p.name.startswith("dlsx-")]
+    if os.path.isdir("/dev/shm"):
+        mine = [f for f in os.listdir("/dev/shm")
+                if f.startswith(f"dlsx-{os.getpid()}-")]
+        assert not mine, mine
+    import gc
+
+    gc.collect()
+    left = [str(p) for d in spill_root.iterdir() for p in d.iterdir()]
+    assert not left, left
+
+
+def _pairs_ds(n=20_000, kmod=997, nparts=4):
+    data = [((i * 2654435761) % kmod, i % 13) for i in range(n)]
+    chunks = [data[i::nparts] for i in range(nparts)]
+    return PartitionedDataset.from_generators(
+        [(lambda c=c: iter(c)) for c in chunks])
+
+
+def _collect(ds):
+    return [list(ds.iter_partition(i)) for i in range(ds.num_partitions)]
+
+
+def _events_spy(monkeypatch):
+    events = []
+    orig = telemetry.emit
+    monkeypatch.setattr(
+        telemetry, "emit",
+        lambda kind, **f: (events.append({"kind": kind, **f}),
+                           orig(kind, **f))[1])
+    return events
+
+
+def _shuffle_edges(events, edge):
+    return [e for e in events if e["kind"] == "shuffle"
+            and e.get("edge") == edge]
+
+
+# ---------------------------------------------------------------------------
+# mapper failure: SIGKILL and raise, tuple and columnar, 1/4 workers
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("transport", ["tuple", "columnar"])
+@pytest.mark.parametrize("nw", [1, 4])
+def test_mapper_sigkill_recovers_byte_identical(_spill_here, monkeypatch,
+                                                transport, nw):
+    """A mapper killed mid-exchange respawns; its slices replay from
+    lineage and the output matches the serial reference byte for byte on
+    BOTH transports at any worker count."""
+    ref = _collect(_pairs_ds().reduce_by_key(
+        lambda a, b: a + b, num_workers=0))
+    events = _events_spy(monkeypatch)
+    monkeypatch.setenv("DLS_FAULT", "die_shuffle_worker@2000")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ROLE", "mapper")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ID", "0")
+    got = _collect(_pairs_ds().reduce_by_key(
+        lambda a, b: a + b, num_workers=nw,
+        combine="sum" if transport == "columnar" else None,
+        transport=transport))
+    assert got == ref, f"{transport}@{nw} diverged after mapper kill"
+    retries = _shuffle_edges(events, "retry")
+    assert retries and retries[0]["role"] == "mapper"
+    assert retries[0]["reason"] == "died"
+    assert retries[0]["exitcode"] == -signal.SIGKILL
+    done = _shuffle_edges(events, "done")[-1]
+    assert done["mapper_retries"] >= 1
+    # winning-slice accounting is deterministic despite the replay
+    assert done["pairs_in"] == 20_000
+    _assert_no_leaks(_spill_here)
+
+
+def test_mapper_transient_raise_retried_then_succeeds(_spill_here, tmp_path,
+                                                      monkeypatch):
+    """A slice whose combine raises once (transient: bad NFS read, a
+    flaky record) is re-executed and the exchange completes — identical
+    bytes, one mapper retry recorded, reason 'raised'."""
+    marker = tmp_path / "raised-once"
+    events = _events_spy(monkeypatch)
+
+    def flaky(a, b):
+        if a + b > 20 and not marker.exists():
+            marker.write_text("x")
+            raise ValueError("transient poison")
+        return a + b
+
+    ref = _collect(_pairs_ds(n=2000, kmod=97).reduce_by_key(
+        lambda a, b: a + b, num_workers=0))
+    got = _collect(_pairs_ds(n=2000, kmod=97).reduce_by_key(
+        flaky, num_workers=2))
+    assert got == ref
+    retries = _shuffle_edges(events, "retry")
+    assert retries and retries[0]["role"] == "mapper"
+    assert retries[0]["reason"] == "raised"
+    _assert_no_leaks(_spill_here)
+
+
+def test_mapper_deterministic_raise_escalates_with_traceback(_spill_here):
+    """A raise that repeats on every attempt burns the budget and
+    escalates as the typed WorkerCrashed carrying the user traceback."""
+    def boom(a, b):
+        if a + b > 50:
+            raise ValueError("poisoned combine")
+        return a + b
+
+    out = _pairs_ds(n=2000, kmod=97).reduce_by_key(boom, num_workers=2)
+    t0 = time.monotonic()
+    with pytest.raises(WorkerCrashed) as ei:
+        _collect(out)
+    assert time.monotonic() - t0 < 60.0
+    assert "poisoned combine" in str(ei.value)
+    _assert_no_leaks(_spill_here)
+
+
+# ---------------------------------------------------------------------------
+# reducer failure (with spilled runs on disk)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("transport", ["tuple", "columnar"])
+def test_reducer_sigkill_with_spills_recovers(_spill_here, monkeypatch,
+                                              transport):
+    """A reducer killed after it has already SPILLED sorted runs to disk
+    restarts, discards the dead attempt's runs, rebuilds its buckets from
+    the retained mapper frames, and finalizes byte-identically."""
+    kw = dict(combine="sum" if transport == "columnar" else None,
+              transport=transport)
+    # 200k distinct keys: even the compact columnar planes overflow the
+    # 4MB floor budget's per-reducer share, so runs really hit disk
+    # before the kill
+    ref = _collect(_pairs_ds(n=200_000, kmod=199_999).reduce_by_key(
+        lambda a, b: a + b, num_workers=0, **kw))
+    events = _events_spy(monkeypatch)
+    monkeypatch.setenv(exchange.MEM_MB_ENV, "4")  # floor budget → spills
+    monkeypatch.setenv("DLS_FAULT", "die_shuffle_worker@3")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ROLE", "reducer")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ID", "0")
+    got = _collect(_pairs_ds(n=200_000, kmod=199_999).reduce_by_key(
+        lambda a, b: a + b, num_workers=2, **kw))
+    assert got == ref, f"{transport} diverged after reducer kill"
+    retries = _shuffle_edges(events, "retry")
+    assert any(r["role"] == "reducer" and r["reason"] == "died"
+               for r in retries)
+    assert _shuffle_edges(events, "spill"), "budget floor never spilled"
+    _assert_no_leaks(_spill_here)
+
+
+def test_mapper_and_reducer_killed_same_run(_spill_here, monkeypatch):
+    """The shuffle-chaos shape: one mapper AND one reducer die in the
+    same exchange; both recover; bytes identical; one retry each."""
+    ref = _collect(_pairs_ds().reduce_by_key(
+        lambda a, b: a + b, num_workers=0))
+    events = _events_spy(monkeypatch)
+    monkeypatch.setenv("DLS_FAULT", "die_shuffle_worker@6")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ROLE", "both")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ID", "0")
+    got = _collect(_pairs_ds().reduce_by_key(
+        lambda a, b: a + b, num_workers=2))
+    assert got == ref
+    done = _shuffle_edges(events, "done")[-1]
+    assert done["mapper_retries"] >= 1 and done["reducer_retries"] >= 1
+    _assert_no_leaks(_spill_here)
+
+
+# ---------------------------------------------------------------------------
+# speculation
+# ---------------------------------------------------------------------------
+
+def test_speculation_first_finish_wins_dedup(_spill_here, monkeypatch):
+    """One partition is pathologically slow; its slice gets cloned to an
+    idle worker once it lags the median past the (patched-down) floor.
+    Both attempts ship byte-identical frames, dedup keeps exactly one
+    copy, output matches the serial reference."""
+    def make_ds():
+        def chunk(i):
+            def gen():
+                for j in range(40):
+                    if i == 0:
+                        time.sleep(0.05)  # the straggler partition
+                    yield ((i * 40 + j) % 13, 1)
+            return gen
+        return PartitionedDataset.from_generators(
+            [chunk(i) for i in range(4)])
+
+    ref = _collect(make_ds().reduce_by_key(lambda a, b: a + b,
+                                           num_workers=0))
+    events = _events_spy(monkeypatch)
+    monkeypatch.setattr(exchange, "_SPECULATE_FLOOR_S", 0.3)
+    monkeypatch.setenv(exchange.SPECULATE_ENV, "2.0")
+    got = _collect(make_ds().reduce_by_key(lambda a, b: a + b,
+                                           num_workers=2))
+    assert got == ref
+    spec = _shuffle_edges(events, "speculate")
+    assert spec, "no speculation despite a 2s straggler"
+    assert spec[0]["part"] == 0
+    done = _shuffle_edges(events, "done")[-1]
+    assert done["speculations"] >= 1
+    # dedup: winning-slice accounting counts every pair exactly once
+    assert done["pairs_in"] == 160
+    _assert_no_leaks(_spill_here)
+
+
+# ---------------------------------------------------------------------------
+# blacklisting + budget
+# ---------------------------------------------------------------------------
+
+def test_blacklist_after_k_strikes_redistributes(_spill_here, monkeypatch):
+    """With the fault firing on EVERY attempt of mapper slot 0 and the
+    strike threshold at 1, the slot is blacklisted after its first death
+    and the surviving mapper absorbs its work — completion, identical
+    bytes, a blacklist event, no further slot-0 respawn."""
+    ref = _collect(_pairs_ds().reduce_by_key(
+        lambda a, b: a + b, num_workers=0))
+    events = _events_spy(monkeypatch)
+    monkeypatch.setenv("DLS_FAULT", "die_shuffle_worker@500")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ROLE", "mapper")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ID", "0")
+    monkeypatch.setenv("DLS_FAULT_ALL_ATTEMPTS", "1")
+    monkeypatch.setenv(exchange.BLACKLIST_ENV, "1")
+    got = _collect(_pairs_ds().reduce_by_key(
+        lambda a, b: a + b, num_workers=2))
+    assert got == ref
+    bl = _shuffle_edges(events, "blacklist")
+    assert len(bl) == 1 and bl[0]["role"] == "mapper" and bl[0]["worker"] == 0
+    done = _shuffle_edges(events, "done")[-1]
+    assert done["blacklists"] == 1
+    _assert_no_leaks(_spill_here)
+
+
+def test_retry_budget_exhaustion_escalates_typed(_spill_here, monkeypatch):
+    """A single-mapper exchange whose worker dies on every attempt burns
+    DLS_SHUFFLE_MAX_RETRIES respawns, then escalates to the typed
+    WorkerCrashed with the budget named — and tears everything down."""
+    monkeypatch.setenv("DLS_FAULT", "die_shuffle_worker@500")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ROLE", "mapper")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ID", "0")
+    monkeypatch.setenv("DLS_FAULT_ALL_ATTEMPTS", "1")
+    monkeypatch.setenv(exchange.MAX_RETRIES_ENV, "2")
+    monkeypatch.setenv(exchange.BLACKLIST_ENV, "99")
+    t0 = time.monotonic()
+    with pytest.raises(WorkerCrashed) as ei:
+        _collect(_pairs_ds().reduce_by_key(
+            lambda a, b: a + b, num_workers=1))
+    assert time.monotonic() - t0 < 60.0
+    assert "exhausted" in str(ei.value)
+    assert ei.value.exitcode == -signal.SIGKILL
+    _assert_no_leaks(_spill_here)
+
+
+def test_zero_retries_is_fail_fast(_spill_here, monkeypatch):
+    """DLS_SHUFFLE_MAX_RETRIES=0: the first death raises today's typed
+    WorkerCrashed within a bounded wait, full teardown — the acceptance
+    gate for the legacy behavior (and the retention-free perf baseline)."""
+    monkeypatch.setenv(exchange.MAX_RETRIES_ENV, "0")
+    monkeypatch.setenv("DLS_FAULT", "die_shuffle_worker@2000")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ROLE", "mapper")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ID", "0")
+    t0 = time.monotonic()
+    with pytest.raises(WorkerCrashed) as ei:
+        _collect(_pairs_ds().reduce_by_key(
+            lambda a, b: a + b, num_workers=2))
+    assert time.monotonic() - t0 < 30.0
+    assert "died" in str(ei.value)
+    assert ei.value.exitcode == -signal.SIGKILL
+    _assert_no_leaks(_spill_here)
+
+
+# ---------------------------------------------------------------------------
+# group_by_key / sort_by replay identity (tagged values, sort frames)
+# ---------------------------------------------------------------------------
+
+def test_group_and_sort_recover_byte_identical(_spill_here, monkeypatch):
+    monkeypatch.setenv("DLS_FAULT", "die_shuffle_worker@1500")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ROLE", "mapper")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ID", "0")
+    ref_g = _collect(_pairs_ds(n=8000).group_by_key(num_workers=0))
+    got_g = _collect(_pairs_ds(n=8000).group_by_key(num_workers=2))
+    assert got_g == ref_g
+    ref_s = list(_pairs_ds(n=8000).sort_by(
+        lambda kv: kv[0], num_workers=0).collect())
+    got_s = list(_pairs_ds(n=8000).sort_by(
+        lambda kv: kv[0], num_workers=2).collect())
+    assert got_s == ref_s
+    _assert_no_leaks(_spill_here)
+
+
+# ---------------------------------------------------------------------------
+# dlstatus recovery rollup
+# ---------------------------------------------------------------------------
+
+def test_dlstatus_renders_recovery_line(tmp_path, monkeypatch, _spill_here):
+    from distributeddeeplearningspark_tpu import status
+
+    wd = tmp_path / "tele"
+    monkeypatch.setenv("DLS_FAULT", "die_shuffle_worker@6")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ROLE", "both")
+    monkeypatch.setenv("DLS_FAULT_SHUFFLE_ID", "0")
+    telemetry.configure(wd)
+    try:
+        _collect(_pairs_ds().reduce_by_key(lambda a, b: a + b,
+                                           num_workers=2))
+    finally:
+        telemetry.reset()
+    rep = status.report(str(wd))
+    sh = rep["shuffle"]
+    rec = sh["recovery"]
+    assert rec["retries"] >= 2
+    assert rec["mapper_retries"] >= 1 and rec["reducer_retries"] >= 1
+    rendered = status.render(rep)
+    assert "recovery:" in rendered and "self-healed" in rendered
+    _assert_no_leaks(_spill_here)
+
+
+# ---------------------------------------------------------------------------
+# no orphans on interpreter exit mid-recovery (live finalizer lists)
+# ---------------------------------------------------------------------------
+
+def test_interpreter_exit_mid_recovery_leaks_nothing(tmp_path):
+    # slow-marked centrally in conftest._SLOW_PATTERNS
+    """Abandon an exchange WHILE a respawned mapper (epoch 1) is
+    running, then exit. The weakref.finalize registration holds the LIVE
+    proc list, so the dynamically-added child is reaped too — no process
+    survives, no shm leaks, and the resource tracker has nothing to
+    complain about."""
+    script = r"""
+import os, sys, threading, time
+os.environ["DLS_SHUFFLE_SPILL_DIR"] = sys.argv[1]
+os.environ["DLS_FAULT"] = "die_shuffle_worker@30"
+os.environ["DLS_FAULT_SHUFFLE_ROLE"] = "mapper"
+os.environ["DLS_FAULT_SHUFFLE_ID"] = "0"
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+def chunk(i):
+    def gen():
+        for j in range(400):
+            time.sleep(0.02)   # keep the exchange mid-flight at exit
+            yield ((i * 400 + j) % 97, 1)
+    return gen
+
+ds = PartitionedDataset.from_generators([chunk(i) for i in range(4)])
+out = ds.reduce_by_key(lambda a, b: a + b, num_workers=2)
+th = threading.Thread(
+    target=lambda: list(out.iter_partition(0)), daemon=True)
+th.start()
+time.sleep(4.0)  # the fault fired (~0.6s in) and epoch 1 is running
+print("pid", os.getpid())
+sys.exit(0)      # finalize must reap the epoch-1 child too
+"""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, "-c", script, str(tmp_path)], env=env,
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "leaked shared_memory" not in out.stderr, out.stderr[-2000:]
+    pid = int(out.stdout.split()[-1])
+    if os.path.isdir("/dev/shm"):
+        left = [f for f in os.listdir("/dev/shm")
+                if f.startswith(f"dlsx-{pid}-")]
+        assert not left, left

@@ -192,7 +192,46 @@ def test_worker_exception_propagates_typed():
     _assert_no_leaks()
 
 
-def test_worker_sigkill_detected():
+def test_worker_sigkill_respawns_byte_identical():
+    """A SIGKILL'd worker respawns (ISSUE 14): the replacement takes over
+    the residue class fast-forwarded past what was already delivered, so
+    the stream completes with EXACTLY the bytes of an unfaulted run, and
+    the respawn leaves a `recovery` telemetry event."""
+    from distributeddeeplearningspark_tpu import telemetry
+
+    def work(x):
+        time.sleep(0.002)
+        return {"v": np.full(300, x, np.float32)}
+
+    n = 400
+    ref = [work(x)["v"].tobytes() for x in range(n)]
+    events = []
+    orig_emit = telemetry.emit
+    telemetry.emit = lambda kind, **f: events.append({"kind": kind, **f})
+    try:
+        pool = WorkerPool(lambda: iter(range(n)), work, 2)
+        s = pool.stream()
+        got = [next(s)["v"].tobytes()]
+        os.kill(pool._procs[0].pid, signal.SIGKILL)
+        t0 = time.monotonic()
+        for ex in s:
+            got.append(ex["v"].tobytes())
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        telemetry.emit = orig_emit
+    assert got == ref  # ordered, byte-identical despite the kill
+    rec = [e for e in events if e["kind"] == "recovery"
+           and e.get("event") == "input-worker-respawn"]
+    assert len(rec) == 1 and rec[0]["worker"] == 0
+    assert rec[0]["exitcode"] == -signal.SIGKILL
+    _assert_no_leaks()
+
+
+def test_worker_sigkill_escalates_when_budget_exhausted(monkeypatch):
+    """With the respawn budget at 0, a dead worker is the old typed
+    CRASH — bounded wait, exitcode preserved, full teardown."""
+    monkeypatch.setenv("DLS_DATA_WORKER_MAX_RETRIES", "0")
+
     def work(x):
         time.sleep(0.01)
         return {"v": np.full(300, x, np.float32)}
@@ -209,6 +248,26 @@ def test_worker_sigkill_detected():
     assert time.monotonic() - t0 < 30.0
     assert ei.value.exitcode == -signal.SIGKILL
     assert "died" in str(ei.value)
+    _assert_no_leaks()
+
+
+def test_worker_repeated_kills_exhaust_budget():
+    """Each respawn burns budget; kills past DLS_DATA_WORKER_MAX_RETRIES
+    escalate. (Kill the same slot every time a replacement appears.)"""
+    def work(x):
+        time.sleep(0.005)
+        return {"v": np.full(300, x, np.float32)}
+
+    pool = WorkerPool(lambda: iter(range(10_000)), work, 2, max_retries=1)
+    s = pool.stream()
+    next(s)
+    with pytest.raises(WorkerCrashed):
+        killed = pool._procs[0]
+        os.kill(killed.pid, signal.SIGKILL)
+        for _ in s:
+            if pool._procs[0] is not killed:  # replacement is up: kill it
+                killed = pool._procs[0]
+                os.kill(killed.pid, signal.SIGKILL)
     _assert_no_leaks()
 
 

@@ -520,6 +520,126 @@ PYEOF
   return $rc
 }
 
+# shuffle-chaos drill (ISSUE 14): the 10M-key groupBy.agg again, but a
+# mapper AND a reducer are SIGKILLed mid-exchange
+# (DLS_FAULT=die_shuffle_worker, role=both) — the exchange must
+# self-heal: >=1 recorded retry per role, blake2b output checksum
+# IDENTICAL to the clean run, zero orphaned processes/shm/spill files.
+# Then the same drill under DLS_SHUFFLE_MAX_RETRIES=0 must raise the
+# typed WorkerCrashed with full teardown (the fail-fast contract).
+run_shuffle_chaos() {
+  local t0 rc wd out
+  t0=$(date +%s)
+  rc=0
+  wd=$(mktemp -d /tmp/dls_shuffle_chaos.XXXXXX)
+  out=$( (WD="$wd" DLS_SHUFFLE_MEM_MB=64 DLS_SHUFFLE_SPILL_DIR="$wd/spill" \
+          JAX_PLATFORMS=cpu python - <<'PYEOF'
+import gc, hashlib, os, sys, time
+import multiprocessing as mp
+import numpy as np
+
+from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.data.dataframe import DataFrame
+from distributeddeeplearningspark_tpu.data.workers import WorkerCrashed
+from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
+
+N, NCHUNK, DUP = 10_000_000, 20, 100_000
+
+def chunk(i):
+    if i == NCHUNK:
+        k = np.arange(DUP, dtype=np.int64)
+    else:
+        r = N // NCHUNK
+        k = np.arange(i * r, (i + 1) * r, dtype=np.int64)
+    return {"k": k, "v": (k % 97).astype(np.float64)}
+
+def run():
+    ds = PartitionedDataset.from_generators(
+        [(lambda i=i: iter([chunk(i)])) for i in range(NCHUNK + 1)])
+    g = DataFrame(ds, ["k", "v"]).groupBy("k").agg(
+        {"v": "sum", "k": "count"}, num_workers=2, transport="columnar")
+    chunks = [ch for p in range(g._chunks.num_partitions)
+              for ch in g._chunks.iter_partition(p)]
+    h = hashlib.blake2b(digest_size=16)
+    for c in sorted(chunks[0]):
+        h.update(np.ascontiguousarray(
+            np.concatenate([ch[c] for ch in chunks])).tobytes())
+    return h.hexdigest()
+
+def assert_no_orphans(tag):
+    deadline = time.time() + 5.0
+    while time.time() < deadline and [p for p in mp.active_children()
+                                      if p.name.startswith("dlsx-")]:
+        time.sleep(0.05)
+    left = [p.name for p in mp.active_children()
+            if p.name.startswith("dlsx-")]
+    assert not left, f"{tag}: orphan children {left}"
+    if os.path.isdir("/dev/shm"):
+        shm = [f for f in os.listdir("/dev/shm")
+               if f.startswith(f"dlsx-{os.getpid()}-")]
+        assert not shm, f"{tag}: orphan shm {shm}"
+    gc.collect()
+    spill = [f for d in os.listdir(os.environ["DLS_SHUFFLE_SPILL_DIR"])
+             for f in os.listdir(
+                 os.path.join(os.environ["DLS_SHUFFLE_SPILL_DIR"], d))]
+    assert not spill, f"{tag}: orphan spill files {spill[:5]}"
+
+telemetry.configure(os.environ["WD"])
+
+# 1) clean run: the checksum oracle
+clean_sum = run()
+gc.collect()
+
+# 2) kill one mapper (at its 5th element — elements here are whole
+#    500k-row chunks) AND one reducer (at its 5th merged frame)
+#    mid-exchange; the run must complete bit-equal
+os.environ["DLS_FAULT"] = "die_shuffle_worker@5"
+os.environ["DLS_FAULT_SHUFFLE_ROLE"] = "both"
+os.environ["DLS_FAULT_SHUFFLE_ID"] = "0"
+t_f = time.time()
+fault_sum = run()
+fault_s = time.time() - t_f
+assert fault_sum == clean_sum, \
+    f"faulted checksum diverged: {fault_sum} vs {clean_sum}"
+events = telemetry.read_events(os.environ["WD"])
+retries = [e for e in events
+           if e.get("kind") == "shuffle" and e.get("edge") == "retry"]
+m_retries = [e for e in retries if e.get("role") == "mapper"]
+r_retries = [e for e in retries if e.get("role") == "reducer"]
+assert m_retries, "no mapper retry recorded"
+assert r_retries, "no reducer retry recorded"
+assert_no_orphans("faulted run")
+
+# 3) the dlstatus recovery line renders from those events
+from distributeddeeplearningspark_tpu import status
+rep = status.report(os.environ["WD"])
+rec = rep["shuffle"]["recovery"]
+assert rec["mapper_retries"] >= 1 and rec["reducer_retries"] >= 1, rec
+assert "recovery:" in status.render(rep)
+
+# 4) DLS_SHUFFLE_MAX_RETRIES=0: today's fail-fast — typed WorkerCrashed,
+#    full teardown
+os.environ["DLS_SHUFFLE_MAX_RETRIES"] = "0"
+try:
+    run()
+    sys.exit("retries=0 did not escalate")
+except WorkerCrashed as e:
+    assert "died" in str(e), str(e)
+assert_no_orphans("fail-fast run")
+telemetry.reset()
+print(f"chaos: mapper+reducer killed mid-10M-key agg; "
+      f"retries m={len(m_retries)} r={len(r_retries)}; "
+      f"checksum={fault_sum} == clean; faulted wall {fault_s:.0f}s; "
+      f"retries=0 escalated typed; zero orphans")
+PYEOF
+) ) || rc=$?
+  log shuffle-chaos "${out:-shuffle chaos drill failed}" "${rc}" \
+    $(( $(date +%s) - t0 ))
+  echo "[shuffle-chaos] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$wd"
+  return $rc
+}
+
 # anatomy smoke (ISSUE 10): a short real train run must leave a compile
 # ledger with exactly one compile per signature (zero flagged recompiles),
 # a device/host/input/compile lap split that explains the independently
@@ -869,6 +989,7 @@ case "${1:-both}" in
   both) run_tier fast "not slow" || overall=$?
         run_tier slow "slow" || overall=$?
         run_shuffle_smoke || overall=$?
+        run_shuffle_chaos || overall=$?
         run_elastic_smoke || overall=$?
         run_mpmd_smoke || overall=$?
         run_perf_guard_smoke || overall=$? ;;
@@ -897,6 +1018,11 @@ case "${1:-both}" in
   # completes via the 2-worker exchange under DLS_SHUFFLE_MEM_MB, exact
   # result + >=1 spill + dlstatus shuffle block (docs/PERFORMANCE.md)
   shuffle) run_shuffle_smoke || overall=$? ;;
+  # shuffle fault tolerance: mapper+reducer SIGKILL mid-10M-key agg →
+  # self-heals checksum-identical with >=1 retry each and zero orphans;
+  # DLS_SHUFFLE_MAX_RETRIES=0 → typed WorkerCrashed, full teardown
+  # (docs/POD_PLAYBOOK.md "A shuffle worker died")
+  shuffle-chaos) run_shuffle_chaos || overall=$? ;;
   # device anatomy: compile ledger exactly-once, lap split explains the
   # Meter wall within 5%, finite MFU (docs/OBSERVABILITY.md "Device
   # anatomy")
@@ -916,6 +1042,6 @@ case "${1:-both}" in
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|anatomy|elastic|mpmd|perf-guard|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|mpmd|perf-guard|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall

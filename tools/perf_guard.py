@@ -69,7 +69,11 @@ HIGHER_BETTER = ("images_per_sec_per_chip", "tokens_per_sec_per_chip",
 #: pipeline_bubble_frac: idle fraction of the MPMD stage pipeline —
 #: growth means the transport or the 1F1B/GPipe schedule regressed even
 #: when wall-clock noise hides it in steps/sec.
-LOWER_BETTER = ("step_time_ms", "compile_s", "pipeline_bubble_frac")
+#: shuffle_recovery_overhead_pct: faulted-vs-clean wall-clock delta of
+#: the kill-a-mapper-and-a-reducer shuffle drill (ISSUE 14) — growth
+#: means lineage replay / retained-frame rebuild got more expensive.
+LOWER_BETTER = ("step_time_ms", "compile_s", "pipeline_bubble_frac",
+                "shuffle_recovery_overhead_pct")
 ZERO_EXPECTED = ("recompile_count",)
 
 #: bench arms whose records carry the fields above (bench.py `want` names).
@@ -198,7 +202,11 @@ def guard(current: dict, history: list[dict], *, band: float = 0.15,
             continue
         eff_band = band
         key = check.split(".", 1)[-1]
-        if key == "compile_s":
+        if key in ("compile_s", "shuffle_recovery_overhead_pct"):
+            # both swing with host load far more than steady-state
+            # throughput: compile times, and a single faulted-vs-clean
+            # wall-clock ratio whose numerator includes fork/respawn
+            # latency and poll cadences
             eff_band = band * COMPILE_BAND_FACTOR
         elif key == "step_time_ms":
             arm = check.split(".", 1)[0]
