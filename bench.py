@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import subprocess
 import sys
@@ -1263,6 +1264,72 @@ def bench_mpmd(iters: int, *, batch_size: int = 8, seq: int = 96,
     }
 
 
+def bench_plan_sweep(iters: int, *, batch_size: int = 0, seq: int = 32) -> dict:
+    """Measured layout search (tools/plan_sweep.py) as a bench arm.
+
+    Runs the digest-asserted small-model sweep on this box's devices and
+    records ``plan_sweep_best_steps_per_sec`` plus the winning plan id —
+    ``tools/perf_guard.py`` guards the rate HIGHER_BETTER under its own
+    field name, so pre-plan BENCH history contributes nothing and the new
+    series builds its own baseline (the transport-tagged-name scoping
+    discipline). The probe batch is content-addressed: the digest is
+    computed twice independently and asserted equal, then recorded, so a
+    cross-round comparison is a comparison of the same bytes.
+    """
+    import importlib.util
+
+    import jax
+
+    from distributeddeeplearningspark_tpu.models import LlamaConfig
+    from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+
+    spec = importlib.util.spec_from_file_location(
+        "plan_sweep", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "tools", "plan_sweep.py"))
+    sweep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sweep)
+
+    n = len(jax.devices())
+    if n % 4 == 0:
+        mesh = MeshSpec(data=n // 4, fsdp=2, seq=2).build()
+    elif n % 2 == 0:
+        mesh = MeshSpec(data=n // 2, fsdp=2).build()
+    else:
+        mesh = MeshSpec(data=n).build()
+    cfg = LlamaConfig.tiny()
+    shards = mesh.shape["data"] * mesh.shape["fsdp"]
+    bs = batch_size or 2 * shards
+    batch, digest = sweep._build_batch(cfg, bs, seq)
+    _, digest2 = sweep._build_batch(cfg, bs, seq)
+    assert digest == digest2, "probe batch is not content-stable"
+    report = sweep.run_sweep(mesh, cfg, batch, steps=max(4, iters // 4),
+                             warmup=1)
+    ranked = report["ranked"]
+    # an all-probes-failed sweep must be a FAILED arm, not a 0.0 record
+    # quietly entering BENCH history (the skipped rows carry the reasons)
+    assert ranked, f"sweep ranked no plans: {report.get('skipped')}"
+    assert ranked == sorted(ranked, key=lambda r: r["step_time_s"]), \
+        "ranked table not ordered by measured step time"
+    return {
+        "plan_sweep_best_steps_per_sec": report.get("best_steps_per_sec"),
+        "winning_plan": report.get("winner"),
+        "winning_plan_sig": report.get("winner_sig"),
+        "winner_rerun_new_compiles": report.get("winner_rerun_new_compiles"),
+        "plans_ranked": [
+            {k: r.get(k) for k in
+             ("plan", "plan_sig", "step_time_s", "steps_per_sec", "mfu",
+              "bytes_accessed", "peak_hbm_bytes", "compile_s",
+              "argument_bytes", "compiles", "recompiles")}
+            for r in ranked],
+        "plans_skipped": report.get("skipped"),
+        "batch_digest": digest,
+        "batch_size": bs,
+        "seq": seq,
+        "mesh": report["mesh"],
+        **_host_conditions(),
+    }
+
+
 def pallas_smoke() -> dict:
     """Compile-and-run flash attention fwd+bwd on the real chip (Mosaic).
 
@@ -1757,7 +1824,7 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model",
                     choices=["all", "resnet", "bert", "llama", "dlrm", "input",
-                             "mpmd", "kernels", "memval"],
+                             "mpmd", "plan", "kernels", "memval"],
                     default="all")
     ap.add_argument("--chip-queue", action="store_true",
                     help="run the whole chip-window backlog (CHIP_QUEUE) as "
@@ -1938,6 +2005,7 @@ def main(argv=None) -> int:
             "dlrm": ("dlrm",),
             "input": ("input_pipeline",),
             "mpmd": ("mpmd_pipeline",),
+            "plan": ("plan_sweep",),
             "kernels": ("pallas_kernels",),
             "memval": ("memory_validation",)}[args.model]
     runners = {
@@ -1963,6 +2031,9 @@ def main(argv=None) -> int:
         "input_pipeline": lambda: bench_input(
             args.iters, **({"batch_size": args.batch} if args.batch else {})),
         "mpmd_pipeline": lambda: bench_mpmd(
+            args.iters, **({"batch_size": args.batch} if args.batch else {}),
+            **({"seq": args.seq} if args.seq else {})),
+        "plan_sweep": lambda: bench_plan_sweep(
             args.iters, **({"batch_size": args.batch} if args.batch else {}),
             **({"seq": args.seq} if args.seq else {})),
         "dlrm": lambda: bench_dlrm(
@@ -2027,6 +2098,20 @@ def main(argv=None) -> int:
                  "note": (f"2-stage exact pipeline, bubble "
                           f"{r['pipeline_bubble_frac']} vs bound "
                           f"{r['theoretical_bubble_frac']}")})
+        return 0
+    elif "plan_sweep" in results:
+        r = results["plan_sweep"]
+        emit("plan_sweep_best_steps_per_sec",
+             r["plan_sweep_best_steps_per_sec"] or 0.0, "steps/sec",
+             0.0, {**extra, **results},
+             headline={
+                 "metric": "plan_sweep_best_steps_per_sec",
+                 "value": r["plan_sweep_best_steps_per_sec"],
+                 "unit": "steps/sec",
+                 "note": (f"winner {r['winning_plan']} "
+                          f"[{r['winning_plan_sig']}] over "
+                          f"{len(r['plans_ranked'])} ranked plan(s), "
+                          f"batch digest {r['batch_digest']}")})
         return 0
     elif "pallas_kernels" in results:
         r = results["pallas_kernels"]

@@ -25,10 +25,23 @@ from distributeddeeplearningspark_tpu.parallel.reshard import (
     redistribute,
     shardings_from_record,
 )
+from distributeddeeplearningspark_tpu.parallel.plan import (
+    DP,
+    FSDP_PLAN,
+    Plan,
+    PlanError,
+    PlanTensorAxisWarning,
+    PlanValidationError,
+    compile_step_with_plan,
+    plan_for_rules,
+    stage_plan,
+    zero_plan,
+)
 from distributeddeeplearningspark_tpu.parallel.sharding import (
     FSDP,
     REPLICATED,
     ShardingRules,
+    add_axis_spec,
     state_shardings,
 )
 
@@ -50,6 +63,17 @@ __all__ = [
     "REPLICATED",
     "FSDP",
     "state_shardings",
+    "add_axis_spec",
+    "Plan",
+    "PlanError",
+    "PlanValidationError",
+    "PlanTensorAxisWarning",
+    "compile_step_with_plan",
+    "plan_for_rules",
+    "stage_plan",
+    "zero_plan",
+    "DP",
+    "FSDP_PLAN",
     "SpanUnavailableError",
     "project_spec",
     "redistribute",

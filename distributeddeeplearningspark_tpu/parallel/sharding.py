@@ -99,26 +99,49 @@ class ShardingRules:
 
 def _add_fsdp_axis(spec: P, shape: tuple[int, ...], mesh: Mesh, min_size: int) -> P:
     """Shard the largest unsharded divisible dim of ``shape`` over 'fsdp'."""
+    return add_axis_spec(spec, shape, mesh, (AXIS_FSDP,), min_size)
+
+
+def add_axis_spec(spec: P, shape: tuple[int, ...], mesh: Mesh,
+                  axes: tuple[str, ...], min_size: int) -> P:
+    """Shard the largest unsharded divisible dim of ``shape`` over ``axes``.
+
+    The generalized auto-FSDP pass (also the plan layer's ZeRO
+    weight-update pass over the replica axes): leaves smaller than
+    ``min_size`` elements, already mentioning one of ``axes``, or with no
+    dim divisible by the axes' total extent stay as they were. When more
+    than one axis is given the whole tuple lands on ONE dim (divisible by
+    the product); if no dim fits, each axis is tried separately,
+    largest-dim first."""
     size = 1
     for d in shape:
         size *= d
     if size < min_size:
         return spec
-    fsdp_n = mesh.shape[AXIS_FSDP]
     entries = list(spec) + [None] * (len(shape) - len(spec))
-    if any(_mentions(e, AXIS_FSDP) for e in entries):
+    if any(_mentions(e, a) for e in entries for a in axes):
         return spec
-    # Largest divisible dim not already assigned a mesh axis.
+    extent = 1
+    for a in axes:
+        extent *= mesh.shape[a]
+    if extent <= 1:
+        return spec
     candidates = [
         (shape[i], i)
         for i in range(len(shape))
-        if entries[i] is None and shape[i] % fsdp_n == 0
+        if entries[i] is None and shape[i] % extent == 0
     ]
-    if not candidates:
-        return spec
-    _, dim = max(candidates)
-    entries[dim] = AXIS_FSDP
-    return P(*entries)
+    if candidates:
+        _, dim = max(candidates)
+        entries[dim] = axes[0] if len(axes) == 1 else tuple(axes)
+        return P(*entries)
+    if len(axes) > 1:
+        # no single dim takes the whole tuple: place axes one at a time
+        out = spec
+        for a in sorted(axes, key=lambda a: -mesh.shape[a]):
+            out = add_axis_spec(out, shape, mesh, (a,), min_size)
+        return out
+    return spec
 
 
 def _mentions(entry, axis: str) -> bool:

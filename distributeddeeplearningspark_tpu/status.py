@@ -512,6 +512,8 @@ def render_anatomy(an: dict) -> list[str]:
                 f"  {fn:<16} {row['compiles']:>3} compile(s)  "
                 f"{row['signatures']:>3} sig(s)  {row['compile_s']:8.2f}s"
                 + (f"  flops={row['flops']:.2e}" if row.get("flops") else "")
+                + (f"  plan={row['plan']}[{row.get('plan_sig') or '?'}]"
+                   if row.get("plan") else "")
                 + (f"  RECOMPILES={row['flagged_recompiles']}"
                    if row["flagged_recompiles"] else ""))
     mem = an.get("memory")

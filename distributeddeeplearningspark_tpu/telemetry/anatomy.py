@@ -153,9 +153,18 @@ class InstrumentedFunction:
     """
 
     def __init__(self, jitted: Callable, *, name: str,
-                 expected_signatures: int = 1, clock=time.perf_counter):
+                 expected_signatures: int = 1, clock=time.perf_counter,
+                 plan=None):
         self._jitted = jitted
         self.name = name
+        # originating compile Plan (parallel/plan.py — duck-typed: anything
+        # with .name and .signature()): every ledger record and compile
+        # phase span carries it, so `dlstatus --anatomy` rows and the
+        # chrome_trace export attribute each compile to its layout
+        self.plan_name = getattr(plan, "name", None) if plan is not None else None
+        self.plan_sig = (plan.signature()
+                         if plan is not None and hasattr(plan, "signature")
+                         else None)
         self.expected_signatures = max(1, int(expected_signatures))
         self._clock = clock
         self._lock = threading.Lock()
@@ -259,6 +268,8 @@ class InstrumentedFunction:
                 "nleaves": nleaves, "compile_s": round(compile_s, 6),
                 "flops": flops, "bytes_accessed": bytes_accessed,
                 **mem_fields,
+                **({"plan": self.plan_name, "plan_sig": self.plan_sig}
+                   if self.plan_name else {}),
                 "sig_compiles": n, "distinct_signatures": distinct,
                 "expected_signatures": self.expected_signatures,
                 "recompile": recompile, "aot": self._aot,
@@ -282,7 +293,9 @@ class InstrumentedFunction:
         """Lower + compile one signature, inside a ``compile`` phase span
         (goodput accounts the stall even mid-traffic)."""
         sig, sig_hash, nleaves = self._reported_sig(key)
-        with telemetry_lib.phase("compile", fn=self.name):
+        with telemetry_lib.phase(
+                "compile", fn=self.name,
+                **({"plan": self.plan_name} if self.plan_name else {})):
             t0 = self._clock()
             try:
                 compiled = self._jitted.lower(*args).compile()
@@ -380,7 +393,9 @@ class InstrumentedFunction:
             # An end-only phase record reconstructs the interval for
             # goodput (t0 = ts - dur_s) without a retroactive begin.
             telemetry_lib.emit("phase", name="compile", edge="end",
-                               dur_s=dt, fn=self.name)
+                               dur_s=dt, fn=self.name,
+                               **({"plan": self.plan_name}
+                                  if self.plan_name else {}))
             self._record_compile(sig, sig_hash, nleaves, dt)
         elif self._anatomy is not None:
             self._anatomy.note_dispatch(dt)
@@ -400,17 +415,25 @@ class InstrumentedFunction:
             "flops_per_step": self.flops_per_step,
             "bytes_per_step": self.bytes_per_step,
             "aot": self._aot,
+            **({"plan": self.plan_name, "plan_sig": self.plan_sig}
+               if self.plan_name else {}),
         }
 
 
 def instrument(jitted: Callable, *, name: str,
-               expected_signatures: int = 1) -> InstrumentedFunction:
+               expected_signatures: int = 1,
+               plan=None) -> InstrumentedFunction:
     """Wrap a jitted callable in the compile ledger (see
-    :class:`InstrumentedFunction`). Idempotent on already-wrapped inputs."""
+    :class:`InstrumentedFunction`). Idempotent on already-wrapped inputs.
+
+    ``plan``: the originating compile Plan (``parallel/plan.py``) —
+    ledger records, compile phase spans, and the chrome_trace export then
+    carry its name/signature."""
     if isinstance(jitted, InstrumentedFunction):
         return jitted
     return InstrumentedFunction(jitted, name=name,
-                                expected_signatures=expected_signatures)
+                                expected_signatures=expected_signatures,
+                                plan=plan)
 
 
 # -- step anatomy -------------------------------------------------------------
@@ -674,7 +697,8 @@ def anatomy_report(events: Iterable[dict]) -> dict[str, Any] | None:
         fn = str(e.get("fn"))
         row = by_fn.setdefault(fn, {
             "compiles": 0, "signatures": set(), "flagged_recompiles": 0,
-            "compile_s": 0.0, "flops": None, "bytes_accessed": None})
+            "compile_s": 0.0, "flops": None, "bytes_accessed": None,
+            "plan": None, "plan_sig": None})
         row["compiles"] += 1
         row["signatures"].add(e.get("sig_hash"))
         row["flagged_recompiles"] += bool(e.get("recompile"))
@@ -683,6 +707,9 @@ def anatomy_report(events: Iterable[dict]) -> dict[str, Any] | None:
             row["flops"] = float(e["flops"])
         if e.get("bytes_accessed"):
             row["bytes_accessed"] = float(e["bytes_accessed"])
+        if e.get("plan"):
+            row["plan"] = e["plan"]
+            row["plan_sig"] = e.get("plan_sig")
     for row in by_fn.values():
         row["signatures"] = len(row["signatures"])
         row["compile_s"] = round(row["compile_s"], 6)
@@ -701,7 +728,8 @@ def anatomy_report(events: Iterable[dict]) -> dict[str, Any] | None:
         "events": [
             {k: e.get(k) for k in
              ("ts", "process", "fn", "sig", "sig_hash", "compile_s",
-              "flops", "bytes_accessed", "recompile", "aot")}
+              "flops", "bytes_accessed", "plan", "plan_sig", "recompile",
+              "aot")}
             for e in compiles[-MAX_LEDGER_EVENTS_REPORTED:]],
         "events_omitted": max(0, len(compiles) - MAX_LEDGER_EVENTS_REPORTED),
     }

@@ -189,8 +189,14 @@ def spans_from_phases(events: Iterable[dict]) -> list[dict]:
                 # crashed session's spans: close them open-ended
                 out.extend(s for s in stack)
                 stack.clear()
+            # identity fields ride along as span attrs, so the chrome
+            # export tags e.g. a compile span with its wrapped fn and its
+            # originating Plan (parallel/plan.py)
+            extra = {k: e[k] for k in ("fn", "plan")
+                     if e.get(k) is not None}
             rec = span(f"train:{proc}", new_span_id(), name, ts, None,
-                       parent_id=stack[-1]["span_id"] if stack else None)
+                       parent_id=stack[-1]["span_id"] if stack else None,
+                       **extra)
             rec["process"] = proc
             stack.append(rec)
         elif edge == "end":

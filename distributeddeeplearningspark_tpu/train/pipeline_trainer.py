@@ -101,7 +101,7 @@ class LlamaStageProgram:
 
     def __init__(self, cfg, stage: int, num_stages: int, mesh, tx, *,
                  mode: str = "exact", loss_mode: str = "full_batch",
-                 rules=None):
+                 rules=None, plan=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -109,6 +109,7 @@ class LlamaStageProgram:
             build_stage_modules,
             check_pp_config,
         )
+        from distributeddeeplearningspark_tpu.parallel import plan as plan_lib
         from distributeddeeplearningspark_tpu.parallel.mesh import BATCH_AXES
 
         if mode not in ("exact", "sharded"):
@@ -145,6 +146,21 @@ class LlamaStageProgram:
         self._jax = jax
         self._row_spec = P(BATCH_AXES)
         self._row_sh = NamedSharding(mesh, self._row_spec)
+        # mode='sharded' stages lay out by a first-class Plan — an explicit
+        # `plan=` (e.g. a per-stage DLS_PIPE_SPEC entry or a pinned sweep
+        # winner) wins; a bare `rules=` is wrapped into an equivalent plan
+        # so both call styles compile identically. The plan's spec
+        # validation runs against THIS stage's mesh (the tensor-axis skew
+        # guard warns here — the per-stage tensor layout is pinned green at
+        # data=1 in tests, the refusal is the sweep's job).
+        if plan is None and rules is not None:
+            plan = plan_lib.Plan(name=f"stage{stage}-rules", rules=rules)
+        if plan is not None:
+            plan.validate(mesh)
+            rules = plan.rules
+            tx = plan.wrap_optimizer(tx, mesh)
+            self.tx = tx
+        self._plan = plan
         self._rules = rules
         self._acc: dict[str, Any] = {}
         self._split_cache: dict[int, Any] = {}
@@ -341,12 +357,16 @@ class LlamaStageProgram:
         if self.mode == "exact":
             rep = NamedSharding(self.mesh, P())
             return jax.tree.map(lambda _: rep, state)
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+        if self._plan is not None:
+            # the stage's Plan owns the layout (incl. any ZeRO opt-state
+            # sharding over the stage's replica axes)
+            return self._plan.state_shardings(abstract, self.mesh)
         from distributeddeeplearningspark_tpu.parallel.sharding import (
             state_shardings,
         )
 
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
         return state_shardings(abstract, self.mesh, self._rules)
 
     # -- per-step compute (called by the runner) -----------------------------
@@ -1039,27 +1059,25 @@ def _stage_mesh(spec: dict, stage: int):
     return MeshSpec(**{k: int(v) for k, v in axes.items()}).build()
 
 
-def _stage_rules(spec: dict, stage: int, cfg):
-    """Per-stage layout strategy for mode='sharded': 'fsdp' (wide sharded
-    storage — the embedding-heavy first stage), 'tensor' (Megatron
-    splits — MLP-heavy middle/last stages), or 'replicated'."""
-    from distributeddeeplearningspark_tpu.parallel.sharding import (
-        ShardingRules,
-    )
+def _stage_plan(spec: dict, stage: int, cfg):
+    """Per-stage layout for mode='sharded' as a first-class compile Plan
+    (parallel/plan.py): 'fsdp' (wide sharded storage — the embedding-heavy
+    first stage), 'tensor' (Megatron splits — MLP-heavy middle/last
+    stages), 'zero' (replicated params, replica-sharded optimizer state),
+    or 'replicated'. ``stage_plans`` (preferred) and the legacy
+    ``stage_rules`` spec keys are synonyms; a per-stage entry may also be
+    a full serialized plan record (e.g. a pinned ``plan_sweep`` winner)."""
+    from distributeddeeplearningspark_tpu.parallel import plan as plan_lib
 
-    name = (spec.get("stage_rules") or {}).get(
-        str(stage), spec.get("rules", "replicated"))
-    if name == "replicated":
-        return ShardingRules()
-    if name == "fsdp":
-        return ShardingRules(fsdp=True,
-                             fsdp_min_size=int(spec.get("fsdp_min_size",
-                                                        2 ** 10)))
-    if name == "tensor":
-        from distributeddeeplearningspark_tpu.models.llama import llama_rules
-
-        return llama_rules(cfg, fsdp=False)
-    raise ValueError(f"unknown stage rules {name!r} in DLS_PIPE_SPEC")
+    name = (spec.get("stage_plans") or spec.get("stage_rules") or {}).get(
+        str(stage), spec.get("plan", spec.get("rules", "replicated")))
+    if isinstance(name, dict):  # inline serialized plan record
+        return plan_lib.Plan.from_record(name)
+    try:
+        return plan_lib.stage_plan(
+            name, cfg, fsdp_min_size=int(spec.get("fsdp_min_size", 2 ** 10)))
+    except plan_lib.PlanError as e:
+        raise ValueError(f"DLS_PIPE_SPEC stage {stage}: {e}") from e
 
 
 def synthetic_batch_fn(spec: dict):
@@ -1102,7 +1120,7 @@ def stage_main() -> int:
         loss_mode=spec.get("loss_mode",
                            "full_batch" if mode == "exact"
                            else "per_microbatch"),
-        rules=_stage_rules(spec, stage, cfg) if mode == "sharded" else None)
+        plan=_stage_plan(spec, stage, cfg) if mode == "sharded" else None)
     transport = mpmd.PipelineTransport.from_env(
         depth=int(spec.get("depth", 2)))
     ckpt = None

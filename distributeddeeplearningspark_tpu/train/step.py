@@ -245,9 +245,11 @@ def jit_predict_step(predict_step: Callable, mesh: Mesh, state_sh: Any) -> Calla
     # outputs replicate (all-gather) like eval metrics: device_get cannot
     # fetch shards living on other hosts' devices, so batch-sharded outputs
     # would crash any multi-process run
-    out_sh = NamedSharding(mesh, P())
-    return jax.jit(predict_step, in_shardings=(state_sh, None),
-                   out_shardings=out_sh)
+    from distributeddeeplearningspark_tpu.parallel import plan as plan_lib
+
+    return plan_lib.compile_step_with_plan(
+        predict_step, plan_lib.DP, mesh, state_shardings=state_sh,
+        kind="predict", instrument=False)
 
 
 def batch_shardings_like(batch: Any, mesh: Mesh) -> Any:
@@ -266,8 +268,12 @@ def jit_train_step(
     state_sh: Any,
     *,
     seq_sharded: bool = False,
+    plan=None,
 ) -> Callable:
-    """Compile with explicit state shardings and state donation.
+    """Compile with explicit state shardings and state donation — routed
+    through the unified plan layer (:func:`..parallel.plan
+    .compile_step_with_plan`), which owns donation and spec validation
+    for every strategy.
 
     Batch shardings are inherited from the arrays themselves (``in_shardings
     = None``): :func:`..data.feed.put_global` is the single source of truth
@@ -276,22 +282,28 @@ def jit_train_step(
     uniform spec here instead would reject rank-1 leaves (sample weights,
     labels) that put_global correctly leaves batch-only.
     """
-    del seq_sharded  # layout carried by the input arrays; kept for API compat
-    metric_sh = NamedSharding(mesh, P())
-    return jax.jit(
-        train_step,
-        in_shardings=(state_sh, None),
-        out_shardings=(state_sh, metric_sh),
-        donate_argnums=(0,),
-    )
+    from distributeddeeplearningspark_tpu.parallel import plan as plan_lib
+
+    if plan is None:
+        plan = plan_lib.plan_for_rules(
+            plan_lib.REPLICATED, context_parallel=seq_sharded)
+    return plan_lib.compile_step_with_plan(
+        train_step, plan, mesh, state_shardings=state_sh, kind="train",
+        instrument=False)
 
 
 def jit_eval_step(
-    eval_step: Callable, mesh: Mesh, state_sh: Any, *, seq_sharded: bool = False
+    eval_step: Callable, mesh: Mesh, state_sh: Any, *,
+    seq_sharded: bool = False, plan=None,
 ) -> Callable:
-    del seq_sharded
-    metric_sh = NamedSharding(mesh, P())
-    return jax.jit(eval_step, in_shardings=(state_sh, None), out_shardings=metric_sh)
+    from distributeddeeplearningspark_tpu.parallel import plan as plan_lib
+
+    if plan is None:
+        plan = plan_lib.plan_for_rules(
+            plan_lib.REPLICATED, context_parallel=seq_sharded)
+    return plan_lib.compile_step_with_plan(
+        eval_step, plan, mesh, state_shardings=state_sh, kind="eval",
+        instrument=False)
 
 
 def init_state(
@@ -303,6 +315,7 @@ def init_state(
     *,
     seed: int = 0,
     sparse_embed: Sequence[Any] = (),
+    plan=None,
 ) -> tuple[TrainState, Any]:
     """Initialize a sharded TrainState directly on the mesh.
 
@@ -313,6 +326,10 @@ def init_state(
 
     ``sparse_embed``: row-sparse table specs (train/embed.py) — allocates
     their per-row accumulators in ``embed_state`` (sharded by the rules).
+
+    ``plan``: a :class:`..parallel.plan.Plan` — shardings then come from
+    ``plan.state_shardings`` (its rules plus the ZeRO weight-update pass
+    over the replica axes) instead of ``rules`` alone.
     """
     init_rng = jax.random.PRNGKey(seed)
 
@@ -332,6 +349,9 @@ def init_state(
                                  rng=state_rng, embed_state=embed_state)
 
     abstract = jax.eval_shape(init_fn, init_rng)
-    shardings = state_shardings(abstract, mesh, rules)
+    if plan is not None:
+        shardings = plan.state_shardings(abstract, mesh)
+    else:
+        shardings = state_shardings(abstract, mesh, rules)
     state = jax.jit(init_fn, out_shardings=shardings)(init_rng)
     return state, shardings

@@ -36,6 +36,7 @@ from distributeddeeplearningspark_tpu.metrics import (
     compiled_flops_per_step,
 )
 from distributeddeeplearningspark_tpu.parallel import collectives
+from distributeddeeplearningspark_tpu.parallel import plan as plan_lib
 from distributeddeeplearningspark_tpu.parallel.mesh import num_data_shards
 from distributeddeeplearningspark_tpu.parallel.sharding import REPLICATED, ShardingRules
 from distributeddeeplearningspark_tpu.rdd import PartitionedDataset
@@ -77,6 +78,7 @@ class Trainer:
         optimizer: optax.GradientTransformation,
         *,
         rules: ShardingRules = REPLICATED,
+        plan: "plan_lib.Plan | None" = None,
         mutable_keys: Sequence[str] = (),
         rng_names: Sequence[str] = ("dropout",),
         seed: int = 0,
@@ -91,6 +93,44 @@ class Trainer:
         self.mesh = self.session.mesh
         self.model = model
         self.loss_fn = loss_fn
+        # every trainer compiles through ONE Plan (parallel/plan.py): an
+        # explicit `plan=` wins (a sweep winner pinned via Plan.load, a
+        # ZeRO layout, a composed ulysses×fsdp); otherwise the legacy
+        # (rules, context_parallel) knobs are wrapped into an equivalent
+        # plan so the unified compile path serves both call styles
+        if plan is not None:
+            if plan.style != "jit":
+                # Trainer's step bodies are GSPMD-style (no explicit
+                # collective calls — the grad all-reduce is inserted by
+                # the partitioner). Wrapping them in shard_map would
+                # silently skip the gradient reduction: each shard would
+                # train on its own rows. shard_map plans are for bodies
+                # built on the explicit collectives verbs.
+                raise plan_lib.PlanValidationError(
+                    f"Trainer requires a style='jit' plan; plan "
+                    f"{plan.name!r} has style={plan.style!r} (shard_map "
+                    f"plans need step bodies with explicit collectives — "
+                    f"compile those via compile_step_with_plan directly)")
+            self.plan = plan
+            rules = plan.rules
+            context_parallel = context_parallel or plan.seq_sharded
+            if plan.model_hints:
+                # the plan layer cannot rebuild the caller's model — a
+                # pinned sweep winner measured WITH these hints applied
+                # (e.g. attention_impl=ulysses), so silently training
+                # without them would not reproduce the ranked number
+                logger.warning(
+                    "plan %r carries model hints %s: apply them to the "
+                    "model config yourself (e.g. dataclasses.replace(cfg, "
+                    "...)) — the sweep measured with them in effect",
+                    plan.name, plan.hints())
+        else:
+            self.plan = plan_lib.plan_for_rules(
+                rules, context_parallel=context_parallel)
+        # typed spec validation up front: a bad pinned plan fails HERE
+        # with PlanValidationError, not as an opaque jax error deep in
+        # init_state (tensor>1 meshes warn per the ROADMAP skew guard)
+        self.plan.validate(self.mesh)
         self.sparse_embed = tuple(sparse_embed)
         if self.sparse_embed and accum_steps != 1:
             raise ValueError("accum_steps is not supported with sparse_embed")
@@ -108,7 +148,10 @@ class Trainer:
             from distributeddeeplearningspark_tpu.train.embed import dense_trainable
 
             optimizer = optim.masked(optimizer, dense_trainable(self.sparse_embed))
-        self.tx = optimizer
+        # ZeRO plans pin the gradient layout replicated inside tx.update
+        # (bitwise parity with the replicated optimizer — see
+        # Plan.wrap_optimizer); a no-op for plans without zero_axes
+        self.tx = self.plan.wrap_optimizer(optimizer, self.mesh)
         self.rules = rules
         self.mutable_keys = tuple(mutable_keys)
         self.rng_names = tuple(rng_names)
@@ -143,14 +186,15 @@ class Trainer:
         """Initialize sharded state from one host example batch."""
         self.state, self.state_shardings = step_lib.init_state(
             self.model, self.tx, sample_batch, self.mesh, self.rules,
-            seed=self.seed, sparse_embed=self.sparse_embed,
+            seed=self.seed, sparse_embed=self.sparse_embed, plan=self.plan,
         )
         if self.mutable_keys == () and self.state.mutable:
             self.mutable_keys = tuple(self.state.mutable.keys())
         self._build_train_step()
         ev = step_lib.make_eval_step(self._apply_fn(), self.loss_fn)
         self._eval_step = step_lib.jit_eval_step(
-            ev, self.mesh, self.state_shardings, seq_sharded=self.context_parallel
+            ev, self.mesh, self.state_shardings,
+            seq_sharded=self.context_parallel, plan=self.plan,
         )
         self._predict_step = step_lib.jit_predict_step(
             step_lib.make_predict_step(self._apply_fn()),
@@ -180,17 +224,17 @@ class Trainer:
                 accum_steps=self.accum_steps, trainable=self.trainable,
                 guard_nonfinite=self._guard_nonfinite,
             )
-        # the compile ledger owns the lower→compile path: every executable
-        # this step ever builds becomes a timed, cost-analyzed `compile`
-        # telemetry event, and a second signature through a shape-stable
-        # train step (expected_signatures=1) flags as a recompile
-        # (docs/OBSERVABILITY.md "Device anatomy")
-        self._train_step = anatomy_lib.instrument(
-            step_lib.jit_train_step(
-                train, self.mesh, self.state_shardings,
-                seq_sharded=self.context_parallel,
-            ),
-            name="train_step",
+        # ONE compile path for every strategy (parallel/plan.py): the plan
+        # centralizes donation + spec validation, and the compile ledger
+        # owns the lower→compile path — every executable this step ever
+        # builds becomes a timed, cost-analyzed `compile` telemetry event
+        # TAGGED with the plan's name/signature, and a second signature
+        # through a shape-stable train step (expected_signatures=1) flags
+        # as a recompile (docs/OBSERVABILITY.md "Device anatomy")
+        self._train_step = plan_lib.compile_step_with_plan(
+            train, self.plan, self.mesh,
+            state_shardings=self.state_shardings,
+            kind="train", name="train_step",
         )
 
     def _apply_fn(self):

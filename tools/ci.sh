@@ -710,6 +710,69 @@ PYEOF
   return $rc
 }
 
+# plan smoke (ISSUE 15): the measured layout search end-to-end — sweep >=3
+# candidate Plans on a tiny llama mesh through the unified compile layer,
+# assert the ranked table is ordered by MEASURED step time, the winner
+# re-runs on its kept executable with ZERO new compiles, and `dlstatus
+# --anatomy` shows exactly one ledgered, plan-tagged compile per plan.
+run_plan_smoke() {
+  local t0 rc wd out
+  t0=$(date +%s)
+  rc=0
+  wd=$(mktemp -d /tmp/dls_plan_smoke.XXXXXX)
+  DLS_TELEMETRY_DIR="$wd" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python tools/plan_sweep.py --steps 4 --warmup 1 --rerun-steps 2 \
+      --json --pin "$wd/winner.plan.json" > "$wd/sweep.json" \
+      2> "$wd/sweep.log" || rc=$?
+  if [ "$rc" -eq 0 ]; then
+    out=$(WD="$wd" python - <<'PYEOF'
+import json, os, subprocess, sys
+
+wd = os.environ["WD"]
+rep = json.load(open(os.path.join(wd, "sweep.json")))
+ranked = rep["ranked"]
+assert len(ranked) >= 3, f"want >=3 ranked plans, got {len(ranked)}"
+times = [r["step_time_s"] for r in ranked]
+assert times == sorted(times), f"table not ordered by step time: {times}"
+assert rep["winner"] == ranked[0]["plan"], rep["winner"]
+assert rep["winner_rerun_new_compiles"] == 0, rep
+assert all(r["compiles"] == 1 and r["recompiles"] == 0 for r in ranked), \
+    [(r["plan"], r["compiles"]) for r in ranked]
+
+# the pinned winner round-trips
+from distributeddeeplearningspark_tpu.parallel.plan import Plan
+pinned = Plan.load(os.path.join(wd, "winner.plan.json"))
+assert pinned.name == rep["winner"], pinned.name
+assert pinned.signature() == rep["winner_sig"]
+
+# --anatomy: one ledgered, plan-tagged compile per plan
+p = subprocess.run(
+    [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+     wd, "--anatomy", "--json"], capture_output=True, text=True)
+assert p.returncode == 0, p.stderr[-500:]
+an = json.loads(p.stdout)["anatomy"]
+by_fn = an["compile_ledger"]["by_fn"]
+for r in ranked:
+    row = by_fn[f"plan:{r['plan']}"]
+    assert row["compiles"] == 1 and row["plan"] == r["plan"], (r["plan"], row)
+    assert row["plan_sig"] == r["plan_sig"], row
+assert an["compile_ledger"]["flagged_recompiles"] == 0
+
+print(f"plans={len(ranked)} winner={rep['winner']} "
+      f"{rep['best_steps_per_sec']}steps/s rerun_compiles=0 "
+      f"ledgered={an['compile_ledger']['compiles']}")
+PYEOF
+) || rc=$?
+  else
+    tail -5 "$wd/sweep.log"
+  fi
+  log plan "${out:-plan smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[plan] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$wd"
+  return $rc
+}
+
 # elastic smoke (ISSUE 11): the kill-a-host drill end-to-end — a 2-host
 # supervised run loses host 1 mid-run (DLS_FAULT=die_host@N, the host stays
 # dead across attempts), the supervisor shrinks the gang to the survivor
@@ -992,6 +1055,7 @@ case "${1:-both}" in
         run_shuffle_chaos || overall=$?
         run_elastic_smoke || overall=$?
         run_mpmd_smoke || overall=$?
+        run_plan_smoke || overall=$?
         run_perf_guard_smoke || overall=$? ;;
   # the recovery drills (kill-mid-finalize, poisoned restore, hang, NaN
   # spike) end-to-end — slow-marked, so the fast tier never pays for gangs
@@ -1035,6 +1099,11 @@ case "${1:-both}" in
   # (P-1)/(M+P-1) bound + 10%, stage-kill drill restarts ONLY the dead
   # stage (docs/PERFORMANCE.md "MPMD pipelines")
   mpmd) run_mpmd_smoke || overall=$? ;;
+  # measured layout search: >=3 plans swept on a tiny llama mesh, ranked
+  # table ordered by measured step time, winner re-runs with zero new
+  # compiles, one plan-tagged ledger compile per plan (docs/PERFORMANCE.md
+  # "Choosing a layout with plan_sweep")
+  plan) run_plan_smoke || overall=$? ;;
   # regression sentinel: BENCH history passes, synthetic 20%-slower
   # record trips rc!=0 with the metric named (tools/perf_guard.py)
   perf-guard) run_perf_guard_smoke || overall=$? ;;
@@ -1042,6 +1111,6 @@ case "${1:-both}" in
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|mpmd|perf-guard|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|mpmd|plan|perf-guard|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall

@@ -65,6 +65,12 @@ HIGHER_BETTER = ("images_per_sec_per_chip", "tokens_per_sec_per_chip",
                  "shuffle_columnar_keys_per_sec",
                  "shuffle_device_keys_per_sec",
                  "columnar_speedup_vs_tuple",
+                 # the measured-layout-search winner's rate carries its own
+                 # name (NOT a shared steps_per_sec) — same scoping rule as
+                 # the shuffle transports: pre-plan BENCH history has no
+                 # such field, so the new series is never judged against an
+                 # incomparable baseline
+                 "plan_sweep_best_steps_per_sec",
                  "steps_per_sec")
 #: pipeline_bubble_frac: idle fraction of the MPMD stage pipeline —
 #: growth means the transport or the 1F1B/GPipe schedule regressed even
@@ -74,11 +80,14 @@ HIGHER_BETTER = ("images_per_sec_per_chip", "tokens_per_sec_per_chip",
 #: means lineage replay / retained-frame rebuild got more expensive.
 LOWER_BETTER = ("step_time_ms", "compile_s", "pipeline_bubble_frac",
                 "shuffle_recovery_overhead_pct")
-ZERO_EXPECTED = ("recompile_count",)
+#: winner_rerun_new_compiles: re-running a plan sweep's winner on its kept
+#: executable must compile NOTHING — a nonzero count over a clean baseline
+#: means plan pinning broke (the sweep's whole point).
+ZERO_EXPECTED = ("recompile_count", "winner_rerun_new_compiles")
 
 #: bench arms whose records carry the fields above (bench.py `want` names).
 ARMS = ("resnet50", "bert_base_mlm", "llama_lora", "llama_decode", "dlrm",
-        "input_pipeline", "mpmd_pipeline")
+        "input_pipeline", "mpmd_pipeline", "plan_sweep")
 
 #: compile times swing with host load far more than steady-state step time.
 COMPILE_BAND_FACTOR = 3.0
