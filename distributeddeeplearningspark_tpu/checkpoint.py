@@ -444,6 +444,10 @@ class Checkpointer:
             "-> restore mesh %s", step, geometry.get("mesh"), to_mesh)
         telemetry.emit(
             "recovery", step=int(step), event="reshard",
+            # transport/walk_back distinguish this disk-mediated restore
+            # path from parallel/live_reshard.py's checkpoint-free moves
+            # (transport="collectives"|"handoff", walk_back=False)
+            transport="checkpoint", walk_back=True,
             from_mesh=geometry.get("mesh"), to_mesh=to_mesh,
             from_devices=geometry.get("num_devices"),
             to_devices=jax.device_count(),

@@ -30,6 +30,19 @@ through every failure mode by the supervisor tests::
                                 # up). Scoped: faults.get() returns None
                                 # for it — only the exchange children
                                 # consult shuffle_fault().
+    DLS_FAULT=sigterm@N         # a preemption NOTICE at step N, not a kill:
+                                # the trainer drains its in-flight step,
+                                # re-gathers the doomed host's live shards
+                                # (parallel/live_reshard.py), writes the
+                                # digest-verified handoff + DRAIN evidence,
+                                # and the whole gang exits clean so the
+                                # supervisor shrinks WITHOUT walking back
+                                # through the checkpoint. Targets a host
+                                # like die_host (DLS_FAULT_HOST, default 1)
+                                # but fires on attempt 0 only (the shrunk
+                                # relaunch runs clean). Scoped: faults.get()
+                                # returns None for it — only the trainer's
+                                # drain path consults sigterm_fault().
 
 Determinism rules:
 
@@ -65,7 +78,7 @@ import time
 logger = logging.getLogger("distributeddeeplearningspark_tpu.faults")
 
 KINDS = ("crash", "hang", "nan", "truncate_ckpt", "die_host",
-         "die_shuffle_worker")
+         "die_shuffle_worker", "sigterm")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,6 +162,11 @@ def get() -> Fault | None:
         # shuffle-scoped: the exchange children consult shuffle_fault();
         # a trainer must never act on it
         return None
+    if fault.kind == "sigterm":
+        # drain-scoped: only the trainer's graceful-preemption path
+        # consults sigterm_fault(); every other caller (host agents,
+        # shuffle children, serving) must not treat a notice as a fault
+        return None
     if fault.kind == "die_host":
         # persists across attempts (a dead host stays dead) unless the
         # drill opts back into the one-shot discipline
@@ -205,6 +223,30 @@ def shuffle_fault(role: str, wid: int, attempt: int) -> int | None:
     if role not in roles or wid != victim:
         return None
     return fault.step
+
+
+def sigterm_fault() -> Fault | None:
+    """The graceful-preemption notice this run should honor, or None.
+
+    Scoped accessor (like :func:`shuffle_fault`): :func:`get` never returns
+    ``sigterm`` so non-trainer callers cannot mistake a notice for a crash
+    fault. The *trainer* — the drain coordinator — consults this regardless
+    of which host it runs on: the notice names the doomed host
+    (``DLS_FAULT_HOST``, read eagerly so a typo'd drill fails loudly), the
+    survivors are the ones re-gathering its shards. Fires on attempt 0 only
+    (the shrunk relaunch must run clean); ``DLS_FAULT_ALL_ATTEMPTS=1`` keeps
+    the notice alive across restarts for give-up testing."""
+    spec = os.environ.get("DLS_FAULT")
+    if not spec:
+        return None
+    fault = parse(spec)
+    if fault.kind != "sigterm":
+        return None
+    fault_host()  # validate eagerly: a typo'd drill must fail loudly
+    if (os.environ.get("DLS_RESTART", "0") != "0"
+            and os.environ.get("DLS_FAULT_ALL_ATTEMPTS") != "1"):
+        return None
+    return fault
 
 
 # -- the injections ----------------------------------------------------------

@@ -104,6 +104,19 @@ def _probed(op: str, fn: Callable) -> Callable:
     return wrapper
 
 
+def transfer_probe(op: str, nbytes: int, wall_s: float,
+                   **fields: Any) -> None:
+    """Report one explicit bulk transfer (the live-reshard engine's
+    schedule, a handoff ingest) as a ``collective`` event when probes are
+    on. These moves run eagerly host-side, so unlike the named-axis verbs
+    there is no trace-time ambiguity — the caller hands us the measured
+    wall directly."""
+    if not collective_probes_enabled():
+        return
+    telemetry.emit("collective", op=op, bytes=int(nbytes),
+                   wait_s=float(wall_s), **fields)
+
+
 _barrier_fns: dict = {}
 
 

@@ -1,0 +1,457 @@
+"""Checkpoint-free live redistribution under a peak-memory bound.
+
+:mod:`.reshard` moves a pytree between layouts in one shot — fine for small
+models, but a whole-leaf move materializes the full leaf in transit, and a
+geometry change that rides disk (checkpoint walk-back) loses every step since
+the last save. This module is the live path (ISSUE 16, the `[elastic speed]`
+ROADMAP item): the memory-efficient redistribution of arXiv:2112.01075
+executed as an explicit block-transfer schedule —
+
+- **schedule** (:func:`chunk_rows`): each leaf is split along its leading
+  dimension into chunks sized so one chunk's bytes fit the budget from
+  ``DLS_RESHARD_MEM_MB``. Chunks are grouped into bounded *rounds*; the
+  in-flight bytes of a round never exceed the budget (a single row wider
+  than the budget is moved whole and reported honestly).
+- **transfer** (:func:`redistribute`): leaves already laid out right pass
+  through untouched; small leaves ride ``jax.device_put`` (XLA's
+  all-gather/dynamic-slice pair); large leaves are streamed chunk-by-chunk —
+  each chunk pulls only the overlapping slices of the source's addressable
+  shards, scatters them into per-target-span buffers, and the assembled
+  blocks are placed via ``make_array_from_single_device_arrays``. The
+  budget bounds the transfer working set (bytes pulled per round), the
+  quantity 2112.01075 bounds on device; destination residency is the leaf
+  itself and cannot be smaller.
+- **verification**: every moved leaf is blake2b-hashed chunk-wise in logical
+  row order on the source during the pull and re-read from the target after
+  placement; a mismatch raises :class:`ReshardVerifyError` before anyone
+  checkpoints corrupt state. Verification re-reads are not counted as
+  transfer rounds.
+
+The second half is the **handoff**: a drained host's live state persisted as
+digest-verified raw blocks (:func:`save_handoff` / :func:`load_handoff`) so a
+shrunk gang resumes from the *current* step instead of walking back through
+the checkpoint. On a real pod the survivors would re-gather the doomed rank's
+shards over collectives; on single-controller CPU rigs (and across the
+supervisor's process boundary) the handoff directory is the transport — same
+schedule, same digests, different wire.
+
+Consumers: ``Trainer.apply_plan`` (live plan_sweep application),
+``Trainer`` graceful SIGTERM drain + ``supervisor`` shrink (ISSUE 16
+drill), and ``serve.fleet`` replica warm-up from a peer's exported weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import os
+import shutil
+import time
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from distributeddeeplearningspark_tpu.parallel.reshard import (
+    SpanUnavailableError,
+    _assemble_block,
+    _slices_cover,
+    geometry_of,
+)
+
+RESHARD_MEM_ENV = "DLS_RESHARD_MEM_MB"
+DEFAULT_MEM_MB = 256.0
+
+HANDOFF_DIRNAME = "live_handoff"
+HANDOFF_MANIFEST = "manifest.json"
+HANDOFF_FORMAT = 1
+
+_DIGEST_SIZE = 16
+
+
+class ReshardVerifyError(RuntimeError):
+    """A leaf's post-move digest does not match its source digest — the
+    live transfer corrupted bytes. Do NOT checkpoint this state; restore
+    from the last verified checkpoint instead."""
+
+
+class HandoffError(RuntimeError):
+    """A live handoff could not be ingested (missing/extra leaves, shape or
+    digest mismatch). The caller should fall back to the checkpoint."""
+
+
+def memory_budget_bytes(mem_mb: float | None = None) -> int:
+    """The in-flight byte budget: ``mem_mb`` if given, else
+    ``DLS_RESHARD_MEM_MB``, else :data:`DEFAULT_MEM_MB`."""
+    if mem_mb is None:
+        raw = os.environ.get(RESHARD_MEM_ENV, "").strip()
+        mem_mb = float(raw) if raw else DEFAULT_MEM_MB
+    if mem_mb <= 0:
+        raise ValueError(
+            f"reshard memory budget must be > 0 MB, got {mem_mb} "
+            f"(set {RESHARD_MEM_ENV} or pass mem_mb)")
+    return max(1, int(mem_mb * 1024 * 1024))
+
+
+def chunk_rows(shape: tuple[int, ...], itemsize: int,
+               budget: int) -> tuple[tuple[int, int], ...]:
+    """Row ranges ``[lo, hi)`` along dim 0 sized so one chunk ≤ ``budget``
+    bytes. 0-d leaves get the single pseudo-row ``(0, 1)``; a row wider than
+    the budget is one chunk (it cannot be split along dim 0)."""
+    if not shape:
+        return ((0, 1),)
+    rows = int(shape[0])
+    if rows == 0:
+        return ()
+    row_bytes = itemsize * max(1, math.prod(shape[1:]))
+    per = max(1, budget // row_bytes)
+    return tuple((lo, min(lo + per, rows)) for lo in range(0, rows, per))
+
+
+@dataclasses.dataclass
+class TransferStats:
+    """Ledger of one :func:`redistribute` call — the live-path fields the
+    ``reshard`` telemetry event carries (bytes moved, rounds, peak
+    in-flight, wall)."""
+
+    leaves: int = 0
+    leaves_moved: int = 0
+    bytes_total: int = 0
+    bytes_moved: int = 0
+    rounds: int = 0
+    peak_inflight_bytes: int = 0
+    mem_budget_bytes: int = 0
+    wall_s: float = 0.0
+    verified: bool = False
+
+    def to_record(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _RoundLedger:
+    """Group chunk transfers into rounds whose in-flight bytes stay under
+    the budget; track the honest peak (a single over-budget chunk makes a
+    round of one and the peak shows it)."""
+
+    def __init__(self, budget: int):
+        self.budget = budget
+        self.rounds = 0
+        self.peak = 0
+        self._inflight = 0
+
+    def add(self, nbytes: int) -> None:
+        if self._inflight and self._inflight + nbytes > self.budget:
+            self.close()
+        self._inflight += int(nbytes)
+        self.peak = max(self.peak, self._inflight)
+
+    def close(self) -> None:
+        if self._inflight:
+            self.rounds += 1
+            self._inflight = 0
+
+
+def _iter_chunks(x: jax.Array, chunks):
+    """Yield ``(lo, hi, block)`` where ``block`` is the host ndarray of rows
+    ``[lo, hi)`` (the full leaf for 0-d), assembled by pulling only the
+    overlapping slice of each addressable source shard — the bounded read
+    primitive both the transfer and the digest passes share."""
+    if x.ndim == 0:
+        yield 0, 1, np.asarray(jax.device_get(x))
+        return
+    shape = x.shape
+    sources = [(_slices_cover(shape, s.index), s.data)
+               for s in x.addressable_shards]
+    if not sources:
+        raise SpanUnavailableError(
+            f"array of shape {shape} has no addressable shards on this "
+            f"host — nothing to redistribute from")
+    for lo, hi in chunks:
+        subs = []
+        for span, data in sources:
+            slo, shi = span[0]
+            olo, ohi = max(lo, slo), min(hi, shi)
+            if olo >= ohi:
+                continue
+            pulled = np.asarray(data[olo - slo:ohi - slo])
+            subs.append(([(olo, ohi)] + span[1:], pulled))
+        target_span = [(lo, hi)] + [(0, d) for d in shape[1:]]
+        yield lo, hi, _assemble_block(shape, target_span, subs)
+
+
+def _digest_chunks(x: jax.Array, chunks) -> str:
+    h = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+    for _, _, block in _iter_chunks(x, chunks):
+        h.update(np.ascontiguousarray(block).tobytes())
+    return h.hexdigest()
+
+
+def _place_chunked(x: jax.Array, target: NamedSharding, chunks,
+                   ledger: _RoundLedger, hasher) -> jax.Array:
+    """Stream ``x`` into ``target`` layout chunk-by-chunk. ``hasher`` sees
+    every chunk in logical row order — the source digest for free."""
+    shape, dtype = x.shape, x.dtype
+    spans: dict[tuple, list] = {}
+    for dev, idx in target.addressable_devices_indices_map(shape).items():
+        span = tuple(tuple(p) for p in _slices_cover(shape, idx))
+        spans.setdefault(span, []).append(dev)
+    bufs = {span: np.empty([hi - lo for lo, hi in span], dtype)
+            for span in spans}
+    for lo, hi, block in _iter_chunks(x, chunks):
+        ledger.add(block.nbytes)
+        hasher.update(np.ascontiguousarray(block).tobytes())
+        for span, buf in bufs.items():
+            (tlo, thi), rest = span[0], span[1:]
+            olo, ohi = max(lo, tlo), min(hi, thi)
+            if olo >= ohi:
+                continue
+            cols = tuple(slice(slo, shi) for slo, shi in rest)
+            buf[olo - tlo:ohi - tlo] = block[(slice(olo - lo, ohi - lo),)
+                                             + cols]
+    arrays = []
+    for span, devs in spans.items():
+        for dev in devs:
+            arrays.append(jax.device_put(bufs[span], dev))
+    return jax.make_array_from_single_device_arrays(shape, target, arrays)
+
+
+def _move_leaf(x: jax.Array, target: NamedSharding, chunks,
+               ledger: _RoundLedger) -> tuple[jax.Array, str]:
+    """Move one leaf; returns ``(moved, source_digest)``."""
+    if x.ndim == 0 or x.nbytes <= ledger.budget:
+        digest = _digest_chunks(x, chunks)
+        try:
+            out = jax.device_put(x, target)
+            ledger.add(x.nbytes)
+            ledger.close()
+            return out, digest
+        except (ValueError, TypeError, RuntimeError):
+            pass  # cross-mesh device_put unsupported: stream it
+    h = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+    out = _place_chunked(x, target, chunks, ledger, h)
+    ledger.close()
+    return out, h.hexdigest()
+
+
+def redistribute(tree: Any, target_shardings: Any, *,
+                 mem_mb: float | None = None,
+                 verify: bool = True) -> tuple[Any, TransferStats]:
+    """Move every leaf of ``tree`` to its sharding in ``target_shardings``
+    in bounded-peak-memory rounds; returns ``(tree, stats)``.
+
+    Unlike :func:`.reshard.redistribute` (one-shot, unbounded), this path
+    chunks each leaf so in-flight transfer bytes per round stay within
+    ``DLS_RESHARD_MEM_MB`` (or ``mem_mb``) and, with ``verify=True``,
+    re-reads every moved leaf from its new layout to check the blake2b
+    digest taken during the pull — a corrupt move raises
+    :class:`ReshardVerifyError` instead of silently training on garbage.
+    """
+    budget = memory_budget_bytes(mem_mb)
+    ledger = _RoundLedger(budget)
+    stats = TransferStats(mem_budget_bytes=budget)
+    t0 = time.perf_counter()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    # None means "leave this leaf alone" — keep it as a LEAF of the
+    # shardings tree (to jax, a bare None is structure, and dropping it
+    # would misalign the zip against the state's leaves)
+    targets = jax.tree_util.tree_leaves(target_shardings,
+                                        is_leaf=lambda t: t is None)
+    out = []
+    pending: list[tuple[str, jax.Array, str, tuple]] = []
+    for (path, x), sh in zip(flat, targets):
+        stats.leaves += 1
+        if sh is None or not hasattr(x, "addressable_shards"):
+            out.append(x if sh is None else jax.device_put(x, sh))
+            continue
+        stats.bytes_total += int(x.nbytes)
+        if x.sharding.is_equivalent_to(sh, x.ndim):
+            out.append(x)
+            continue
+        chunks = chunk_rows(tuple(x.shape), x.dtype.itemsize, budget)
+        moved, digest = _move_leaf(x, sh, chunks, ledger)
+        stats.leaves_moved += 1
+        stats.bytes_moved += int(x.nbytes)
+        out.append(moved)
+        if verify:
+            from distributeddeeplearningspark_tpu.parallel.sharding import (
+                path_str)
+
+            pending.append((path_str(path), moved, digest, chunks))
+
+    for name, moved, digest, chunks in pending:
+        got = _digest_chunks(moved, chunks)
+        if got != digest:
+            raise ReshardVerifyError(
+                f"leaf {name!r}: blake2b mismatch after live reshard "
+                f"(source {digest}, target {got}) — transfer corrupted "
+                f"bytes; do not checkpoint this state, restore from the "
+                f"last verified checkpoint")
+    stats.verified = bool(verify)
+    stats.rounds = ledger.rounds
+    stats.peak_inflight_bytes = ledger.peak
+    stats.wall_s = time.perf_counter() - t0
+    _probe(stats)
+    return jax.tree_util.tree_unflatten(treedef, out), stats
+
+
+def _probe(stats: TransferStats) -> None:
+    from distributeddeeplearningspark_tpu.parallel import collectives
+
+    collectives.transfer_probe("live_reshard", stats.bytes_moved,
+                               stats.wall_s, rounds=stats.rounds)
+
+
+def emit_reshard_event(stats: TransferStats, *, step: int | None = None,
+                       transport: str = "collectives",
+                       walk_back: bool = False, **fields: Any) -> None:
+    """Emit the ``reshard`` recovery event with the live-path fields
+    (bytes moved, rounds, peak in-flight, wall) through the process-wide
+    telemetry writer; no-op when telemetry is unconfigured."""
+    from distributeddeeplearningspark_tpu import telemetry
+
+    tele = telemetry.get()
+    if tele is None:
+        return
+    tele.recovery(step, "reshard", transport=transport,
+                  walk_back=bool(walk_back),
+                  bytes_moved=int(stats.bytes_moved),
+                  rounds=int(stats.rounds),
+                  peak_inflight_bytes=int(stats.peak_inflight_bytes),
+                  mem_budget_mb=round(stats.mem_budget_bytes / 2**20, 3),
+                  wall_s=round(stats.wall_s, 4),
+                  leaves_moved=int(stats.leaves_moved),
+                  verified=bool(stats.verified), **fields)
+
+
+# -- live handoff -------------------------------------------------------------
+
+
+def handoff_dir(directory: str | os.PathLike) -> str:
+    return os.path.join(str(directory), HANDOFF_DIRNAME)
+
+
+def has_handoff(directory: str | os.PathLike) -> bool:
+    return os.path.exists(os.path.join(handoff_dir(directory),
+                                       HANDOFF_MANIFEST))
+
+
+def tree_digest(tree: Any) -> str:
+    """One blake2b over every leaf's bytes in path order — the cheap
+    whole-state fingerprint the fleet warm-up and tests compare."""
+    h = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+    from distributeddeeplearningspark_tpu.parallel.sharding import path_str
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        h.update(path_str(path).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def save_handoff(directory: str | os.PathLike, step: int, state: Any, *,
+                 data_state: dict | None = None,
+                 stats: TransferStats | None = None) -> str:
+    """Persist a drained host's live state atomically as raw ``.npy`` blocks
+    plus a digest manifest. Written to a temp dir and renamed into place, so
+    a handoff either exists completely or not at all — the supervisor's
+    relaunch decision keys off :func:`has_handoff`."""
+    from distributeddeeplearningspark_tpu.parallel.sharding import path_str
+
+    final = handoff_dir(directory)
+    tmp = f"{final}.tmp.{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    leaves = []
+    for i, (path, leaf) in enumerate(
+            jax.tree_util.tree_flatten_with_path(state)[0]):
+        arr = np.asarray(jax.device_get(leaf))
+        fname = f"leaf{i:05d}.npy"
+        np.save(os.path.join(tmp, fname), arr)
+        leaves.append({
+            "path": path_str(path), "file": fname,
+            "shape": list(arr.shape), "dtype": str(arr.dtype),
+            "digest": hashlib.blake2b(
+                np.ascontiguousarray(arr).tobytes(),
+                digest_size=_DIGEST_SIZE).hexdigest(),
+        })
+    manifest = {
+        "format": HANDOFF_FORMAT,
+        "step": int(step),
+        "data_state": data_state,
+        "geometry": geometry_of(state),
+        "leaves": leaves,
+        "stats": stats.to_record() if stats is not None else None,
+    }
+    with open(os.path.join(tmp, HANDOFF_MANIFEST), "w") as f:
+        json.dump(manifest, f)
+    shutil.rmtree(final, ignore_errors=True)
+    os.replace(tmp, final)
+    return final
+
+
+def peek_handoff(directory: str | os.PathLike) -> dict | None:
+    """The handoff manifest without ingesting it (None when absent)."""
+    path = os.path.join(handoff_dir(directory), HANDOFF_MANIFEST)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_handoff(directory: str | os.PathLike, template: Any,
+                 shardings: Any) -> tuple[Any, dict]:
+    """Ingest a handoff onto ``shardings``: every leaf digest-verified
+    against the manifest, shapes checked against ``template``, placed with
+    ``jax.device_put``. Returns ``(state, manifest)``; raises
+    :class:`HandoffError` on any mismatch (fall back to the checkpoint)."""
+    from distributeddeeplearningspark_tpu.parallel.sharding import path_str
+
+    hd = handoff_dir(directory)
+    manifest = peek_handoff(directory)
+    if manifest is None:
+        raise HandoffError(f"no live handoff at {hd}")
+    if manifest.get("format") != HANDOFF_FORMAT:
+        raise HandoffError(
+            f"handoff at {hd} has format {manifest.get('format')!r}, this "
+            f"build reads format {HANDOFF_FORMAT} — fall back to the "
+            f"checkpoint")
+    by_path = {rec["path"]: rec for rec in manifest["leaves"]}
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    sh_leaves = jax.tree_util.tree_leaves(shardings)
+    out = []
+    for (path, leaf), sh in zip(flat, sh_leaves):
+        key = path_str(path)
+        rec = by_path.pop(key, None)
+        if rec is None:
+            raise HandoffError(
+                f"handoff at {hd} has no leaf for {key!r} — state "
+                f"structure changed; fall back to the checkpoint")
+        arr = np.load(os.path.join(hd, rec["file"]))
+        want = tuple(getattr(leaf, "shape", arr.shape))
+        if tuple(arr.shape) != want:
+            raise HandoffError(
+                f"handoff leaf {key!r} has shape {tuple(arr.shape)}, the "
+                f"restoring state wants {want} — fall back to the "
+                f"checkpoint")
+        got = hashlib.blake2b(np.ascontiguousarray(arr).tobytes(),
+                              digest_size=_DIGEST_SIZE).hexdigest()
+        if got != rec["digest"]:
+            raise HandoffError(
+                f"handoff leaf {key!r}: blake2b {got} does not match the "
+                f"manifest's {rec['digest']} — torn or corrupt handoff; "
+                f"fall back to the checkpoint")
+        out.append(jax.device_put(arr, sh))
+    if by_path:
+        raise HandoffError(
+            f"handoff at {hd} carries leaves the restoring state lacks: "
+            f"{sorted(by_path)} — fall back to the checkpoint")
+    return jax.tree_util.tree_unflatten(treedef, out), manifest
+
+
+def clear_handoff(directory: str | os.PathLike) -> None:
+    """Consume the handoff once ingested (idempotent)."""
+    shutil.rmtree(handoff_dir(directory), ignore_errors=True)

@@ -348,6 +348,21 @@ class InferenceEngine:
             elif isinstance(self.params_version, int):
                 self.params_version += 1
 
+    def export_params(self) -> tuple[Any, int | str]:
+        """Host-side snapshot of the serving params, ``(tree, version)``.
+
+        The peer-warm-up export: a relaunched replica imports this via
+        :meth:`swap_params` instead of walking back to the checkpoint
+        directory. Snapshot taken under the queue lock so the tree and its
+        version are from the same swap; leaves come back as numpy (they
+        must cross a process boundary by pickle)."""
+        with self._cond:
+            params = self._params
+            version = self.params_version
+        jax = self._jax
+        return jax.tree.map(lambda a: np.asarray(jax.device_get(a)),
+                            params), version
+
     # -- worker --------------------------------------------------------------
 
     def _bucket(self, n: int) -> int:

@@ -319,6 +319,40 @@ def replica_main() -> int:
                             lambda f, mid=mid: on_future(mid, f))
                     elif op == "reload":
                         reply(mid, ok=True, result=reload_fn(msg.get("step")))
+                    elif op == "export_params":
+                        # peer warm-up export: the serving weights leave as
+                        # numpy + a digest so the importer can prove the
+                        # transfer landed intact (docs/POD_PLAYBOOK.md)
+                        from distributeddeeplearningspark_tpu.parallel import (
+                            live_reshard,
+                        )
+
+                        params, version = engine.export_params()
+                        reply(mid, ok=True, result={
+                            "params": params, "version": version,
+                            "digest": live_reshard.tree_digest(params)})
+                    elif op == "import_params":
+                        from distributeddeeplearningspark_tpu.parallel import (
+                            live_reshard,
+                        )
+
+                        got = live_reshard.tree_digest(msg["params"])
+                        want = msg.get("digest")
+                        if want is not None and got != want:
+                            raise ValueError(
+                                f"import_params digest mismatch: donor sent "
+                                f"{want}, received tree hashes to {got} — "
+                                f"refusing to serve corrupted weights; "
+                                f"reload from the checkpoint instead")
+                        engine.swap_params(msg["params"],
+                                           version=msg.get("version"))
+                        telemetry_lib.emit(
+                            "recovery", event="replica-warmup",
+                            replica=replica_id, digest=got,
+                            params_version=engine.params_version)
+                        reply(mid, ok=True, result={
+                            "params_version": engine.params_version,
+                            "digest": got})
                     elif op == "shutdown":
                         reply(mid, ok=True, result=engine.stats())
                         break
@@ -473,6 +507,33 @@ class LocalReplica:
                 self.engine.swap_params(self._reload_fn(self._reloads))
                 fut.set_result(
                     {"params_version": self.engine.params_version})
+            elif op == "export_params":
+                from distributeddeeplearningspark_tpu.parallel import (
+                    live_reshard,
+                )
+
+                params, version = self.engine.export_params()
+                fut.set_result({
+                    "params": params, "version": version,
+                    "digest": live_reshard.tree_digest(params)})
+            elif op == "import_params":
+                from distributeddeeplearningspark_tpu.parallel import (
+                    live_reshard,
+                )
+
+                got = live_reshard.tree_digest(payload["params"])
+                want = payload.get("digest")
+                if want is not None and got != want:
+                    raise ValueError(
+                        f"import_params digest mismatch: donor sent {want}, "
+                        f"received tree hashes to {got} — refusing to serve "
+                        f"corrupted weights; reload from the checkpoint "
+                        f"instead")
+                self.engine.swap_params(payload["params"],
+                                        version=payload.get("version"))
+                fut.set_result({
+                    "params_version": self.engine.params_version,
+                    "digest": got})
             else:
                 raise ValueError(f"unknown op {op!r}")
         except Exception as e:  # noqa: BLE001 — protocol parity with the
@@ -648,8 +709,40 @@ class ServingFleet:
 
     # -- failure handling ----------------------------------------------------
 
+    def _warm_from_peer(self, nh) -> dict | None:
+        """Warm a relaunched replica's weights from an alive peer instead of
+        disk: export the donor's serving params (numpy + digest over the
+        socket), import them into the newcomer, which re-hashes before
+        swapping. The relaunch already serves *something* (spec seed or
+        whatever the checkpoint dir holds); this replaces it with the exact
+        tree the survivors are serving — no stale-version window, no
+        checkpoint round trip. Returns the warm-up record, or None when no
+        donor is alive or the transfer failed (the replica then keeps its
+        disk/seed params — degraded, not down)."""
+        donor = next(
+            (h for h in self.handles if h is not nh and h.alive), None)
+        if donor is None:
+            return None
+        try:
+            t0 = time.monotonic()
+            exported = donor.call("export_params",
+                                  timeout=self.startup_timeout_s)
+            rec = nh.call("import_params", params=exported["params"],
+                          version=exported["version"],
+                          digest=exported["digest"],
+                          timeout=self.startup_timeout_s)
+            return {"donor": donor.name,
+                    "wall_s": round(time.monotonic() - t0, 6),
+                    **(rec or {})}
+        except Exception:  # noqa: BLE001 — warm-up is best-effort: a failed
+            # transfer must not turn one dead replica into two
+            logger.exception("fleet: warm-up of %s from peer failed; "
+                             "serving its own restore", nh.name)
+            return None
+
     def restart_dead(self, router: Router | None = None) -> list[str]:
-        """Relaunch every dead replica (bumped ``DLS_RESTART`` ordinal) and
+        """Relaunch every dead replica (bumped ``DLS_RESTART`` ordinal),
+        warm its weights from an alive peer (:meth:`_warm_from_peer`), and
         swap the new handle into the router. Returns restarted names."""
         restarted = []
         for i, h in enumerate(self.handles):
@@ -663,13 +756,21 @@ class ServingFleet:
             proc, port, key = self._spawn(i)
             nh = self._connect(i, proc, port, key)
             nh.call("ping", timeout=self.startup_timeout_s)
+            warm = self._warm_from_peer(nh)
             self.handles[i] = nh
             if router is not None:
                 router.replace(nh)
             if self._tele is not None:
                 self._tele.recovery(None, "replica-restart",
                                     replica=nh.name, returncode=rc,
-                                    ordinal=self._ordinals[i])
+                                    ordinal=self._ordinals[i],
+                                    warmed_from=(warm or {}).get("donor"))
+                if warm is not None:
+                    self._tele.recovery(
+                        None, "replica-warmup", replica=nh.name,
+                        donor=warm["donor"], wall_s=warm["wall_s"],
+                        digest=warm.get("digest"),
+                        params_version=warm.get("params_version"))
             restarted.append(nh.name)
         return restarted
 

@@ -605,6 +605,17 @@ class ContinuousGenerator:
             elif isinstance(self.params_version, int):
                 self.params_version += 1
 
+    def export_params(self) -> tuple[Any, int | str]:
+        """Host-side snapshot of the serving params, ``(tree, version)`` —
+        same contract as :meth:`.engine.InferenceEngine.export_params`
+        (peer warm-up export; numpy leaves, version from the same swap)."""
+        with self._cond:
+            params = self._params
+            version = self.params_version
+        jax = self._jax
+        return jax.tree.map(lambda a: np.asarray(jax.device_get(a)),
+                            params), version
+
     # -- serving loop --------------------------------------------------------
 
     def _bucket(self, n: int) -> int:

@@ -256,6 +256,42 @@ def shuffle_from(events: list[dict]) -> dict | None:
     }
 
 
+def reshard_from(events: list[dict]) -> dict | None:
+    """Fold ``reshard`` recovery events into one block, or None when the
+    run never resharded. The split the operator cares about is transport:
+    ``collectives``/``handoff`` moves are checkpoint-free (the run kept its
+    current step), ``checkpoint`` moves are restore-time walk-backs. Totals
+    sum every move; ``last`` keeps the newest move whole."""
+    moves = [e for e in events if e.get("kind") == "recovery"
+             and e.get("event") == "reshard"]
+    if not moves:
+        return None
+    live = [e for e in moves if not e.get("walk_back")]
+    last = moves[-1]
+    return {
+        "moves": len(moves),
+        "live_moves": len(live),
+        "walk_back_moves": len(moves) - len(live),
+        "bytes_moved": sum(int(e.get("bytes_moved", 0) or 0) for e in moves),
+        "by_transport": {
+            t: sum(e.get("transport") == t for e in moves)
+            for t in ("collectives", "handoff", "checkpoint")},
+        "last": {
+            "step": last.get("step"),
+            "transport": last.get("transport"),
+            "walk_back": bool(last.get("walk_back")),
+            "reason": last.get("reason"),
+            "bytes_moved": last.get("bytes_moved"),
+            "rounds": last.get("rounds"),
+            "peak_inflight_bytes": last.get("peak_inflight_bytes"),
+            "mem_budget_mb": last.get("mem_budget_mb"),
+            "wall_s": last.get("wall_s"),
+            "leaves_moved": last.get("leaves_moved"),
+            "verified": last.get("verified"),
+        },
+    }
+
+
 def report(workdir: str, *, now: float | None = None,
            hosts: bool = False, fleet_serve: bool = False,
            traces: bool = False, slo_target: float | None = None,
@@ -310,6 +346,7 @@ def report(workdir: str, *, now: float | None = None,
         "goodput": telemetry.goodput(events),
         "input_workers": input_workers_from(events),
         "shuffle": shuffle_from(events),
+        "reshard": reshard_from(events),
         "serving": serving_from(events),
         "attempts": attempts_from(events),
         "recovery_events": [e for e in events if e.get("kind") == "recovery"],
@@ -684,6 +721,32 @@ def render(rep: dict) -> str:
         lines.append(
             f"  bucket rows max={last['bucket_rows_max']} "
             f"mean={last['bucket_rows_mean']}  verdict: {last['verdict']}")
+    rs = rep.get("reshard")
+    if rs:
+        last = rs["last"]
+        lines.append("")
+        lines.append(
+            f"resharding: {rs['moves']} move(s)  "
+            f"live={rs['live_moves']}  walk-back={rs['walk_back_moves']}  "
+            f"moved={rs['bytes_moved'] / 1e6:.1f}MB")
+        mode = ("walk-back (checkpoint)" if last["walk_back"]
+                else "checkpoint-free (live)")
+        lines.append(
+            f"  last move: {mode} transport={last.get('transport')} "
+            f"step={last.get('step', '-')}"
+            + (f" reason={last['reason']}" if last.get("reason") else "")
+            + (f" moved={last['bytes_moved'] / 1e6:.1f}MB"
+               if last.get("bytes_moved") is not None else "")
+            + (f" rounds={last['rounds']}"
+               if last.get("rounds") is not None else "")
+            + (f" peak={last['peak_inflight_bytes'] / 1e6:.1f}MB"
+               f"/{last['mem_budget_mb']:.0f}MB budget"
+               if last.get("peak_inflight_bytes") is not None
+               and last.get("mem_budget_mb") is not None else "")
+            + (f" wall={_fmt_s(last['wall_s'])}"
+               if last.get("wall_s") is not None else "")
+            + ("" if last.get("verified") is None
+               else f" verified={str(bool(last['verified'])).lower()}"))
     sv = rep.get("serving")
     if sv:
         lines.append("")
@@ -732,6 +795,13 @@ def render(rep: dict) -> str:
         # an elastic run's shrinks, summarized where the operator looks
         # first: one line per geometry change, between the attempt rows
         # it separates (the events also appear in the recovery list below)
+        drains = [e for e in rep["recovery_events"]
+                  if e.get("event") == "graceful_shutdown"]
+        for e in drains:
+            lines.append(
+                f"  graceful shutdown: host {e.get('dead_host')} drained at "
+                f"step {e.get('step', '-')} (attempt "
+                f"#{e.get('ordinal', '-')}) — handed off live, no backoff")
         geo = [e for e in rep["recovery_events"]
                if e.get("event") == "geometry_change"]
         for e in geo:
@@ -740,7 +810,8 @@ def render(rep: dict) -> str:
                 f"{e.get('to_processes')} host(s) after "
                 f"{e.get('evidence_attempts')} attempt(s) blamed host "
                 f"{e.get('dead_host')}; survivors {e.get('hosts')}, "
-                f"resume step {e.get('step', '-')}, batch "
+                f"resume step {e.get('step', '-')} "
+                f"({e.get('resume', 'checkpoint')}), batch "
                 f"{e.get('batch_policy')}")
     if rep["recovery_events"]:
         lines.append("")

@@ -46,11 +46,57 @@ logger = logging.getLogger("distributeddeeplearningspark_tpu.supervisor")
 #: instead of burning ``max_restarts`` on a poisoned checkpoint.
 RESTORE_FAILED_EXIT = 13
 
+#: Evidence file a gracefully draining gang leaves in the checkpoint root:
+#: ``"<doomed_host> <drained_step>"``. Written by the trainer's SIGTERM
+#: drain (after the live handoff commits), read by :meth:`Supervisor._classify`
+#: to tell "the gang exited zero because it DRAINED" from "the gang finished"
+#: — without it a graceful preemption would look like success (or, had the
+#: drain path exited non-zero, burn a backoff slot as a training-crash).
+DRAIN_EVIDENCE = "DRAIN"
+
 
 def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def drain_evidence_path(directory: str) -> str:
+    return os.path.join(directory, DRAIN_EVIDENCE)
+
+
+def write_drain_evidence(directory: str, *, host: int, step: int) -> str:
+    """Atomically record a graceful drain: the doomed host ordinal and the
+    step training completed before handing off. The trainer writes this
+    LAST (after the live handoff is fully committed) so its existence
+    implies an ingestible handoff."""
+    path = drain_evidence_path(directory)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{int(host)} {int(step)}\n")
+    os.replace(tmp, path)
+    return path
+
+
+def read_drain_evidence(directory: str) -> tuple[int, int] | None:
+    """``(doomed_host, drained_step)`` or None (absent/torn evidence)."""
+    try:
+        with open(drain_evidence_path(directory)) as f:
+            host, step = f.read().split()
+        return int(host), int(step)
+    except (OSError, ValueError):
+        return None
+
+
+def consume_drain_evidence(directory: str, *, ordinal: int) -> None:
+    """Retire the evidence once acted on (kept beside the stream as
+    ``DRAIN.consumed-<ordinal>`` for post-incident forensics) so a later
+    attempt's clean exit is never misread as another drain."""
+    path = drain_evidence_path(directory)
+    try:
+        os.replace(path, f"{path}.consumed-{ordinal}")
+    except OSError:
+        pass
 
 
 @dataclasses.dataclass
@@ -61,8 +107,9 @@ class Attempt:
     returncodes: list[int]
     duration_s: float
     #: Failure class: "clean" | "training-crash" | "restore-failure" | "hang"
-    #: (see :meth:`Supervisor._classify`). Drives the restart strategy and
-    #: gives operators one log line naming WHICH recovery path fired.
+    #: | "graceful-shutdown" (see :meth:`Supervisor._classify`). Drives the
+    #: restart strategy and gives operators one log line naming WHICH
+    #: recovery path fired.
     classification: str = ""
     #: Whether any progress evidence (heartbeat/checkpoint mtime) appeared
     #: during the attempt — the signal separating "crashed at restore" from
@@ -83,7 +130,10 @@ class Attempt:
 
     @property
     def ok(self) -> bool:
-        return all(rc == 0 for rc in self.returncodes)
+        # a graceful drain also exits all-zero — it is a handoff, not a
+        # completion, and must not end the run
+        return (all(rc == 0 for rc in self.returncodes)
+                and self.classification != "graceful-shutdown")
 
 
 @dataclasses.dataclass
@@ -362,7 +412,15 @@ class Supervisor:
         restore". Without progress tracking (no progress_path/heartbeats)
         the circumstantial branch stays quiet: ``made_progress`` is then
         reported True to avoid misclassifying.
+
+        ``graceful-shutdown`` is evidence-driven, not code-driven: a drained
+        gang exits all-zero (it would read as "clean" — run over) and a
+        drain raced by the kill path could exit non-zero (it would read as
+        "training-crash" and burn a backoff slot). The DRAIN file the
+        trainer writes after committing the live handoff overrides both.
         """
+        if self._drain_evidence() is not None:
+            return "graceful-shutdown"
         if all(c == 0 for c in codes):
             return "clean"
         if hang:
@@ -372,6 +430,13 @@ class Supervisor:
         if ordinal > 0 and not made_progress and self._has_checkpoint():
             return "restore-failure"
         return "training-crash"
+
+    def _drain_evidence(self) -> tuple[int, int] | None:
+        """``(doomed_host, drained_step)`` when a graceful drain left its
+        evidence in the checkpoint root; None otherwise."""
+        if not self.ckpt_dir:
+            return None
+        return read_drain_evidence(self.ckpt_dir)
 
     def _dead_host_from(self, culprit: dict | None,
                         first_failed: list[int] | None) -> int | None:
@@ -518,38 +583,43 @@ class Supervisor:
         if tele is not None:
             tele.recovery(step, "restore-fallback", directory=self.ckpt_dir)
 
-    def _shrink(self, dead_host: int, *, streak: int) -> None:
+    def _shrink(self, dead_host: int, *, streak: int,
+                resume_step: int | None = None,
+                resume: str = "checkpoint") -> None:
         """Drop ``dead_host`` from the gang and re-plan onto the survivors.
 
         The destructive half of elasticity that is NOT destructive to state:
         nothing is quarantined or deleted — the next attempt restores the
-        last verified checkpoint through the reshard-on-restore path, on a
-        gang one host narrower. One ``geometry_change`` recovery record ties
-        the evidence (dead host, streak) to the action (new geometry,
+        last verified checkpoint through the reshard-on-restore path (or,
+        after a graceful drain, ingests the live handoff and resumes from
+        the CURRENT step — ``resume="live-handoff"``), on a gang one host
+        narrower. One ``geometry_change`` recovery record ties the evidence
+        (dead host, streak) to the action (new geometry, resume source,
         batch policy) for ``dlstatus`` and the span model."""
         from distributeddeeplearningspark_tpu.checkpoint import latest_step_in
 
         old_n = self.num_processes
         self._hosts.remove(dead_host)
         self.num_processes = len(self._hosts)
-        resume_step = (latest_step_in(self.ckpt_dir)
-                       if self.ckpt_dir else None)
+        if resume_step is None:
+            resume_step = (latest_step_in(self.ckpt_dir)
+                           if self.ckpt_dir else None)
         # advisory for workers that want to log/scale on it; the feed math
         # already preserves the global batch by splitting it n-1 ways
         self.env["DLS_ELASTIC_GEOMETRY"] = f"{old_n}:{self.num_processes}"
         logger.warning(
             "shrink-to-survive: host %d blamed by %d consecutive failed "
             "attempt(s) — re-planning the gang %d -> %d process(es) "
-            "(survivors: %s), resuming from checkpoint step %s",
+            "(survivors: %s), resuming from %s step %s",
             dead_host, streak, old_n, self.num_processes, self._hosts,
-            resume_step)
+            resume, resume_step)
         tele = self._telemetry()
         if tele is not None:
             tele.recovery(
                 resume_step, "geometry_change", dead_host=dead_host,
                 evidence_attempts=streak, from_processes=old_n,
                 to_processes=self.num_processes, hosts=list(self._hosts),
-                batch_policy="preserve_global")
+                batch_policy="preserve_global", resume=resume)
 
     def run(self) -> SupervisorResult:
         attempts: list[Attempt] = []
@@ -567,6 +637,36 @@ class Supervisor:
                         ordinal, attempt.duration_s, ordinal,
                     )
                     return SupervisorResult(attempts)
+                if attempt.classification == "graceful-shutdown":
+                    # a drain is a handoff, not a failure: shrink NOW on the
+                    # evidence (no K-attempt streak — the gang told us who is
+                    # leaving), resume from the DRAINED step via the live
+                    # handoff, and burn no backoff slot relaunching
+                    evidence = self._drain_evidence()
+                    host, drain_step = (evidence if evidence
+                                        else (attempt.dead_host, None))
+                    if self.ckpt_dir:
+                        consume_drain_evidence(self.ckpt_dir, ordinal=ordinal)
+                    tele = self._telemetry()
+                    if tele is not None:
+                        tele.recovery(
+                            drain_step, "graceful_shutdown", ordinal=ordinal,
+                            dead_host=host, drained=True,
+                            returncodes=attempt.returncodes)
+                    if ordinal >= self.max_restarts:
+                        break  # notice arrived with no relaunch budget left
+                    logger.warning(
+                        "attempt %d drained gracefully at step %s (host %s "
+                        "preempted); shrinking and relaunching from the "
+                        "live handoff without backoff",
+                        ordinal, drain_step, host)
+                    if (host is not None and host in self._hosts
+                            and self.num_processes > self.min_processes):
+                        self._shrink(host, streak=0, resume_step=drain_step,
+                                     resume="live-handoff")
+                    streak_host, streak = None, 0
+                    backoff_ordinal = 0
+                    continue
                 if attempt.dead_host is not None and attempt.dead_host == streak_host:
                     streak += 1
                 elif attempt.dead_host is not None:

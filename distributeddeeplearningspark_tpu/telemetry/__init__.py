@@ -22,14 +22,24 @@ present):
   ``eval``. Other names (``run``, ``manifest``, ``profile-trace``) are
   informational.
 - ``recovery`` — a recovery action fired: ``event`` ("skip", "rollback",
-  "restart", "restore-fallback", "geometry_change", "reshard", ...) plus
-  free-form evidence fields. ``geometry_change`` is the supervisor's
-  elastic shrink (``dead_host``, ``evidence_attempts``,
+  "restart", "restore-fallback", "geometry_change", "graceful_shutdown",
+  "reshard", ...) plus free-form evidence fields. ``geometry_change`` is
+  the supervisor's elastic shrink (``dead_host``, ``evidence_attempts``,
   ``from_processes``/``to_processes``, surviving ``hosts``,
-  ``batch_policy``; ``step`` is the checkpoint the survivors resume
-  from); ``reshard`` is the checkpoint layer restoring across
-  topologies (``from_mesh``/``to_mesh``, ``from_devices``/``to_devices``,
-  ``from_processes``/``to_processes``).
+  ``batch_policy``; ``step`` is where the survivors resume and ``resume``
+  says how — "checkpoint" walk-back or "live-handoff" continuation);
+  ``graceful_shutdown`` is a drained preemption exit (``dead_host``,
+  ``ordinal``, ``step`` = the drain step — no backoff slot burned);
+  ``reshard`` is one state move across layouts. Every reshard carries
+  ``transport`` ("checkpoint" = restore-time re-projection, "collectives"
+  = live all-to-all between steps, "handoff" = ingest of a drained
+  host's persisted live state) and ``walk_back`` (True only for the
+  checkpoint path — the run rewound to a saved step). Live paths add the
+  engine's measured evidence: ``bytes_moved``, ``rounds``,
+  ``peak_inflight_bytes``, ``mem_budget_mb``, ``wall_s``,
+  ``leaves_moved``, ``verified``; the checkpoint path keeps its
+  topology record (``from_mesh``/``to_mesh``, ``from_devices``/
+  ``to_devices``, ``from_processes``/``to_processes``).
 - ``attempt`` — supervisor gang lifecycle: ``edge`` ("begin"/"end"/
   "backoff"), ``ordinal``, ``num_processes`` (+ ``hosts``, the surviving
   original host ordinals, on begin), and on end ``returncodes``/

@@ -148,6 +148,10 @@ class Trainer:
             from distributeddeeplearningspark_tpu.train.embed import dense_trainable
 
             optimizer = optim.masked(optimizer, dense_trainable(self.sparse_embed))
+        # the unwrapped (post-masking) optimizer is kept so apply_plan can
+        # re-wrap it under a NEW plan's ZeRO layout without asking the
+        # caller to re-thread it
+        self._optimizer = optimizer
         # ZeRO plans pin the gradient layout replicated inside tx.update
         # (bitwise parity with the replicated optimizer — see
         # Plan.wrap_optimizer); a no-op for plans without zero_axes
@@ -179,6 +183,10 @@ class Trainer:
         # device-side skip guard (fit(on_nonfinite="skip")) — set before
         # init() builds the jitted step, or fit() rebuilds it on change
         self._guard_nonfinite = False
+        # step at which a graceful preemption drain ended fit() early (the
+        # worker script keys its exit path off this — a drained run must
+        # not write DONE or a final checkpoint)
+        self.preempted_at: int | None = None
 
     # -- setup --------------------------------------------------------------
 
@@ -191,6 +199,14 @@ class Trainer:
         if self.mutable_keys == () and self.state.mutable:
             self.mutable_keys = tuple(self.state.mutable.keys())
         self._build_train_step()
+        self._build_aux_steps()
+        logger.info("initialized %s params over mesh %s",
+                    f"{self.state.num_params:,}", dict(self.mesh.shape))
+        return self.state
+
+    def _build_aux_steps(self) -> None:
+        """(Re)compile the eval/predict steps against the CURRENT
+        (shardings, plan) — shared by init() and apply_plan()."""
         ev = step_lib.make_eval_step(self._apply_fn(), self.loss_fn)
         self._eval_step = step_lib.jit_eval_step(
             ev, self.mesh, self.state_shardings,
@@ -200,9 +216,6 @@ class Trainer:
             step_lib.make_predict_step(self._apply_fn()),
             self.mesh, self.state_shardings,
         )
-        logger.info("initialized %s params over mesh %s",
-                    f"{self.state.num_params:,}", dict(self.mesh.shape))
-        return self.state
 
     def _build_train_step(self) -> None:
         """(Re)compile the jitted train step from the current trainer config
@@ -369,6 +382,154 @@ class Trainer:
         )
         logger.info("resumed at step %d", int(jax.device_get(self.state.step)))
         return self.state, data_state
+
+    def restore_live_handoff(self, checkpointer=None):
+        """Resume from a graceful drain's live handoff — the CURRENT step,
+        not the last checkpoint (no walk-back).
+
+        Ingests the digest-verified raw blocks a draining gang left beside
+        the checkpoints (:func:`..parallel.live_reshard.save_handoff`)
+        directly onto THIS trainer's shardings, consumes the handoff, and
+        returns ``(state, data_state)`` exactly like :meth:`restore`.
+        Raises :class:`..parallel.live_reshard.HandoffError` on any
+        digest/structure mismatch — the caller falls back to the
+        checkpoint. Call after ``init()``.
+        """
+        import time
+
+        from distributeddeeplearningspark_tpu.parallel import live_reshard
+
+        ckpt = checkpointer or self.checkpointer
+        self._telemetry(ckpt)
+        if ckpt is None:
+            raise RuntimeError(
+                "Trainer.restore_live_handoff: no checkpointer configured — "
+                "the handoff lives in its directory")
+        if self.state is None:
+            raise RuntimeError(
+                "Trainer.restore_live_handoff: state is uninitialized — "
+                "call init() (with a sample batch) before restoring")
+        t0 = time.perf_counter()
+        self.state, manifest = live_reshard.load_handoff(
+            ckpt.directory, self.state, self.state_shardings)
+        step = int(manifest["step"])
+        stats = live_reshard.TransferStats(
+            leaves=len(manifest["leaves"]),
+            leaves_moved=len(manifest["leaves"]),
+            bytes_moved=sum(int(x.nbytes) for x in
+                            jax.tree_util.tree_leaves(self.state)),
+            mem_budget_bytes=live_reshard.memory_budget_bytes(),
+            wall_s=time.perf_counter() - t0, verified=True)
+        stats.bytes_total = stats.bytes_moved
+        live_reshard.emit_reshard_event(
+            stats, step=step, transport="handoff", walk_back=False,
+            reason="preemption-resume")
+        live_reshard.clear_handoff(ckpt.directory)
+        logger.info("resumed from live handoff at step %d (checkpoint-free, "
+                    "no walk-back)", step)
+        return self.state, manifest.get("data_state")
+
+    def apply_plan(self, plan: "plan_lib.Plan", *,
+                   verify: bool = True):
+        """Apply a plan (e.g. a serialized ``plan_sweep`` winner) LIVE
+        between steps — no restart, no checkpoint round-trip.
+
+        The state is re-projected onto the new plan's shardings by the
+        bounded live-reshard engine (:mod:`..parallel.live_reshard`,
+        blake2b-verified when ``verify``), the optimizer re-wrapped under
+        the new plan's ZeRO layout, and train/eval/predict recompiled
+        through the same ``compile_step_with_plan`` path ``init()`` uses —
+        so the trajectory thereafter is bitwise identical to a restart
+        pinned to the same plan. Returns the engine's
+        :class:`~..parallel.live_reshard.TransferStats`.
+        """
+        if self.state is None:
+            raise RuntimeError("init() the trainer before apply_plan() — "
+                               "there is no live state to re-project yet")
+        if plan.style != "jit":
+            raise plan_lib.PlanValidationError(
+                f"Trainer requires a style='jit' plan; plan {plan.name!r} "
+                f"has style={plan.style!r} (shard_map plans need step "
+                f"bodies with explicit collectives — compile those via "
+                f"compile_step_with_plan directly)")
+        plan.validate(self.mesh)
+        if plan.model_hints:
+            logger.warning(
+                "plan %r carries model hints %s: apply_plan cannot rebuild "
+                "the model — the live trajectory only matches the sweep's "
+                "ranked number if the model was built with them",
+                plan.name, plan.hints())
+        from distributeddeeplearningspark_tpu import checkpoint as ckpt_lib
+        from distributeddeeplearningspark_tpu.parallel import live_reshard
+
+        old = self.plan
+        targets = plan.state_shardings(ckpt_lib.abstract_like(self.state),
+                                       self.mesh)
+        self.state, stats = live_reshard.redistribute(
+            self.state, targets, verify=verify)
+        self.state_shardings = targets
+        self.plan = plan
+        self.rules = plan.rules
+        self.tx = plan.wrap_optimizer(self._optimizer, self.mesh)
+        self._build_train_step()
+        self._build_aux_steps()
+        self._telemetry()
+        live_reshard.emit_reshard_event(
+            stats, step=int(jax.device_get(self.state.step)),
+            transport="collectives", walk_back=False, reason="apply-plan",
+            from_plan=old.name, to_plan=plan.name,
+            from_signature=old.signature(), to_signature=plan.signature())
+        logger.info(
+            "applied plan %r live (was %r): moved %d/%d leaves, %.1f MiB in "
+            "%d bounded round(s), %.3fs — steps recompiled, no restart",
+            plan.name, old.name, stats.leaves_moved, stats.leaves,
+            stats.bytes_moved / 2**20, stats.rounds, stats.wall_s)
+        return stats
+
+    def _graceful_drain(self, step: int, *, examples_seen: int,
+                        batch_size: int) -> None:
+        """Honor a preemption notice (``DLS_FAULT=sigterm@N``): the
+        in-flight step is drained, the doomed host's live shards are
+        re-gathered onto the survivors-hold-everything layout (every leaf
+        replicated) by the bounded engine, the state is committed as a
+        digest-verified live handoff beside the checkpoints, and the DRAIN
+        evidence file is written LAST so the supervisor only ever sees
+        evidence backed by an ingestible handoff. Hard kills (die_host)
+        never reach here — they still walk back through the checkpoint."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from distributeddeeplearningspark_tpu import supervisor as sup_lib
+        from distributeddeeplearningspark_tpu.parallel import live_reshard
+
+        if self.checkpointer is None:
+            raise RuntimeError(
+                "graceful preemption drain needs a checkpointer: its "
+                "directory carries the live handoff the shrunk gang "
+                "resumes from")
+        doomed = faults.fault_host()
+        jax.block_until_ready(self.state.params)  # drain the in-flight step
+        targets = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, PartitionSpec()),
+            self.state_shardings)
+        self.state, stats = live_reshard.redistribute(self.state, targets)
+        self.state_shardings = targets
+        live_reshard.emit_reshard_event(
+            stats, step=step, transport="collectives", walk_back=False,
+            reason="preemption-drain", dead_host=doomed)
+        live_reshard.save_handoff(
+            self.checkpointer.directory, step, self.state,
+            data_state={"examples_seen": examples_seen,
+                        "batch_size": batch_size},
+            stats=stats)
+        sup_lib.write_drain_evidence(
+            self.checkpointer.directory, host=doomed, step=step)
+        self.preempted_at = step
+        logger.warning(
+            "graceful drain at step %d: host %d preempted — live handoff "
+            "committed (%d leaves, %.1f MiB gathered in %d round(s)); "
+            "exiting clean for the supervisor to shrink without walk-back",
+            step, doomed, stats.leaves, stats.bytes_moved / 2**20,
+            stats.rounds)
 
     def _telemetry(self, checkpointer=None) -> "telemetry_lib.EventWriter | None":
         """The run's event writer, or None when no workdir is resolvable.
@@ -568,6 +729,10 @@ class Trainer:
         # target must not train — the machine it stands in for is gone
         faults.die_if_dead_host_on_relaunch()
         fault = faults.get()
+        # the graceful-preemption notice is scoped out of get(): every rank
+        # consults it (the trainer coordinates the drain no matter which
+        # host is doomed — survivors are the ones re-gathering shards)
+        preempt = faults.sigterm_fault()
         skipped_dev = None  # device-side cumulative skip count (stays async)
         n_skipped = 0
         rollbacks = 0
@@ -740,6 +905,16 @@ class Trainer:
                     sanitize.assert_replicas_in_sync(self.state.params)
                 for cb in callbacks:
                     cb(step_i, last_metrics)
+                if preempt is not None and step_i >= preempt.step:
+                    # preemption notice: drain (the step above completed),
+                    # hand off live state, exit BEFORE any further
+                    # checkpoint write — the resume point is THIS step
+                    self._graceful_drain(
+                        step_i,
+                        examples_seen=(step_i + rolled_back_batches)
+                        * batch_size,
+                        batch_size=batch_size)
+                    break
                 if checkpoint_every and self.checkpointer and step_i % checkpoint_every == 0:
                     self.checkpointer.save(
                         step_i, self.state,
@@ -792,7 +967,11 @@ class Trainer:
                                "(on_nonfinite='skip')", n_skipped)
         elif on_nonfinite == "rollback":
             summary["rollbacks"] = float(rollbacks)
-        if self.checkpointer and checkpoint_every:
+        if (self.checkpointer and checkpoint_every
+                and self.preempted_at is None):
+            # a drained run already committed its live handoff; a final
+            # checkpoint here would advance the walk-back point past the
+            # handoff and muddy the "no walk-back" resume invariant
             self.checkpointer.save(
                 step_i, self.state,
                 data_state={"examples_seen":

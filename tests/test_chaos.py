@@ -115,6 +115,70 @@ def test_die_host_fault_gating(monkeypatch):
             faults.parse(bad)
 
 
+def test_sigterm_fault_scoping(monkeypatch):
+    """sigterm is a preemption NOTICE, not a crash: faults.get() never
+    returns it (non-trainer callers must not mistake it for a kill), the
+    trainer's scoped accessor does — on attempt 0 only, with the doomed
+    host's env knob validated eagerly."""
+    monkeypatch.setenv("DLS_FAULT", "sigterm@9")
+    monkeypatch.delenv("DLS_RESTART", raising=False)
+    monkeypatch.delenv("DLS_FAULT_HOST", raising=False)
+    monkeypatch.delenv("DLS_FAULT_ALL_ATTEMPTS", raising=False)
+    assert faults.get() is None
+    assert faults.sigterm_fault() == faults.Fault("sigterm", 9)
+    # the shrunk relaunch must run clean …
+    monkeypatch.setenv("DLS_RESTART", "1")
+    assert faults.sigterm_fault() is None
+    # … unless the drill opts into give-up testing
+    monkeypatch.setenv("DLS_FAULT_ALL_ATTEMPTS", "1")
+    assert faults.sigterm_fault() == faults.Fault("sigterm", 9)
+    monkeypatch.delenv("DLS_FAULT_ALL_ATTEMPTS")
+    monkeypatch.delenv("DLS_RESTART")
+    # other kinds don't leak through the scoped accessor
+    monkeypatch.setenv("DLS_FAULT", "crash@3")
+    assert faults.sigterm_fault() is None
+    # a typo'd doomed-host knob fails loudly at consult time
+    monkeypatch.setenv("DLS_FAULT", "sigterm@9")
+    monkeypatch.setenv("DLS_FAULT_HOST", "frobnicate")
+    with pytest.raises(ValueError, match="DLS_FAULT_HOST"):
+        faults.sigterm_fault()
+    for bad in ("sigterm@0", "sigterm@", "sigterm@x"):
+        with pytest.raises(ValueError):
+            faults.parse(bad)
+
+
+def test_drain_evidence_roundtrip_and_classification(tmp_path):
+    """The DRAIN evidence file protocol: written atomically, read back as
+    (host, step), consumed to a forensic rename — and it overrides BOTH the
+    all-zero "clean" read and the non-zero "training-crash" read in the
+    supervisor's classifier (a drain is a handoff, not a completion)."""
+    import sys as _sys
+
+    from distributeddeeplearningspark_tpu import supervisor as sup_lib
+
+    assert sup_lib.read_drain_evidence(tmp_path) is None
+    sup_lib.write_drain_evidence(tmp_path, host=1, step=9)
+    assert sup_lib.read_drain_evidence(tmp_path) == (1, 9)
+
+    sup = Supervisor([_sys.executable, "-c", "pass"], num_processes=2,
+                     ckpt_dir=str(tmp_path))
+    # all-zero exits would otherwise read "clean" and END the run
+    assert sup._classify([0, 0], ordinal=0, hang=False,
+                         made_progress=True) == "graceful-shutdown"
+    # a drain raced by the kill path must not burn a backoff slot either
+    assert sup._classify([0, -15], ordinal=0, hang=False,
+                         made_progress=True) == "graceful-shutdown"
+    attempt = sup_lib.Attempt(ordinal=0, returncodes=[0, 0], duration_s=1.0,
+                              classification="graceful-shutdown")
+    assert not attempt.ok  # a handoff is not a completion
+
+    sup_lib.consume_drain_evidence(tmp_path, ordinal=0)
+    assert sup_lib.read_drain_evidence(tmp_path) is None
+    assert os.path.exists(tmp_path / "DRAIN.consumed-0")
+    assert sup._classify([0, 0], ordinal=0, hang=False,
+                         made_progress=True) == "clean"
+
+
 # -- drill 1: SIGKILL mid-checkpoint-finalize --------------------------------
 
 
@@ -393,6 +457,102 @@ def test_die_host_shrinks_gang_and_training_continues(tmp_path):
     clean_losses = _losses_by_step(clean)
     common = sorted(set(drill_losses) & set(clean_losses))
     assert common and common[-1] == 24, (drill_losses, clean_losses)
+    for s in common:
+        assert drill_losses[s] == pytest.approx(clean_losses[s], rel=1e-6), (
+            s, drill_losses[s], clean_losses[s])
+
+
+@pytest.mark.slow
+def test_sigterm_drains_and_continues_from_current_step(tmp_path):
+    """THE graceful-preemption drill (ISSUE 16): DLS_FAULT=sigterm@9 is a
+    preemption NOTICE for host 1 of a 2-host gang. The doomed rank drains
+    its in-flight step, the state is re-gathered live and handed off, the
+    gang exits clean — and the supervisor classifies it graceful-shutdown
+    (not training-crash), shrinks IMMEDIATELY (no repeat-evidence wait, no
+    backoff slot), and the relaunch continues from the CURRENT step via
+    the handoff: checkpoint-free, no walk-back, loss trajectory matching
+    an unfaulted run. (die_host keeps its checkpoint walk-back — the drill
+    above.)"""
+    wd = tmp_path / "run"
+    wd.mkdir()
+    sup = Supervisor(
+        [sys.executable, WORKER, "elastic", "--ckpt-dir", str(wd),
+         "--steps", "18", "--checkpoint-every", "6"],
+        num_processes=2, max_restarts=4, restart_backoff_s=0.05,
+        backoff_jitter=0.0, shrink_after=2,
+        env={**_CLEAN_ENV, "DLS_FAULT": "sigterm@9"},
+        progress_path=str(wd),
+    )
+    result = sup.run()
+    assert result.ok, (
+        f"attempts: {[(a.ordinal, a.returncodes, a.classification) for a in result.attempts]}")
+    # ONE drain, ONE relaunch — no dead-host repeat evidence needed
+    assert result.restarts == 1
+    assert [a.num_processes for a in result.attempts] == [2, 1]
+    assert result.attempts[0].classification == "graceful-shutdown"
+    assert result.attempts[0].returncodes == [0, 0]
+    step, attempt, nprocs = open(wd / "DONE").read().split()
+    assert (int(step), int(attempt), int(nprocs)) == (18, 1, 1)
+    # the evidence file was consumed to its forensic rename
+    assert not os.path.exists(wd / "DRAIN")
+    assert os.path.exists(wd / "DRAIN.consumed-0")
+
+    events = telemetry.read_events(wd)
+    # first-class graceful_shutdown event at the drained step
+    gs = [e for e in events if e.get("kind") == "recovery"
+          and e.get("event") == "graceful_shutdown"]
+    assert len(gs) == 1
+    assert gs[0]["step"] == 9 and gs[0]["dead_host"] == 1
+    assert gs[0]["drained"] is True
+    # the shrink resumed from the DRAIN step via the live handoff —
+    # not from a checkpoint walk-back
+    geo = _geometry_changes(wd)
+    assert len(geo) == 1, geo
+    assert geo[0]["resume"] == "live-handoff"
+    assert geo[0]["step"] == 9
+    assert geo[0]["dead_host"] == 1
+    assert geo[0]["from_processes"] == 2 and geo[0]["to_processes"] == 1
+    # reshard telemetry: the drain's live re-gather + the relaunch's
+    # handoff ingest; NOTHING walked back through a checkpoint
+    rs = [e for e in events if e.get("kind") == "recovery"
+          and e.get("event") == "reshard"]
+    assert any(e["transport"] == "collectives"
+               and e.get("reason") == "preemption-drain" for e in rs), rs
+    assert any(e["transport"] == "handoff"
+               and e.get("reason") == "preemption-resume" for e in rs), rs
+    assert not any(e.get("walk_back") for e in rs), rs
+    # no step ran twice: drain at 9, resume at 10 — checkpoint-free
+    seen = [int(e["step"]) for e in events
+            if e.get("kind") == "step_metrics"]
+    assert len(seen) == len(set(seen)), sorted(seen)
+    # no backoff slot burned on the graceful path
+    assert not any(e.get("kind") == "attempt" and e.get("edge") == "backoff"
+                   for e in events)
+
+    # dlstatus explains the incident: graceful line, reshard block, np 2->1
+    rep = status.report(str(wd))
+    assert rep["reshard"]["live_moves"] >= 2
+    assert rep["reshard"]["walk_back_moves"] == 0
+    rendered = status.render(rep)
+    assert "graceful shutdown: host 1" in rendered, rendered
+    assert "checkpoint-free (live)" in rendered, rendered
+
+    # loss trajectory: the whole drill run must match an unfaulted 1-host
+    # run step for step (the drain/handoff must not perturb training)
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    sup2 = Supervisor(
+        [sys.executable, WORKER, "elastic", "--ckpt-dir", str(clean),
+         "--steps", "18", "--checkpoint-every", "6"],
+        num_processes=1, max_restarts=0, env=_CLEAN_ENV,
+        progress_path=str(clean),
+    )
+    assert sup2.run().ok
+    drill_losses = _losses_by_step(wd)
+    clean_losses = _losses_by_step(clean)
+    common = sorted(set(drill_losses) & set(clean_losses))
+    assert common and common[-1] == 18, (drill_losses, clean_losses)
+    assert any(s > 9 for s in common)  # post-drain steps are compared
     for s in common:
         assert drill_losses[s] == pytest.approx(clean_losses[s], rel=1e-6), (
             s, drill_losses[s], clean_losses[s])

@@ -1,0 +1,408 @@
+"""Checkpoint-free live resharding (parallel/live_reshard.py, ISSUE 16).
+
+The acceptance invariants pinned here:
+
+- a live fsdp → tensor move is BITWISE-equal to the checkpoint round trip
+  (save on mesh A, restore re-projected onto mesh B) for params AND
+  optimizer state, without touching disk and faster than the walk-back;
+- peak in-flight transfer bytes stay within ``DLS_RESHARD_MEM_MB`` — the
+  engine rounds large leaves instead of materializing them whole;
+- a corrupted move raises :class:`ReshardVerifyError` naming the recovery
+  action instead of silently training on garbage;
+- the drained-host handoff (save → load) round-trips bitwise and refuses
+  torn/corrupt manifests with :class:`HandoffError`;
+- ``Trainer.apply_plan`` switches plans between steps with a trajectory
+  thereafter bitwise-equal to a run restarted under the new plan.
+"""
+
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributeddeeplearningspark_tpu import Checkpointer, telemetry
+from distributeddeeplearningspark_tpu.checkpoint import abstract_like
+from distributeddeeplearningspark_tpu.models import LeNet5
+from distributeddeeplearningspark_tpu.parallel import live_reshard
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+from distributeddeeplearningspark_tpu.parallel.sharding import (
+    FSDP,
+    ShardingRules,
+    state_shardings,
+)
+from distributeddeeplearningspark_tpu.train import losses, step as step_lib
+
+#: Shards the big LeNet dense kernel's output dim (400x120) over the tensor
+#: axis; everything else stays replicated — enough real movement for the
+#: layout-cross tests without inventing a model (the later kernels' dims
+#: don't divide by 8).
+TENSOR_RULES = ShardingRules(rules=((r"Dense_0/kernel", P(None, "tensor")),))
+
+
+def _host_tree(tree):
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def _assert_trees_bitwise(a, b):
+    fa, fb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+
+
+@pytest.fixture()
+def meshes(eight_devices):
+    return {
+        "fsdp": MeshSpec(data=2, fsdp=4).build(),
+        "tensor": MeshSpec(data=1, tensor=8).build(),
+    }
+
+
+def _lenet_state(mesh, rules=FSDP, seed=0):
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": rng.normal(0, 1, (8, 28, 28, 1)).astype(np.float32),
+        "label": rng.integers(0, 10, (8,)).astype(np.int32),
+    }
+    return step_lib.init_state(LeNet5(), optax.adamw(1e-3), batch, mesh,
+                               rules, seed=seed)
+
+
+# -- the engine ---------------------------------------------------------------
+
+
+def test_live_reshard_bitwise_matches_checkpoint_roundtrip(tmp_path, meshes):
+    """fsdp → tensor over collectives == save + cross-topology restore,
+    byte for byte, params AND optimizer moments — at a fraction of the
+    wall and with zero disk traffic."""
+    import time
+
+    state, _ = _lenet_state(meshes["fsdp"])
+    targets = state_shardings(abstract_like(state), meshes["tensor"],
+                              TENSOR_RULES)
+
+    t0 = time.perf_counter()
+    with Checkpointer(tmp_path / "ckpt", async_save=False) as ckpt:
+        ckpt.save(0, state)
+        ckpt.wait()
+        via_disk, _ = ckpt.restore(abstract_like(state), shardings=targets)
+    ckpt_wall = time.perf_counter() - t0
+
+    live, stats = live_reshard.redistribute(state, targets)
+
+    _assert_trees_bitwise(_host_tree(via_disk), _host_tree(live))
+    _assert_trees_bitwise(_host_tree(state), _host_tree(live))
+    for arr, sh in zip(jax.tree.leaves(live),
+                       jax.tree.leaves(targets,
+                                       is_leaf=lambda s: hasattr(s, "spec"))):
+        assert arr.sharding.is_equivalent_to(sh, arr.ndim)
+    # the dense kernels really crossed layouts (not an all-noop pass)
+    assert stats.leaves_moved >= 2 and stats.bytes_moved > 0
+    assert stats.verified
+    assert stats.peak_inflight_bytes <= stats.mem_budget_bytes
+    # checkpoint-free must beat the disk round trip it replaces (the ci.sh
+    # smoke pins the "small fraction" ratio; here just strictly faster)
+    assert stats.wall_s < ckpt_wall, (stats.wall_s, ckpt_wall)
+
+
+def test_rounds_bound_peak_inflight_bytes(meshes):
+    """A leaf far over budget moves in multiple rounds, never holding more
+    than the budget in flight — the 2112.01075 bounded-memory contract."""
+    x_host = np.arange(512 * 64, dtype=np.float32).reshape(512, 64)
+    x = jax.device_put(x_host,
+                       NamedSharding(meshes["fsdp"], P("fsdp", None)))
+    target = NamedSharding(meshes["tensor"], P(None, "tensor"))
+    out, stats = live_reshard.redistribute(
+        {"w": x}, {"w": target}, mem_mb=0.01)  # 10 KB budget vs 128 KB leaf
+    assert np.asarray(out["w"]).tobytes() == x_host.tobytes()
+    assert out["w"].sharding.is_equivalent_to(target, 2)
+    assert stats.rounds > 1
+    assert 0 < stats.peak_inflight_bytes <= stats.mem_budget_bytes
+
+
+def test_memory_budget_env_var(monkeypatch):
+    monkeypatch.setenv(live_reshard.RESHARD_MEM_ENV, "3")
+    assert live_reshard.memory_budget_bytes() == 3 * 1024 * 1024
+    monkeypatch.delenv(live_reshard.RESHARD_MEM_ENV)
+    assert (live_reshard.memory_budget_bytes()
+            == int(live_reshard.DEFAULT_MEM_MB * 1024 * 1024))
+    # explicit argument beats the env
+    monkeypatch.setenv(live_reshard.RESHARD_MEM_ENV, "3")
+    assert live_reshard.memory_budget_bytes(1.0) == 1024 * 1024
+    with pytest.raises(ValueError):
+        live_reshard.memory_budget_bytes(-1.0)
+
+
+def test_equivalent_layout_is_noop(meshes):
+    x = jax.device_put(np.ones((64, 16), np.float32),
+                       NamedSharding(meshes["fsdp"], P("fsdp", None)))
+    out, stats = live_reshard.redistribute(
+        {"w": x}, {"w": NamedSharding(meshes["fsdp"], P("fsdp", None))})
+    assert out["w"] is x
+    assert stats.leaves_moved == 0 and stats.bytes_moved == 0
+    assert stats.bytes_total == x.nbytes  # accounted, just not moved
+
+
+def test_verify_catches_corrupted_move(meshes, monkeypatch):
+    """A digest mismatch across the move is a typed refusal naming the
+    recovery action — never a silent continue."""
+    real = live_reshard._move_leaf
+
+    def corrupt(x, target, chunks, ledger):
+        out, _ = real(x, target, chunks, ledger)
+        return out, "0" * 32  # claim a digest the re-read cannot match
+
+    monkeypatch.setattr(live_reshard, "_move_leaf", corrupt)
+    x = jax.device_put(np.ones((64, 16), np.float32),
+                       NamedSharding(meshes["fsdp"], P("fsdp", None)))
+    with pytest.raises(live_reshard.ReshardVerifyError,
+                       match="last verified checkpoint"):
+        live_reshard.redistribute(
+            {"w": x}, {"w": NamedSharding(meshes["tensor"],
+                                          P(None, "tensor"))})
+
+
+def test_none_target_leaves_leaf_alone(meshes):
+    """None in the shardings tree means 'do not touch' — including python
+    scalars a TrainState may carry."""
+    x = jax.device_put(np.ones((8, 8), np.float32),
+                       NamedSharding(meshes["fsdp"], P()))
+    tree = {"w": x, "count": 5}
+    out, stats = live_reshard.redistribute(
+        tree, {"w": NamedSharding(meshes["tensor"], P()), "count": None})
+    assert out["count"] == 5
+    assert stats.leaves == 2
+
+
+def test_chunk_rows_shapes():
+    # 0-d: one degenerate chunk; zero rows: none; otherwise row ranges
+    assert live_reshard.chunk_rows((), 4, 1024) == ((0, 1),)
+    assert live_reshard.chunk_rows((0, 8), 4, 1024) == ()
+    chunks = live_reshard.chunk_rows((10, 100), 4, 1200)  # 3 rows/chunk
+    assert chunks[0] == (0, 3) and chunks[-1][1] == 10
+    assert all(hi > lo for lo, hi in chunks)
+    # a single over-budget row still moves (honest peak, not a deadlock)
+    assert live_reshard.chunk_rows((4, 1000), 4, 100) == (
+        (0, 1), (1, 2), (2, 3), (3, 4))
+
+
+# -- the handoff --------------------------------------------------------------
+
+
+def test_handoff_round_trip_bitwise(tmp_path, meshes):
+    state, shardings = _lenet_state(meshes["fsdp"])
+    assert not live_reshard.has_handoff(tmp_path)
+    live_reshard.save_handoff(tmp_path, 7, state,
+                              data_state={"examples_seen": 112,
+                                          "batch_size": 16})
+    assert live_reshard.has_handoff(tmp_path)
+    peek = live_reshard.peek_handoff(tmp_path)
+    assert peek["step"] == 7 and peek["data_state"]["examples_seen"] == 112
+
+    targets = state_shardings(abstract_like(state), meshes["tensor"],
+                              TENSOR_RULES)
+    loaded, manifest = live_reshard.load_handoff(tmp_path, state, targets)
+    _assert_trees_bitwise(_host_tree(state), _host_tree(loaded))
+    assert manifest["step"] == 7
+    live_reshard.clear_handoff(tmp_path)
+    assert not live_reshard.has_handoff(tmp_path)
+
+
+def test_handoff_rejects_corrupt_leaf(tmp_path, meshes):
+    state, shardings = _lenet_state(meshes["fsdp"])
+    live_reshard.save_handoff(tmp_path, 3, state)
+    d = live_reshard.handoff_dir(tmp_path)
+    victim = sorted(f for f in os.listdir(d) if f.endswith(".npy"))[0]
+    arr = np.load(os.path.join(d, victim))
+    np.save(os.path.join(d, victim), arr + 1.0)
+    with pytest.raises(live_reshard.HandoffError, match="checkpoint"):
+        live_reshard.load_handoff(tmp_path, state, shardings)
+
+
+def test_handoff_rejects_missing_and_extra_leaves(tmp_path, meshes):
+    import json
+
+    state, shardings = _lenet_state(meshes["fsdp"])
+    live_reshard.save_handoff(tmp_path, 3, state)
+    d = live_reshard.handoff_dir(tmp_path)
+    with open(os.path.join(d, live_reshard.HANDOFF_MANIFEST)) as f:
+        manifest = json.load(f)
+    manifest["leaves"] = manifest["leaves"][:-1]
+    with open(os.path.join(d, live_reshard.HANDOFF_MANIFEST), "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(live_reshard.HandoffError, match="checkpoint"):
+        live_reshard.load_handoff(tmp_path, state, shardings)
+
+
+def test_tree_digest_orders_and_discriminates():
+    a = {"w": np.ones((4, 4), np.float32), "b": np.zeros(3, np.float32)}
+    b = {"w": np.ones((4, 4), np.float32), "b": np.zeros(3, np.float32)}
+    assert live_reshard.tree_digest(a) == live_reshard.tree_digest(b)
+    b["w"] = b["w"] + 1
+    assert live_reshard.tree_digest(a) != live_reshard.tree_digest(b)
+
+
+# -- telemetry ----------------------------------------------------------------
+
+
+def test_emit_reshard_event_fields(tmp_path, meshes):
+    telemetry.configure(tmp_path)
+    try:
+        x = jax.device_put(np.ones((64, 16), np.float32),
+                           NamedSharding(meshes["fsdp"], P("fsdp", None)))
+        _, stats = live_reshard.redistribute(
+            {"w": x}, {"w": NamedSharding(meshes["tensor"],
+                                          P(None, "tensor"))})
+        live_reshard.emit_reshard_event(stats, step=12, reason="apply-plan")
+        telemetry.get().close()
+        events = [e for e in telemetry.read_events(tmp_path)
+                  if e.get("kind") == "recovery"
+                  and e.get("event") == "reshard"]
+        assert len(events) == 1
+        e = events[0]
+        assert e["transport"] == "collectives" and e["walk_back"] is False
+        assert e["step"] == 12 and e["reason"] == "apply-plan"
+        assert e["bytes_moved"] == stats.bytes_moved
+        assert e["rounds"] == stats.rounds
+        assert e["peak_inflight_bytes"] == stats.peak_inflight_bytes
+        assert e["mem_budget_mb"] == pytest.approx(
+            stats.mem_budget_bytes / (1024 * 1024))
+        assert e["leaves_moved"] == stats.leaves_moved and e["verified"]
+    finally:
+        telemetry.reset()
+
+
+def test_dlstatus_renders_reshard_and_graceful_shutdown(tmp_path, meshes):
+    """The status satellite: reshard events get a dedicated block (live vs
+    walk-back split), graceful shutdowns a dedicated attempt line, and
+    --json a structured reshard summary."""
+    from distributeddeeplearningspark_tpu import status
+
+    telemetry.configure(tmp_path)
+    try:
+        x = jax.device_put(np.ones((64, 16), np.float32),
+                           NamedSharding(meshes["fsdp"], P("fsdp", None)))
+        _, stats = live_reshard.redistribute(
+            {"w": x}, {"w": NamedSharding(meshes["tensor"],
+                                          P(None, "tensor"))})
+        live_reshard.emit_reshard_event(stats, step=9,
+                                        reason="preemption-drain")
+        tele = telemetry.get()
+        tele.recovery(9, "graceful_shutdown", ordinal=0, dead_host=1,
+                      drained=True)
+        tele.emit("attempt", edge="begin", ordinal=0, num_processes=2)
+        tele.emit("attempt", edge="end", ordinal=0, returncodes=[0, 0],
+                  classification="graceful-shutdown", duration_s=1.0)
+        tele.close()
+
+        rep = status.report(str(tmp_path))
+        rs = rep["reshard"]
+        assert rs["moves"] == 1 and rs["live_moves"] == 1
+        assert rs["walk_back_moves"] == 0
+        assert rs["by_transport"]["collectives"] == 1
+        assert rs["last"]["transport"] == "collectives"
+        assert rs["last"]["step"] == 9
+        assert rs["bytes_moved"] == stats.bytes_moved
+
+        rendered = status.render(rep)
+        assert "resharding" in rendered
+        assert "checkpoint-free (live)" in rendered
+        assert "graceful shutdown: host 1" in rendered
+    finally:
+        telemetry.reset()
+
+
+# -- Trainer.apply_plan -------------------------------------------------------
+
+
+def test_trainer_apply_plan_trajectory_bitwise(tmp_path):
+    """Switching plans LIVE between steps must land exactly where a run
+    restarted under the new plan from the same checkpoint lands — the plan
+    sweep's winner can be applied without a restart."""
+    import dataclasses
+
+    from distributeddeeplearningspark_tpu import (
+        PartitionedDataset,
+        Session,
+        Trainer,
+    )
+    from distributeddeeplearningspark_tpu.parallel.plan import (
+        DP,
+        Plan,
+        zero_plan,
+    )
+
+    rng = np.random.default_rng(5)
+    examples = [
+        {"image": rng.normal(0, 1, (28, 28, 1)).astype(np.float32),
+         "label": np.int32(i % 10)}
+        for i in range(128)
+    ]
+    batch_size = 16
+    plan_a = Plan(name="dp", donate_state=False)
+    plan_b = dataclasses.replace(
+        zero_plan(DP, axes=("data",), name="dp+zero"),
+        zero_min_size=64, donate_state=False)
+
+    def make_trainer(plan, ckpt):
+        sess = Session.builder.master("local[2]").getOrCreate()
+        ds = PartitionedDataset.parallelize(examples, 2).repeat()
+        t = Trainer(sess, LeNet5(), losses.softmax_xent,
+                    optax.sgd(0.1, momentum=0.9), plan=plan,
+                    checkpointer=ckpt, seed=11)
+        return t, ds
+
+    with Checkpointer(tmp_path / "ck", async_save=False) as ck:
+        # live run: 3 steps under plan A, switch in place, 3 more under B
+        t1, ds = make_trainer(plan_a, ck)
+        t1.fit(ds, batch_size=batch_size, steps=3, checkpoint_every=3,
+               log_every=100)
+        stats = t1.apply_plan(plan_b)
+        assert stats.verified
+        assert t1.plan.name == "dp+zero"
+        assert t1._train_step.plan_name == "dp+zero"
+        state_live, _ = t1.fit(ds, batch_size=batch_size, steps=6,
+                               log_every=100,
+                               data_state={"examples_seen": 3 * batch_size,
+                                           "batch_size": batch_size})
+        Session._active and Session._active.stop()
+
+        # pinned run: fresh process under plan B from the same checkpoint
+        t2, ds = make_trainer(plan_b, ck)
+        t2.init(t2._sample_batch(ds, batch_size))
+        _, data_state = t2.restore()
+        assert int(jax.device_get(t2.state.step)) == 3
+        state_pin, _ = t2.fit(ds, batch_size=batch_size, steps=6,
+                              log_every=100, data_state=data_state)
+
+    _assert_trees_bitwise(_host_tree(state_live.params),
+                          _host_tree(state_pin.params))
+    _assert_trees_bitwise(_host_tree(state_live.opt_state),
+                          _host_tree(state_pin.opt_state))
+
+
+def test_apply_plan_requires_init():
+    from distributeddeeplearningspark_tpu import Session, Trainer
+    from distributeddeeplearningspark_tpu.parallel.plan import Plan
+
+    sess = Session.builder.master("local[2]").getOrCreate()
+    t = Trainer(sess, LeNet5(), losses.softmax_xent, optax.sgd(0.1))
+    with pytest.raises(RuntimeError, match="init"):
+        t.apply_plan(Plan(name="dp"))
+
+
+def test_apply_plan_rejects_shard_map_style():
+    from distributeddeeplearningspark_tpu import Session, Trainer
+    from distributeddeeplearningspark_tpu.parallel.plan import (
+        Plan,
+        PlanValidationError,
+    )
+
+    sess = Session.builder.master("local[2]").getOrCreate()
+    t = Trainer(sess, LeNet5(), losses.softmax_xent, optax.sgd(0.1))
+    t.state = object()  # get past the init guard to the style guard
+    with pytest.raises(PlanValidationError, match="style='jit'"):
+        t.apply_plan(Plan(name="mapstyle", style="shard_map"))

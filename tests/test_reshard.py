@@ -72,6 +72,69 @@ def test_shardings_from_record_unknown_leaf_replicates(meshes):
     assert sh["new_leaf"].spec == P()
 
 
+def test_shardings_from_record_uneven_leaf_degrades(meshes):
+    """A recorded axis whose dim no longer divides the target mesh width
+    must degrade that leaf to replicated — never crash the restore with a
+    divisibility error deep in XLA."""
+    record = {"specs": {"even": ["fsdp", None], "odd": ["fsdp", None]}}
+    abstract = {"even": jax.ShapeDtypeStruct((64, 16), np.float32),
+                "odd": jax.ShapeDtypeStruct((65, 16), np.float32)}
+    sh = reshard.shardings_from_record(record, abstract, meshes["half"])
+    assert sh["even"].spec == P("fsdp", None)
+    assert sh["odd"].spec == P(None, None)
+
+
+def test_shardings_from_record_zero_d_scalar(meshes):
+    """0-d leaves (step counters, schedule counts) always come back
+    replicated — even when the record carries junk for them."""
+    record = {"specs": {"step": ["fsdp"], "count": []}}
+    abstract = {"step": jax.ShapeDtypeStruct((), np.int32),
+                "count": jax.ShapeDtypeStruct((), np.float32)}
+    sh = reshard.shardings_from_record(record, abstract, meshes["half"])
+    assert sh["step"].spec == P()
+    assert sh["count"].spec == P()
+    # and a 0-d leaf moves bitwise between meshes
+    from jax.sharding import NamedSharding as NS
+
+    s = jax.device_put(np.float32(3.5), NS(meshes["fsdp"], P()))
+    out = reshard.redistribute({"s": s}, {"s": NS(meshes["dp"], P())})
+    assert float(out["s"]) == 3.5 and out["s"].ndim == 0
+
+
+def test_shardings_from_record_opt_state_without_specs(meshes):
+    """Optimizer-state leaves a pre-live checkpoint never recorded specs
+    for replicate cleanly instead of guessing — the spec keys cover params
+    only, the abstract tree carries the full TrainState paths."""
+    record = {"specs": {"params/w": ["fsdp", None]}}
+    abstract = {
+        "params": {"w": jax.ShapeDtypeStruct((64, 16), np.float32)},
+        "opt_state": {"mu": {"w": jax.ShapeDtypeStruct((64, 16),
+                                                       np.float32)},
+                      "count": jax.ShapeDtypeStruct((), np.int32)},
+    }
+    sh = reshard.shardings_from_record(record, abstract, meshes["half"])
+    assert sh["params"]["w"].spec == P("fsdp", None)
+    assert sh["opt_state"]["mu"]["w"].spec == P()
+    assert sh["opt_state"]["count"].spec == P()
+
+
+def test_reshard_error_names_escape_hatch(tmp_path, meshes):
+    """The typed refusal when a checkpoint's recorded topology cannot be
+    rebuilt here must name BOTH escape hatches (shardings / mesh=) — the
+    operator fixes this from the message alone (POD_PLAYBOOK)."""
+    from distributeddeeplearningspark_tpu.checkpoint import (
+        Checkpointer,
+        ReshardError,
+    )
+
+    with Checkpointer(tmp_path / "ck", async_save=False) as ck:
+        with pytest.raises(ReshardError, match="shardings") as ei:
+            ck._reshard_check(5, {"num_devices": 4096, "num_processes": 512,
+                                  "mesh": {"data": 4096}})
+    assert "mesh=" in str(ei.value)
+    assert "4096" in str(ei.value)
+
+
 # -- data movement ------------------------------------------------------------
 
 

@@ -401,6 +401,50 @@ def test_rolling_reload_zero_dropped_in_process():
         e.stop()
 
 
+# -- peer warm-up (in-process fleet) ------------------------------------------
+
+
+def test_local_replica_peer_warmup_export_import():
+    """A relaunched replica warms its weights from a serving peer instead
+    of disk: export (numpy tree + blake2b digest) → import (re-hash,
+    verify, swap) — the newcomer then serves the donor's exact params at
+    the donor's version."""
+    import jax.numpy as jnp
+
+    def fwd(params, batch):
+        return {"y": batch["x"] * params["w"]}
+
+    donor_e = InferenceEngine(fwd, {"w": jnp.float32(7.0)}, max_batch=4,
+                              max_wait_ms=1.0, max_queue=64,
+                              name="donor").start()
+    target_e = InferenceEngine(fwd, {"w": jnp.float32(1.0)}, max_batch=4,
+                               max_wait_ms=1.0, max_queue=64,
+                               name="target").start()
+    try:
+        donor_e.swap_params({"w": jnp.float32(7.0)}, version=3)
+        donor = LocalReplica("donor", donor_e)
+        target = LocalReplica("target", target_e)
+        exported = donor.call("export_params")
+        assert exported["version"] == 3 and exported["digest"]
+        rec = target.call("import_params", params=exported["params"],
+                          version=exported["version"],
+                          digest=exported["digest"])
+        assert rec["digest"] == exported["digest"]
+        assert rec["params_version"] == 3
+        out = target.call("infer",
+                          example={"x": np.float32(2.0)}, timeout=60.0)
+        assert float(out["y"]) == 14.0  # the donor's weights, not seed 1.0
+
+        # a torn transfer is refused, the replica keeps serving its params
+        with pytest.raises(ValueError, match="digest mismatch"):
+            target.call("import_params", params={"w": np.float32(9.0)},
+                        digest="0" * 32)
+        assert target.engine.params_version == 3
+    finally:
+        donor_e.stop()
+        target_e.stop()
+
+
 # -- dlstatus --fleet-serve ----------------------------------------------------
 
 
@@ -508,6 +552,11 @@ def test_fleet_processes_end_to_end(tmp_path):
             f.result(120)                  # survivors absorbed the load
         assert fleet.restart_dead(router) == ["r0"]
         assert fleet.handles[0].alive
+        # the relaunch warmed from the surviving peer, not disk: it comes
+        # back already on the fleet's CURRENT (post-reload) weights
+        donor_v = fleet.handles[1].call("stats")["params_version"]
+        assert donor_v == 1
+        assert fleet.handles[0].call("stats")["params_version"] == donor_v
         fut = router.submit(payload(0))
         fut.result(120)
 
@@ -517,6 +566,10 @@ def test_fleet_processes_end_to_end(tmp_path):
     assert {"p0", "p1"} <= procs           # both replicas left events
     assert fs["totals"]["ok"] >= 41
     recov = [e for e in rep["recovery_events"]
-             if e.get("event") in ("rolling-reload", "replica-restart")]
+             if e.get("event") in ("rolling-reload", "replica-restart",
+                                   "replica-warmup")]
     assert {e["event"] for e in recov} == {"rolling-reload",
-                                           "replica-restart"}
+                                           "replica-restart",
+                                           "replica-warmup"}
+    restart = next(e for e in recov if e["event"] == "replica-restart")
+    assert restart.get("warmed_from") == "r1"

@@ -127,7 +127,10 @@ def host_agent(args) -> int:
     No jax: it stamps the supervisor's heartbeat file, applies the
     ``die_host`` discipline (die at the step-N checkpoint boundary on
     attempt 0; die at startup on every later attempt — a dead machine
-    stays dead), and exits 0 once rank 0's DONE marker appears."""
+    stays dead), exits 0 when the trainer's DRAIN evidence appears (a
+    graceful ``sigterm`` preemption ends the WHOLE gang cleanly — the
+    doomed host's "death" is this clean exit), and exits 0 once rank 0's
+    DONE marker appears."""
     import time
 
     from distributeddeeplearningspark_tpu import faults
@@ -147,6 +150,8 @@ def host_agent(args) -> int:
             latest = _latest_step(args.ckpt_dir)
             if latest is not None and latest >= fault.step:
                 faults.crash()
+        if os.path.exists(os.path.join(args.ckpt_dir, "DRAIN")):
+            return 0  # graceful preemption: whole gang exits clean
         if os.path.exists(os.path.join(args.ckpt_dir, "DONE")):
             return 0
         time.sleep(0.1)
@@ -186,8 +191,26 @@ def mode_elastic(args) -> int:
     trainer = Trainer(spark, LeNet5(), losses.softmax_xent,
                       optax.sgd(0.05, momentum=0.9), checkpointer=ckpt, seed=5)
     data_state = None
-    if ckpt.latest_step() is not None:
+    restored = False
+    from distributeddeeplearningspark_tpu.parallel import live_reshard
+
+    if live_reshard.has_handoff(args.ckpt_dir):
+        # graceful-preemption resume: ingest the drained gang's live
+        # handoff and continue from the CURRENT step — no walk-back
         trainer.init(trainer._sample_batch(ds, args.batch_size))
+        try:
+            _, data_state = trainer.restore_live_handoff()
+            restored = True
+        except live_reshard.HandoffError:
+            import traceback
+
+            traceback.print_exc()
+            # torn/mismatched handoff: consume it and walk back through
+            # the checkpoint like any hard failure
+            live_reshard.clear_handoff(args.ckpt_dir)
+    if not restored and ckpt.latest_step() is not None:
+        if trainer.state is None:
+            trainer.init(trainer._sample_batch(ds, args.batch_size))
         try:
             _, data_state = trainer.restore()
         except Exception:
@@ -203,6 +226,11 @@ def mode_elastic(args) -> int:
         ds, batch_size=args.batch_size, steps=args.steps, log_every=2,
         checkpoint_every=args.checkpoint_every, data_state=data_state,
     )
+    if trainer.preempted_at is not None:
+        # drained gracefully: the live handoff + DRAIN evidence are the
+        # exit artifacts — no DONE, no final checkpoint; the supervisor
+        # shrinks and relaunches from the current step
+        return 0
     ckpt.wait()
     final_step = int(jax.device_get(state.step))
     with open(os.path.join(args.ckpt_dir, "DONE"), "w") as f:

@@ -879,6 +879,159 @@ PYEOF
   return $rc
 }
 
+# live-reshard smoke (ISSUE 16): checkpoint-free resharding end to end —
+# (1) graceful preemption: DLS_FAULT=sigterm@9 drains host 1 at step 9,
+# the supervisor classifies graceful-shutdown (no backoff slot burned),
+# shrinks 2->1 and the survivor resumes from the CURRENT step via the
+# live handoff (no walk_back anywhere in the event stream, dlstatus
+# renders the move as checkpoint-free); (2) a hard die_host@9 kill still
+# walks back through the checkpoint (resume="checkpoint"); (3) a live
+# fsdp->tensor redistribute of a full TrainState is BITWISE equal to the
+# checkpoint save+restore round trip at <=50% of its wall, peak in-flight
+# bytes within DLS_RESHARD_MEM_MB (docs/POD_PLAYBOOK.md "We got a
+# preemption notice").
+run_live_reshard_smoke() {
+  local t0 rc wd out
+  t0=$(date +%s)
+  rc=0
+  wd=$(mktemp -d /tmp/dls_live_reshard.XXXXXX)
+  out=$(WD="$wd" python - <<'PYEOF'
+import json, os, subprocess, sys, time
+
+import numpy as np
+
+wd = os.environ["WD"]
+worker = os.path.join("tests", "workers", "worker.py")
+
+from distributeddeeplearningspark_tpu.supervisor import Supervisor
+
+# -- graceful preemption: SIGTERM@9 -> drain -> shrink -> resume at 9 ---------
+sig_dir = os.path.join(wd, "sig")
+os.makedirs(sig_dir)
+sup = Supervisor(
+    [sys.executable, worker, "elastic", "--ckpt-dir", sig_dir,
+     "--steps", "18", "--checkpoint-every", "6"],
+    num_processes=2, max_restarts=4, restart_backoff_s=0.05,
+    backoff_jitter=0.0, shrink_after=2,
+    env={"XLA_FLAGS": "", "JAX_PLATFORMS": "cpu",
+         "DLS_FAULT": "sigterm@9"},
+    progress_path=sig_dir,
+)
+result = sup.run()
+assert result.ok, [(a.ordinal, a.returncodes, a.classification)
+                   for a in result.attempts]
+assert result.attempts[0].classification == "graceful-shutdown", \
+    result.attempts[0].classification
+step, attempt, nprocs = open(os.path.join(sig_dir, "DONE")).read().split()
+assert (int(step), int(nprocs)) == (18, 1), (step, attempt, nprocs)
+
+p = subprocess.run(
+    [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+     sig_dir, "--json"], capture_output=True, text=True)
+assert p.returncode == 0, p.stderr[-500:]
+rep = json.loads(p.stdout)
+ev = rep["recovery_events"]
+geo = [e for e in ev if e.get("event") == "geometry_change"]
+assert geo and geo[0].get("resume") == "live-handoff" \
+    and geo[0].get("step") == 9, geo
+gs = [e for e in ev if e.get("event") == "graceful_shutdown"]
+assert gs and gs[0].get("dead_host") == 1 and gs[0].get("step") == 9, gs
+moves = [e for e in ev if e.get("event") == "reshard"]
+assert any(e.get("transport") == "handoff" for e in moves), moves
+assert not any(e.get("walk_back") for e in moves), moves
+rs = rep.get("reshard") or {}
+assert rs.get("walk_back_moves") == 0 and rs.get("live_moves", 0) >= 2, rs
+human = subprocess.run(
+    [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+     sig_dir], capture_output=True, text=True)
+assert "graceful shutdown: host 1" in human.stdout, human.stdout[-800:]
+assert "checkpoint-free (live)" in human.stdout, human.stdout[-800:]
+
+# -- a hard kill still walks back through the checkpoint ----------------------
+die_dir = os.path.join(wd, "die")
+os.makedirs(die_dir)
+sup = Supervisor(
+    [sys.executable, worker, "elastic", "--ckpt-dir", die_dir,
+     "--steps", "12", "--checkpoint-every", "6"],
+    num_processes=2, max_restarts=4, restart_backoff_s=0.05,
+    backoff_jitter=0.0, shrink_after=2,
+    env={"XLA_FLAGS": "", "JAX_PLATFORMS": "cpu",
+         "DLS_FAULT": "die_host@9"},
+    progress_path=die_dir,
+)
+result = sup.run()
+assert result.ok, [(a.ordinal, a.returncodes, a.classification)
+                   for a in result.attempts]
+step, _, nprocs = open(os.path.join(die_dir, "DONE")).read().split()
+assert (int(step), int(nprocs)) == (12, 1), (step, nprocs)
+p = subprocess.run(
+    [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+     die_dir, "--json"], capture_output=True, text=True)
+assert p.returncode == 0, p.stderr[-500:]
+geo2 = [e for e in json.loads(p.stdout)["recovery_events"]
+        if e.get("event") == "geometry_change"]
+assert geo2 and geo2[0].get("resume") == "checkpoint", geo2
+
+# -- live redistribute vs the checkpoint round trip it replaces ---------------
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import optax
+from jax.sharding import PartitionSpec as P
+
+from distributeddeeplearningspark_tpu.checkpoint import (
+    Checkpointer, abstract_like)
+from distributeddeeplearningspark_tpu.models import LeNet5
+from distributeddeeplearningspark_tpu.parallel import live_reshard
+from distributeddeeplearningspark_tpu.parallel.mesh import MeshSpec
+from distributeddeeplearningspark_tpu.parallel.sharding import (
+    FSDP, ShardingRules, state_shardings)
+from distributeddeeplearningspark_tpu.train import step as step_lib
+
+rng = np.random.default_rng(0)
+batch = {"image": rng.normal(0, 1, (8, 28, 28, 1)).astype(np.float32),
+         "label": rng.integers(0, 10, (8,)).astype(np.int32)}
+state, _ = step_lib.init_state(
+    LeNet5(), optax.adamw(1e-3), batch,
+    MeshSpec(data=2, fsdp=4).build(), FSDP, seed=3)
+targets = state_shardings(
+    abstract_like(state), MeshSpec(data=1, tensor=8).build(),
+    ShardingRules(rules=((r"Dense_0/kernel", P(None, "tensor")),)))
+
+t0 = time.perf_counter()
+ck_dir = os.path.join(wd, "ck")
+with Checkpointer(ck_dir, async_save=False) as ck:
+    ck.save(0, state)
+    ck.wait()
+    via_disk, _ = ck.restore(abstract_like(state), shardings=targets)
+ckpt_wall = time.perf_counter() - t0
+
+live, stats = live_reshard.redistribute(state, targets)
+host = lambda t: jax.tree.map(  # noqa: E731
+    lambda x: np.asarray(jax.device_get(x)).tobytes(), t)
+assert host(live) == host(via_disk), "live != checkpoint round trip"
+assert host(live) == host(state), "live reshard changed bytes"
+assert stats.verified and stats.leaves_moved >= 2, stats.to_record()
+assert stats.peak_inflight_bytes <= stats.mem_budget_bytes, stats.to_record()
+ratio = stats.wall_s / max(ckpt_wall, 1e-9)
+assert ratio <= 0.5, (
+    f"live reshard took {stats.wall_s:.3f}s vs checkpoint round trip "
+    f"{ckpt_wall:.3f}s (ratio {ratio:.2f} > 0.50)")
+
+print(f"sigterm: drained@9 shrink=2->1 resume=live-handoff done=18 "
+      f"walk_back_moves=0 | die_host: resume=checkpoint done=12 | "
+      f"live-vs-ckpt: bitwise=ok leaves_moved={stats.leaves_moved} "
+      f"peak={stats.peak_inflight_bytes}B<=budget ratio={ratio:.2f}<=0.50")
+PYEOF
+) || rc=$?
+  log live-reshard "${out:-live-reshard smoke failed}" "${rc}" \
+    $(( $(date +%s) - t0 ))
+  echo "[live-reshard] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$wd"
+  return $rc
+}
+
 # mpmd smoke (ISSUE 13): the MPMD stage-pipeline end to end — (1) a
 # 2-stage x 2-fake-device pipeline over the socket transport matches the
 # single-program llama_pp baseline BITWISE (per-step losses), (2) a
@@ -1054,6 +1207,7 @@ case "${1:-both}" in
         run_shuffle_smoke || overall=$?
         run_shuffle_chaos || overall=$?
         run_elastic_smoke || overall=$?
+        run_live_reshard_smoke || overall=$?
         run_mpmd_smoke || overall=$?
         run_plan_smoke || overall=$?
         run_perf_guard_smoke || overall=$? ;;
@@ -1095,6 +1249,12 @@ case "${1:-both}" in
   # completion on the survivor) + dlstatus geometry change + bitwise
   # fsdp→tensor restore (docs/POD_PLAYBOOK.md "We lost a host")
   elastic) run_elastic_smoke || overall=$? ;;
+  # checkpoint-free live resharding: SIGTERM graceful drain resumes from
+  # the CURRENT step via the live handoff (no walk-back), die_host still
+  # walks back through the checkpoint, live fsdp->tensor redistribute
+  # bitwise == the disk round trip at <=50% of its wall
+  # (docs/POD_PLAYBOOK.md "We got a preemption notice")
+  live-reshard) run_live_reshard_smoke || overall=$? ;;
   # MPMD pipeline: 2-stage bitwise parity vs llama_pp, bubble under the
   # (P-1)/(M+P-1) bound + 10%, stage-kill drill restarts ONLY the dead
   # stage (docs/PERFORMANCE.md "MPMD pipelines")
@@ -1111,6 +1271,6 @@ case "${1:-both}" in
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|mpmd|plan|perf-guard|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|live-reshard|mpmd|plan|perf-guard|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
