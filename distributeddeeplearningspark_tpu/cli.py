@@ -42,6 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="run directory: telemetry events append to <workdir>/telemetry "
              "and `dlstatus <workdir>` reads the run report",
     )
+    p.add_argument(
+        "--tenant", default=None,
+        help="tenant this run belongs to: exported as DLS_TENANT, stamped "
+             "on every telemetry record, and folded by `dlstatus --cluster` "
+             "into the per-tenant goodput/occupancy rollup",
+    )
     p.add_argument("script", help="driver script to run")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
@@ -86,6 +92,10 @@ def main(argv: list[str] | None = None) -> int:
         from distributeddeeplearningspark_tpu import telemetry
 
         os.environ[telemetry.WORKDIR_ENV] = os.path.abspath(args.workdir)
+    if args.tenant:
+        from distributeddeeplearningspark_tpu import telemetry
+
+        os.environ[telemetry.TENANT_ENV] = args.tenant
 
     if not os.path.exists(args.script):
         raise SystemExit(f"dlsubmit: script not found: {args.script}")

@@ -548,6 +548,16 @@ class LocalReplica:
         self.engine.stop()
 
 
+def _proc_of(replica_name: str) -> str | None:
+    """Fleet handle name ("r<idx>") -> the replica's telemetry process
+    name ("p<idx>" — the fleet exports ``DLS_PROCESS_ID=idx``), so
+    recovery events can be joined against per-process serving rows and
+    health-alert evidence without knowing the naming convention."""
+    if replica_name.startswith("r") and replica_name[1:].isdigit():
+        return "p" + replica_name[1:]
+    return None
+
+
 class ServingFleet:
     """Launch and manage N replica processes (the serving gang).
 
@@ -701,6 +711,7 @@ class ServingFleet:
                 if self._tele is not None:
                     self._tele.recovery(None, "rolling-reload",
                                         replica=h.name,
+                                        replica_process=_proc_of(h.name),
                                         params_version=(rec or {}).get(
                                             "params_version"))
             finally:
@@ -761,13 +772,20 @@ class ServingFleet:
             if router is not None:
                 router.replace(nh)
             if self._tele is not None:
+                # replica_process is the incident-correlation stamp: the
+                # health engine's alert evidence names replicas by their
+                # telemetry stream ("p0"), the fleet by handle ("r0") —
+                # both on the event lets the timeline join them
                 self._tele.recovery(None, "replica-restart",
-                                    replica=nh.name, returncode=rc,
+                                    replica=nh.name,
+                                    replica_process=_proc_of(nh.name),
+                                    returncode=rc,
                                     ordinal=self._ordinals[i],
                                     warmed_from=(warm or {}).get("donor"))
                 if warm is not None:
                     self._tele.recovery(
                         None, "replica-warmup", replica=nh.name,
+                        replica_process=_proc_of(nh.name),
                         donor=warm["donor"], wall_s=warm["wall_s"],
                         digest=warm.get("digest"),
                         params_version=warm.get("params_version"))

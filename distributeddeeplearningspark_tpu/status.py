@@ -18,6 +18,12 @@ was productive, and what ate the rest".
     dlstatus <workdir> --slo 0.25     # + SLO sentinel: p99 target, burn rate
     dlstatus <workdir> --anatomy      # + compile ledger, device/host/input
                                       #   split, MFU, memory watermarks
+    dlstatus <workdir> --health       # + rule-evaluated health verdicts
+                                      #   (rewrites <workdir>/health.json)
+    dlstatus <workdir> --incidents    # + the ordered incident timeline
+                                      #   (alert edges + recovery + attempts)
+    dlstatus --cluster ROOT           # every workdir under ROOT: per-tenant
+                                      #   goodput/occupancy, worst alert
     dlstatus <workdir> --watch        # live-follow: re-render on an interval
     dlstatus <workdir> --export-trace out.json  # Chrome/Perfetto trace_event
 
@@ -52,6 +58,7 @@ import time
 from distributeddeeplearningspark_tpu import telemetry
 from distributeddeeplearningspark_tpu.telemetry import anatomy as anatomy_lib
 from distributeddeeplearningspark_tpu.telemetry import fleet as fleet_lib
+from distributeddeeplearningspark_tpu.telemetry import health as health_lib
 
 #: goodput components rendered in the breakdown table, in display order.
 _COMPONENTS = telemetry.GOODPUT_COMPONENTS
@@ -623,6 +630,81 @@ def render_slo(s: dict) -> list[str]:
     return lines
 
 
+def render_health(h: dict) -> list[str]:
+    """The ``--health`` section: worst-severity rollup, per-rule verdicts,
+    active (damped) alerts."""
+    lines: list[str] = []
+    st = h.get("stream") or {}
+    lines.append(
+        f"health: {h['worst_severity']}  "
+        f"(schema v{h['schema']}, evaluation {h.get('evaluations', 1)})"
+        + ("  DEGRADED STREAM" if st.get("degraded") else ""))
+    for name, r in h["rules"].items():
+        if not r["verdicts"]:
+            continue
+        for v in r["verdicts"]:
+            lines.append(f"  [{v['severity']:<4}] {v['key']}: {v['summary']}")
+    if all(not r["verdicts"] for r in h["rules"].values()):
+        lines.append("  all rules OK")
+    for a in h.get("alerts_active") or []:
+        lines.append(
+            f"  active alert {a['key']} [{a['severity']}] since "
+            f"t={a['since_ts']:.1f} (held {a['held']} eval(s))")
+    return lines
+
+
+def render_incidents(rows: list[dict], first_ts: float | None) -> list[str]:
+    """The ``--incidents`` section: the ordered timeline, one line each."""
+    lines = [f"incident timeline: {len(rows)} event(s)"]
+    t0 = first_ts if first_ts is not None else (rows[0]["ts"] if rows else 0.0)
+    for r in rows:
+        sev = f" [{r['severity']}]" if r.get("severity") else ""
+        who = f" <{r['who']}>" if r.get("who") else ""
+        step = f" step={r['step']}" if r.get("step") is not None else ""
+        lines.append(
+            f"  t+{r['ts'] - t0:8.1f}s  {r['type']:<12}{sev}{who}"
+            f"{step}  {r['summary']}")
+    return lines
+
+
+def render_cluster(c: dict) -> str:
+    """The ``--cluster`` table: one row per discovered workdir + the
+    per-tenant rollup."""
+    lines: list[str] = []
+    lines.append(
+        f"cluster: {len(c['workdirs'])} workdir(s) under {c['root']}  "
+        f"worst={c['worst_severity']}")
+    lines.append(
+        f"  {'workdir':<32} {'kind':<6} {'tenants':<16} {'goodput':>7}  "
+        f"{'occ':>5}  {'hb age':>7}  {'step':>7}  worst alert")
+    for r in c["workdirs"]:
+        wd = r["workdir"]
+        if len(wd) > 32:
+            wd = "…" + wd[-31:]
+        worst = (f"[{r['worst_alert']['severity']}] "
+                 f"{r['worst_alert']['key']}" if r["worst_alert"] else "-")
+        if r["degraded"]:
+            worst += " (degraded stream)"
+        lines.append(
+            f"  {wd:<32} {r['kind']:<6} {','.join(r['tenants']):<16} "
+            f"{r['goodput_frac']:>7.3f}  {_fmt_pct(r['occupancy']):>5}  "
+            f"{_fmt_s(r['last_heartbeat_age_s']):>7}  "
+            f"{r['last_step'] if r['last_step'] is not None else '-':>7}  "
+            f"{worst}")
+    if c["tenants"]:
+        lines.append("  per-tenant rollup:")
+        for t, agg in sorted(c["tenants"].items()):
+            good = (f"{agg['goodput_frac']:.3f}"
+                    if agg.get("goodput_frac") is not None else "-")
+            lines.append(
+                f"    {t:<14} workdirs={agg['workdirs']} "
+                f"(train {agg['train_workdirs']}, serve "
+                f"{agg['serve_workdirs']})  goodput={good}  "
+                f"requests={agg['requests']} shed={agg['shed']}  "
+                f"worst={agg['worst_severity']}")
+    return "\n".join(lines)
+
+
 def render(rep: dict) -> str:
     """Human-readable report (the default output)."""
     lines: list[str] = []
@@ -636,6 +718,9 @@ def render(rep: dict) -> str:
     if rep["last_heartbeat_ts"] is not None:
         lines.append(
             f"  last heartbeat: {_fmt_s(rep['last_heartbeat_age_s'])} ago")
+    if rep.get("health"):
+        lines.append("")
+        lines.extend(render_health(rep["health"]))
     if rep.get("fleet"):
         lines.append("")
         lines.extend(render_fleet(rep["fleet"]))
@@ -824,6 +909,9 @@ def render(rep: dict) -> str:
                 f"[{e.get('process')}] {e.get('event')} "
                 f"step={e.get('step', '-')}"
                 + (f" {json.dumps(extra, default=str)}" if extra else ""))
+    if rep.get("incidents") is not None:
+        lines.append("")
+        lines.extend(render_incidents(rep["incidents"], rep["first_ts"]))
     return "\n".join(lines)
 
 
@@ -831,8 +919,10 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="dlstatus",
         description="Inspect a run's telemetry: goodput, attempts, recovery.")
-    ap.add_argument("workdir", help="run directory (holds telemetry/) or the "
-                                    "telemetry directory itself")
+    ap.add_argument("workdir", nargs="?", default=None,
+                    help="run directory (holds telemetry/) or the "
+                         "telemetry directory itself (optional with "
+                         "--cluster)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report")
     ap.add_argument("--hosts", action="store_true",
@@ -855,6 +945,20 @@ def main(argv: list[str] | None = None) -> int:
                     help="device-side anatomy: compile ledger + recompile "
                          "verdict, device/host/input lap split, MFU, "
                          "memory watermarks")
+    ap.add_argument("--health", action="store_true",
+                    help="evaluate the health ruleset (telemetry.health): "
+                         "per-rule OK/WARN/CRIT verdicts, worst-severity "
+                         "rollup — and rewrite <workdir>/health.json, the "
+                         "machine contract")
+    ap.add_argument("--incidents", action="store_true",
+                    help="ordered incident timeline: alert raise/clear "
+                         "edges + recovery events + failed attempts, "
+                         "attributed to host/replica/stage/tenant")
+    ap.add_argument("--cluster", metavar="ROOT", default=None,
+                    help="discover every workdir under ROOT and render the "
+                         "cluster table: per-tenant goodput/occupancy, "
+                         "worst alert, heartbeat age (composes with "
+                         "--json/--watch; --slo arms the SLO rule)")
     ap.add_argument("--export-trace", metavar="OUT.json", default=None,
                     help="write the run's spans (serve requests + train "
                          "phases) as Chrome/Perfetto trace_event JSON")
@@ -871,12 +975,35 @@ def main(argv: list[str] | None = None) -> int:
     if args.watch and args.export_trace:
         ap.error("--watch and --export-trace are mutually exclusive "
                  "(export reads one finished stream)")
+    if args.cluster is None and args.workdir is None:
+        ap.error("a workdir is required (or --cluster ROOT)")
+    if args.cluster is not None:
+        return _cluster_main(args)
+
+    # --health runs through ONE engine for the whole invocation: a watch's
+    # successive evaluations share its incremental cursor and its flap-
+    # damping state (damping=1 one-shot: the report reflects the stream
+    # NOW; continuous damping belongs to a long-lived --watch/daemon).
+    # write_alerts=False — an inspector must not append to the stream it
+    # inspects; health.json is its only write.
+    engine = None
+    if args.health:
+        engine = health_lib.HealthEngine(
+            args.workdir, damping=(None if args.watch else 1),
+            slo_target_s=args.slo, slo_budget=args.slo_budget,
+            write_alerts=False)
 
     def build(events: list[dict]) -> dict:
-        return report(args.workdir, hosts=args.hosts,
-                      fleet_serve=args.fleet_serve, traces=args.traces,
-                      slo_target=args.slo, slo_budget=args.slo_budget,
-                      anatomy=args.anatomy, events=events)
+        rep = report(args.workdir, hosts=args.hosts,
+                     fleet_serve=args.fleet_serve, traces=args.traces,
+                     slo_target=args.slo, slo_budget=args.slo_budget,
+                     anatomy=args.anatomy, events=events)
+        if engine is not None:
+            rep["health"] = {k: v for k, v in engine.evaluate().items()
+                             if not k.startswith("_")}
+        if args.incidents:
+            rep["incidents"] = health_lib.incident_timeline(events)
+        return rep
 
     def emit_one(rep: dict) -> None:
         if args.json:
@@ -891,6 +1018,16 @@ def main(argv: list[str] | None = None) -> int:
     events = telemetry.read_events(args.workdir)
     rep = build(events)
     if not rep["num_events"]:
+        if rep["event_files"]:
+            # parseable-but-degraded: the files say a run was here (a
+            # crashed run's partial segment mid-rotation) — report that,
+            # don't die. The health rule says the same thing.
+            print(f"dlstatus: {len(rep['event_files'])} event file(s) under "
+                  f"{args.workdir} but no parseable events — degraded "
+                  f"stream (crashed run's partial segment?)",
+                  file=sys.stderr)
+            emit_one(rep)
+            return 0
         print(f"dlstatus: no telemetry events under {args.workdir} "
               f"(looked in {telemetry.telemetry_dir(args.workdir)})",
               file=sys.stderr)
@@ -911,20 +1048,70 @@ def main(argv: list[str] | None = None) -> int:
     return 0
 
 
-def _watch(args, build, emit_one) -> int:
-    """``--watch``: tail the stream, re-render on an interval.
+def _cluster_main(args) -> int:
+    """``--cluster ROOT``: the multi-workdir fold, composing with
+    ``--json`` (one report per line) and ``--watch``."""
 
-    A pure re-read per tick — the reader's ``events-*.jsonl`` glob already
-    follows segment rotation and newly appearing process files, and a
-    torn mid-append tail line is skipped exactly as in one-shot mode, so
-    following an in-progress run needs no writer cooperation. Human mode
-    clears the screen between renders on a TTY (a separator line
-    otherwise); ``--json`` emits one report line per tick, streamable
-    into ``jq``."""
+    def build() -> dict:
+        return health_lib.cluster_report(
+            args.cluster, slo_target_s=args.slo, slo_budget=args.slo_budget)
+
+    def emit_one(c: dict) -> None:
+        if args.json:
+            print(json.dumps(_json_safe(c), default=str))
+        else:
+            print(render_cluster(c))
+
+    if not args.watch:
+        c = build()
+        if not c["workdirs"]:
+            print(f"dlstatus: no telemetry workdirs under {args.cluster}",
+                  file=sys.stderr)
+            return 1
+        emit_one(c)
+        return 0
     renders = 0
     try:
         while True:
-            events = telemetry.read_events(args.workdir)
+            if not args.json:
+                if sys.stdout.isatty():
+                    print("\x1b[2J\x1b[H", end="")
+                elif renders:
+                    print("\n" + "=" * 72)
+            emit_one(build())
+            renders += 1
+            if args.watch_count and renders >= args.watch_count:
+                return 0
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+    except BrokenPipeError:
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+def _watch(args, build, emit_one) -> int:
+    """``--watch``: tail the stream, re-render on an interval.
+
+    Incremental per tick: an :class:`~..telemetry.EventCursor` keeps one
+    byte offset per segment file, so each tick parses only what was
+    appended since the last one — a long run's watch tick stops being
+    O(total events). The cursor's glob still follows segment rotation and
+    newly appearing process files, and a torn mid-append tail is held
+    back until its newline lands, so following an in-progress run needs
+    no writer cooperation. A workdir whose files hold no parseable events
+    (a crashed run's partial segment) renders as a degraded stream and
+    keeps following — it does not die. Human mode clears the screen
+    between renders on a TTY (a separator line otherwise); ``--json``
+    emits one report line per tick, streamable into ``jq``."""
+    renders = 0
+    cursor = telemetry.EventCursor(args.workdir)
+    try:
+        while True:
+            cursor.poll()
+            events = cursor.events
             if not args.json:
                 if sys.stdout.isatty():
                     print("\x1b[2J\x1b[H", end="")
@@ -937,12 +1124,19 @@ def _watch(args, build, emit_one) -> int:
                       + ", ctrl-C to stop)")
             if events:
                 emit_one(build(events))
-            elif args.json:
-                print(json.dumps({"workdir": args.workdir,
-                                  "num_events": 0}))
             else:
-                print(f"  no telemetry events yet under {args.workdir} "
-                      f"(waiting)")
+                files = telemetry.event_files(args.workdir)
+                if args.json:
+                    print(json.dumps({"workdir": args.workdir,
+                                      "num_events": 0,
+                                      "degraded": bool(files)}))
+                elif files:
+                    print(f"  {len(files)} event file(s) but no parseable "
+                          f"events under {args.workdir} — degraded stream "
+                          f"(crashed run's partial segment?); waiting")
+                else:
+                    print(f"  no telemetry events yet under {args.workdir} "
+                          f"(waiting)")
             renders += 1
             if args.watch_count and renders >= args.watch_count:
                 return 0

@@ -688,10 +688,16 @@ class Supervisor:
                         # a hang restart names the culprit host the fleet
                         # data localized — "restart (hang)" alone sends the
                         # operator grepping four hosts' logs
+                        # dead_host rides along even without a hang culprit
+                        # (a crash names one from the first failing rank) so
+                        # the incident timeline can attribute every restart,
+                        # not just the localized hangs
                         tele.recovery(
                             None, "restart", ordinal=ordinal,
                             classification=attempt.classification,
                             returncodes=attempt.returncodes,
+                            **({"dead_host": attempt.dead_host}
+                               if attempt.dead_host is not None else {}),
                             **self._culprit_fields(attempt))
                     # destructive fallback only on the EXPLICIT sentinel: the
                     # circumstantial classification (no progress + checkpoint

@@ -109,6 +109,17 @@ present):
   ``stage``/``step``/``mb``) plus one cross-process trace per microbatch
   whose context rides the transport frames — folded by
   :func:`.fleet.pipeline_anatomy` into the measured bubble fraction.
+- ``alert`` — one health-rule state *transition* from the continuous
+  health engine (:mod:`.health`): ``edge`` ("raise"/"clear"), ``rule``
+  (which rule fired), ``key`` (the dedup identity, e.g. ``slo:tenant0``
+  or ``hang:host2`` — one live alert per key, re-evaluations of an
+  already-raised state emit nothing), ``severity`` ("WARN"/"CRIT"; a
+  clear carries ``cleared_from``), ``summary`` (one operator-facing
+  line), ``evidence`` (the rule's measured inputs at the edge), and
+  ``held`` (evaluations the new state was held before the edge emitted
+  — the flap-damping receipt). Alert edges + ``recovery`` events are
+  the incident timeline ``dlstatus --incidents`` renders; the Chrome
+  exporter draws them as instant events on an ``alerts`` row.
 
 Worker-side events additionally carry ``host`` (the process index from the
 ``DLS_*`` env contract via :func:`~..utils.env.process_identity`, plus
@@ -148,6 +159,14 @@ WORKDIR_ENV = "DLS_TELEMETRY_DIR"
 #: them transparently). Unset/invalid = unbounded (the training default —
 #: runs are finite; long-lived serving fleets should cap).
 MAX_MB_ENV = "DLS_TELEMETRY_MAX_MB"
+
+#: Env var naming the tenant a run/fleet belongs to. When set (``dlsubmit
+#: --tenant`` exports it; the supervisor and serve fleet pass their env to
+#: children), every writer stamps ``tenant`` on its records — the attribution
+#: key ``dlstatus --cluster`` and the multi-tenant scheduler fold on. An
+#: explicit per-record ``tenant`` field (router tenant sheds, per-client
+#: serving tenants) always wins over the env-level stamp.
+TENANT_ENV = "DLS_TENANT"
 
 
 def _max_bytes_from_env() -> int | None:
@@ -216,9 +235,12 @@ class EventWriter:
 
     def __init__(self, workdir: str | os.PathLike, *, process: str | None = None,
                  clock=time.time, host: int | None | object = _HOST_FROM_ENV,
-                 hosts: int | None = None, max_mb: float | None = None):
+                 hosts: int | None = None, max_mb: float | None = None,
+                 tenant: str | None = None):
         self.workdir = os.path.abspath(os.fspath(workdir))
         self.process = process or _default_process()
+        self.tenant = tenant if tenant is not None else (
+            os.environ.get(TENANT_ENV) or None)
         # size-capped segment rotation (long-lived serving fleets must not
         # grow one unbounded file per process): segment 0 is the classic
         # ``events-<process>.jsonl``, later ones ``events-<process>.<n>.jsonl``
@@ -268,6 +290,10 @@ class EventWriter:
             rec.setdefault("host", self.host)
             if self.hosts > 1:
                 rec.setdefault("hosts", self.hosts)
+        if self.tenant is not None:
+            # setdefault: a record-level tenant (a router shed naming the
+            # tenant it throttled) is evidence; the env stamp is attribution
+            rec.setdefault("tenant", self.tenant)
         return rec
 
     def _resume_segment(self) -> None:
@@ -506,6 +532,24 @@ def event_files(workdir: str | os.PathLike) -> list[str]:
                                          "events-*.jsonl")))
 
 
+def _parse_event_line(line: str) -> dict | None:
+    """One JSONL line -> event dict, or None for torn/garbage lines.
+
+    A record must be a JSON object carrying ``ts`` and ``kind`` — anything
+    else (a half-written tail, an editor's stray newline, a non-event JSON
+    value) is not an event."""
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        return None
+    if isinstance(rec, dict) and "ts" in rec and "kind" in rec:
+        return rec
+    return None
+
+
 def read_events(workdir: str | os.PathLike) -> list[dict]:
     """Merge every process's event file into one ts-ordered stream.
 
@@ -518,19 +562,81 @@ def read_events(workdir: str | os.PathLike) -> list[dict]:
         try:
             with open(path) as f:
                 for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue  # torn tail / garbage line
-                    if isinstance(rec, dict) and "ts" in rec and "kind" in rec:
+                    rec = _parse_event_line(line)
+                    if rec is not None:
                         events.append(rec)
         except OSError:
             continue
     events.sort(key=lambda e: float(e["ts"]))
     return events
+
+
+class EventCursor:
+    """Incremental :func:`read_events`: per-file byte offsets so each poll
+    parses only what was appended since the last one.
+
+    ``dlstatus --watch`` and the health engine re-evaluate every few
+    seconds; re-parsing a long run's whole JSONL set each tick is O(total
+    events) per tick and grows without bound. The cursor keeps one byte
+    offset per segment file:
+
+    - **New files/segments** (a rotation, a late-joining process) enter the
+      glob on the next poll and are read from byte 0.
+    - **Torn tails** — a writer mid-append when we poll — are held back:
+      only complete (newline-terminated) lines are consumed, the offset
+      stays at the line start, and the finished line parses next poll.
+      A torn line is therefore *deferred*, never dropped (the one-shot
+      reader, arriving after the crash, skips it instead).
+    - **Truncated/replaced files** (offset beyond EOF) reset to 0.
+
+    ``events`` is the accumulated ts-sorted merge (what :func:`read_events`
+    would return, minus any still-torn tails); :meth:`poll` returns just the
+    newly appended records. ``skipped_lines`` counts complete-but-garbage
+    lines — the parseable-but-degraded signal the health engine reports
+    when a crashed run's partial segment is all a workdir has."""
+
+    def __init__(self, workdir: str | os.PathLike):
+        self.workdir = os.fspath(workdir)
+        self._offsets: dict[str, int] = {}
+        self.events: list[dict] = []
+        self.skipped_lines = 0
+
+    @property
+    def files(self) -> list[str]:
+        """Every segment file seen so far (polled at least once)."""
+        return sorted(self._offsets)
+
+    def poll(self) -> list[dict]:
+        """Read appended lines from every segment; return the new events
+        (also merged, ts-stably, into :attr:`events`)."""
+        new: list[dict] = []
+        for path in event_files(self.workdir):
+            off = self._offsets.setdefault(path, 0)
+            try:
+                size = os.path.getsize(path)
+                if size < off:
+                    off = self._offsets[path] = 0  # truncated/replaced
+                if size == off:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    data = f.read()
+            except OSError:
+                continue
+            end = data.rfind(b"\n")
+            if end < 0:
+                continue  # only a torn fragment so far — retry next poll
+            self._offsets[path] = off + end + 1
+            for raw in data[:end + 1].splitlines():
+                rec = _parse_event_line(raw.decode("utf-8", errors="replace"))
+                if rec is not None:
+                    new.append(rec)
+                elif raw.strip():
+                    self.skipped_lines += 1
+        if new:
+            self.events.extend(new)
+            self.events.sort(key=lambda e: float(e["ts"]))
+        return new
 
 
 # -- goodput accounting ------------------------------------------------------
@@ -594,7 +700,10 @@ def goodput(events: Iterable[dict]) -> dict[str, float]:
            "goodput_frac": 0.0}
     for c in _INTERVAL_COMPONENTS:
         out[c] = 0.0
-    events = [e for e in events if "ts" in e]
+    # alert events are meta-observation (the health engine watching the
+    # run), not run activity: a long-lived engine appending edges to a
+    # finished workdir must not stretch its wall-clock span
+    events = [e for e in events if "ts" in e and e.get("kind") != "alert"]
     if not events:
         return out
     events = sorted(events, key=lambda e: float(e["ts"]))

@@ -380,6 +380,24 @@ def _percentile(sorted_vals: list[float], q: float) -> float | None:
     return sorted_vals[i]
 
 
+#: gauge keys a replica row copies from its newest ``serve`` gauge, when
+#: present. Part of the :func:`serving_fleet` row CONTRACT (below) — the
+#: health engine and the future autoscaler read ``queue_depth`` and
+#: ``kv_page_occupancy`` from health.json, so removing or renaming one is
+#: a schema break the stability test pins.
+SERVE_GAUGE_KEYS = (
+    "kv_pages_total", "kv_pages_used", "kv_page_occupancy",
+    "prefix_hits", "prefix_misses", "prefix_hit_rate",
+    "prefix_tokens_saved", "active", "queue_depth", "params_version")
+
+#: request-fold keys every :func:`serving_fleet` replica row carries
+#: unconditionally (the gauge keys above join only when a gauge reported
+#: them). Exported so the stability test and the docs pin ONE list.
+SERVE_ROW_BASE_KEYS = (
+    "requests", "ok", "shed", "errors", "shed_rate",
+    "latency_p50_s", "latency_p99_s", "requests_per_s", "engines")
+
+
 def _fold_serving(reqs: list[dict], gauges: list[dict]) -> dict[str, Any]:
     """One serving row from request events + the newest ``serve`` gauge."""
     ok = [e for e in reqs if e.get("outcome") == "ok"]
@@ -401,11 +419,8 @@ def _fold_serving(reqs: list[dict], gauges: list[dict]) -> dict[str, Any]:
     }
     if gauges:
         g = gauges[-1]  # latest snapshot answers "what is the state NOW"
-        row.update({k: g.get(k) for k in (
-            "kv_pages_total", "kv_pages_used", "kv_page_occupancy",
-            "prefix_hits", "prefix_misses", "prefix_hit_rate",
-            "prefix_tokens_saved", "active", "params_version")
-            if g.get(k) is not None})
+        row.update({k: g.get(k) for k in SERVE_GAUGE_KEYS
+                    if g.get(k) is not None})
     return row
 
 
@@ -562,6 +577,15 @@ def latency_anatomy(events: Iterable[dict], *, slow_n: int = 3
 #: the period's budget is effectively gone (EXHAUSTED) — the SRE-workbook
 #: fast-burn threshold shape.
 SLO_EXHAUST_BURN = 10.0
+
+#: exact key set of every :func:`slo_report` tenant row and the totals row —
+#: a CONTRACT, not documentation: ``health.json`` copies ``burn_rate``/
+#: ``violation_frac``/``verdict`` per tenant and the future autoscaler
+#: scales on ``burn_rate``, so a rename here silently breaks machine
+#: consumers. The stability test pins this tuple against a live fold;
+#: extending the row means extending the tuple (append-only).
+SLO_ROW_KEYS = ("requests", "ok", "shed", "errors", "slow", "violations",
+                "violation_frac", "burn_rate", "p99_s", "verdict")
 
 
 def slo_report(events: Iterable[dict], *, target_p99_s: float,

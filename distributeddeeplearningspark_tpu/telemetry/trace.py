@@ -348,10 +348,15 @@ def chrome_trace(events: Iterable[dict]) -> dict[str, Any]:
     # memory watermark samples (telemetry/anatomy.py) become a counter
     # track per process — the HBM trendline next to the span timeline
     mems = [e for e in events if e.get("kind") == "memory"]
-    if not all_spans and not mems:
+    # health alert edges (telemetry/health.py) become instant events on an
+    # "alerts" row — the raise/clear markers lined up against the spans
+    # that explain them
+    alerts = [e for e in events if e.get("kind") == "alert"]
+    if not all_spans and not mems and not alerts:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     epoch = min([float(s["t0"]) for _, s in all_spans]
-                + [float(e["ts"]) for e in mems])
+                + [float(e["ts"]) for e in mems]
+                + [float(e["ts"]) for e in alerts])
 
     pids: dict[str, int] = {}
     tids: dict[tuple[int, str], int] = {}
@@ -403,4 +408,14 @@ def chrome_trace(events: Iterable[dict]) -> dict[str, Any]:
             "name": "memory", "cat": "memory", "ph": "C",
             "pid": pid_of(str(e.get("process") or "?")), "tid": 0,
             "ts": (float(e["ts"]) - epoch) * 1e6, "args": gauges})
+    for e in alerts:
+        pid = pid_of(str(e.get("process") or "health"))
+        trace_events.append({
+            "name": f"{e.get('edge', '?')} {e.get('key', '?')}",
+            "cat": "alert", "ph": "i", "s": "g",  # global-scope instant
+            "pid": pid, "tid": tid_of(pid, "alerts"),
+            "ts": (float(e["ts"]) - epoch) * 1e6,
+            "args": {k: e[k] for k in ("rule", "key", "severity", "edge",
+                                       "summary", "cleared_from", "held")
+                     if e.get(k) is not None}})
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}

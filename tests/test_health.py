@@ -1,0 +1,520 @@
+"""Health engine: rules, flap damping, alert edges, health.json, cluster.
+
+Everything runs on fake clocks — no sleeps, no real-time dependence — the
+engine takes an injectable clock and every rule is a pure fold over
+timestamped records. The live end-to-end drill (faulted serving fleet →
+CRIT naming the replica → clean rerun → clear edge) is ``tools/ci.sh
+health``; this file pins the contracts it relies on.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributeddeeplearningspark_tpu import status, telemetry
+from distributeddeeplearningspark_tpu.telemetry import fleet as fleet_lib
+from distributeddeeplearningspark_tpu.telemetry import health
+from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def _writer(tmp_path, process="p0", t0=0.0, **kw):
+    clock = FakeClock(t0)
+    return telemetry.EventWriter(tmp_path, process=process, clock=clock,
+                                 **kw), clock
+
+
+def _alert_events(workdir):
+    return [e for e in telemetry.read_events(workdir)
+            if e.get("kind") == "alert"]
+
+
+# -- incremental reads: EventCursor ------------------------------------------
+
+
+def test_cursor_second_poll_parses_only_appended_lines(tmp_path):
+    w, clock = _writer(tmp_path)
+    for step in (1, 2, 3):
+        w.heartbeat(step=step)
+        clock.tick(1.0)
+    cur = telemetry.EventCursor(tmp_path)
+    first = cur.poll()
+    assert [e["step"] for e in first] == [1, 2, 3]
+    # append two more; the second poll must surface exactly those two
+    w.heartbeat(step=4)
+    clock.tick(1.0)
+    w.heartbeat(step=5)
+    w.close()
+    second = cur.poll()
+    assert [e["step"] for e in second] == [4, 5]
+    assert [e["step"] for e in cur.events] == [1, 2, 3, 4, 5]
+    # nothing new -> empty, state unchanged
+    assert cur.poll() == []
+    assert len(cur.events) == 5
+
+
+def test_cursor_holds_back_torn_tail_until_completed(tmp_path):
+    tdir = tmp_path / telemetry.TELEMETRY_DIRNAME
+    tdir.mkdir()
+    path = tdir / "events-p0.jsonl"
+    whole = json.dumps({"ts": 1.0, "kind": "heartbeat", "step": 1})
+    torn = json.dumps({"ts": 2.0, "kind": "heartbeat", "step": 2})
+    with open(path, "w") as f:
+        f.write(whole + "\n" + torn[:10])  # mid-record crash: no newline
+    cur = telemetry.EventCursor(tmp_path)
+    assert [e["step"] for e in cur.poll()] == [1]
+    # the torn fragment was NOT consumed: completing the line surfaces it
+    with open(path, "a") as f:
+        f.write(torn[10:] + "\n")
+    assert [e["step"] for e in cur.poll()] == [2]
+    assert cur.skipped_lines == 0
+
+
+def test_cursor_tolerates_truncation_and_garbage(tmp_path):
+    tdir = tmp_path / telemetry.TELEMETRY_DIRNAME
+    tdir.mkdir()
+    path = tdir / "events-p0.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"ts": 1.0, "kind": "heartbeat"}) + "\n")
+        f.write("this is not json\n")
+    cur = telemetry.EventCursor(tmp_path)
+    assert len(cur.poll()) == 1
+    assert cur.skipped_lines == 1
+    # file replaced with a shorter one (rotation/copy-truncate): the
+    # cursor resets its offset instead of seeking past EOF forever
+    with open(path, "w") as f:
+        f.write(json.dumps({"ts": 5.0, "kind": "heartbeat", "step": 9})
+                + "\n")
+    assert [e["step"] for e in cur.poll()] == [9]
+
+
+def test_cursor_picks_up_new_files(tmp_path):
+    w0, _ = _writer(tmp_path, process="p0")
+    w0.heartbeat(step=1)
+    w0.close()
+    cur = telemetry.EventCursor(tmp_path)
+    assert len(cur.poll()) == 1
+    w1, _ = _writer(tmp_path, process="p1", t0=0.5)
+    w1.heartbeat(step=2)
+    w1.close()
+    assert [e["process"] for e in cur.poll()] == ["p1"]
+    # accumulated view stays ts-sorted across files
+    assert [e["ts"] for e in cur.events] == [0.0, 0.5]
+
+
+# -- tenant stamping ----------------------------------------------------------
+
+
+def test_tenant_env_stamps_every_record(tmp_path, monkeypatch):
+    monkeypatch.setenv(telemetry.TENANT_ENV, "teamA")
+    w, _ = _writer(tmp_path)
+    w.heartbeat(step=1)
+    w.emit("request", outcome="ok", latency_s=0.1, tenant="explicit")
+    w.close()
+    events = telemetry.read_events(tmp_path)
+    assert events[0]["tenant"] == "teamA"
+    # a record-level tenant (the router's attribution) wins over the env
+    assert events[1]["tenant"] == "explicit"
+
+
+# -- flap damping and alert edges ---------------------------------------------
+
+
+def _gauge_engine(tmp_path, damping):
+    w, clock = _writer(tmp_path)
+    eng = health.HealthEngine(tmp_path, damping=damping, clock=clock)
+    return w, clock, eng
+
+
+def test_oscillating_rule_emits_zero_edges(tmp_path):
+    """A rule flipping OK<->CRIT every evaluation never confirms, so the
+    bus sees nothing — the whole point of flap damping."""
+    w, clock, eng = _gauge_engine(tmp_path, damping=3)
+    for i in range(8):
+        w.emit("serve", queue_depth=100 if i % 2 == 0 else 0)
+        clock.tick(1.0)
+        eng.evaluate()
+    eng.close()
+    w.close()
+    assert _alert_events(tmp_path) == []
+    assert eng._state == {}
+
+
+def test_damping_holds_n_evaluations_then_raises_once(tmp_path):
+    w, clock, eng = _gauge_engine(tmp_path, damping=3)
+    w.emit("serve", queue_depth=100)
+    w.close()
+    for _ in range(2):
+        clock.tick(1.0)
+        rep = eng.evaluate()
+        assert _alert_events(tmp_path) == []       # still pending
+        assert rep["worst_severity"] == "OK"
+    clock.tick(1.0)
+    rep = eng.evaluate()
+    alerts = _alert_events(tmp_path)
+    assert [(a["edge"], a["key"], a["severity"], a["held"])
+            for a in alerts] == [("raise", "queue:p0", "CRIT", 3)]
+    assert rep["worst_severity"] == "CRIT"
+    assert [a["key"] for a in rep["alerts_active"]] == ["queue:p0"]
+    # identical re-raises dedup: further evaluations emit nothing new
+    for _ in range(3):
+        clock.tick(1.0)
+        eng.evaluate()
+    assert len(_alert_events(tmp_path)) == 1
+    eng.close()
+
+
+def test_clear_edge_pairs_with_raise(tmp_path):
+    w, clock, eng = _gauge_engine(tmp_path, damping=2)
+    w.emit("serve", queue_depth=100)
+    for _ in range(2):
+        clock.tick(1.0)
+        eng.evaluate()
+    assert [a["edge"] for a in _alert_events(tmp_path)] == ["raise"]
+    # condition recovers; the clear must also hold `damping` evaluations
+    w.emit("serve", queue_depth=0)
+    w.close()
+    clock.tick(1.0)
+    eng.evaluate()
+    assert [a["edge"] for a in _alert_events(tmp_path)] == ["raise"]
+    clock.tick(1.0)
+    rep = eng.evaluate()
+    eng.close()
+    alerts = _alert_events(tmp_path)
+    assert [(a["edge"], a["key"]) for a in alerts] == [
+        ("raise", "queue:p0"), ("clear", "queue:p0")]
+    clear = alerts[-1]
+    assert clear["severity"] == "OK" and clear["cleared_from"] == "CRIT"
+    assert rep["worst_severity"] == "OK" and rep["alerts_active"] == []
+
+
+def test_escalation_carries_prev_severity(tmp_path):
+    w, clock, eng = _gauge_engine(tmp_path, damping=1)
+    w.emit("serve", queue_depth=10)      # warn >= 8
+    clock.tick(1.0)
+    eng.evaluate()
+    w.emit("serve", queue_depth=50)      # crit >= 32
+    w.close()
+    clock.tick(1.0)
+    eng.evaluate()
+    eng.close()
+    alerts = _alert_events(tmp_path)
+    assert [a["severity"] for a in alerts] == ["WARN", "CRIT"]
+    assert alerts[1]["prev"] == "WARN"
+
+
+# -- health.json contract -----------------------------------------------------
+
+HEALTH_KEYS = {
+    "schema", "generated_ts", "workdir", "worst_severity", "rules",
+    "goodput", "slo", "queue_depth", "tenants", "last_step",
+    "last_heartbeat_age_s", "stream", "evaluations", "alerts_active",
+}
+
+
+def test_health_json_schema_and_no_internal_keys(tmp_path):
+    w, clock, eng = _gauge_engine(tmp_path, damping=1)
+    w.heartbeat(step=7)
+    w.close()
+    clock.tick(1.0)
+    eng.evaluate()
+    eng.close()
+    path = os.path.join(str(tmp_path), health.HEALTH_FILENAME)
+    with open(path) as f:
+        doc = json.load(f)
+    assert set(doc) == HEALTH_KEYS
+    assert doc["schema"] == health.HEALTH_SCHEMA
+    assert doc["worst_severity"] in health.SEVERITIES
+    assert doc["last_step"] == 7
+    assert set(doc["rules"]) == {name for name, _ in health.RULES}
+    # the atomic rewrite leaves no temp droppings behind
+    assert [p for p in os.listdir(tmp_path) if ".tmp." in p] == []
+
+
+def test_worst_severity_ladder():
+    assert health.worst_severity([]) == "OK"
+    assert health.worst_severity(["OK", "WARN"]) == "WARN"
+    assert health.worst_severity(["WARN", "CRIT", "OK"]) == "CRIT"
+
+
+# -- rules --------------------------------------------------------------------
+
+
+def test_slo_rule_names_worst_replica(tmp_path):
+    wa, ca = _writer(tmp_path, process="p0")
+    wb, cb = _writer(tmp_path, process="p1")
+    for i in range(20):
+        wa.emit("request", outcome="ok", latency_s=2.0, tenant="t0")
+        wb.emit("request", outcome="ok", latency_s=0.01, tenant="t0")
+        ca.tick(0.5)
+        cb.tick(0.5)
+    wa.close()
+    wb.close()
+    rep = health.evaluate_health(telemetry.read_events(tmp_path),
+                                 slo_target_s=0.5)
+    slo = [v for v in rep["_verdicts"] if v["rule"] == "slo"]
+    assert len(slo) == 1
+    v = slo[0]
+    assert v["key"] == "slo:t0" and v["severity"] == "CRIT"
+    assert v["evidence"]["worst_replica"] == "p0"
+    assert "worst replica p0" in v["summary"]
+    assert rep["slo"]["tenants"]["t0"]["verdict"] == "EXHAUSTED"
+
+
+def test_windowed_rules_clear_on_clean_rerun(tmp_path):
+    w, clock = _writer(tmp_path)
+    for _ in range(20):
+        w.emit("request", outcome="ok", latency_s=2.0)
+        clock.tick(0.5)
+    # a clean rerun appended much later: the trailing window holds only it
+    clock.t = 1000.0
+    for _ in range(20):
+        w.emit("request", outcome="ok", latency_s=0.01)
+        clock.tick(0.1)
+    w.close()
+    events = telemetry.read_events(tmp_path)
+    burning = health.evaluate_health(events, slo_target_s=0.5, now=10.0,
+                                     window_s=50.0)
+    assert any(v["rule"] == "slo" for v in burning["_verdicts"])
+    healed = health.evaluate_health(events, slo_target_s=0.5, now=1002.0,
+                                    window_s=50.0)
+    assert [v for v in healed["_verdicts"] if v["rule"] == "slo"] == []
+    assert healed["worst_severity"] == "OK"
+
+
+def test_restart_storm_rule(tmp_path):
+    w, clock = _writer(tmp_path)
+    for i in range(4):
+        w.recovery(i * 10, "restart", classification="training-crash")
+        clock.tick(5.0)
+    w.close()
+    rep = health.evaluate_health(telemetry.read_events(tmp_path))
+    storm = [v for v in rep["_verdicts"] if v["rule"] == "restarts"]
+    assert len(storm) == 1 and storm[0]["severity"] == "CRIT"
+    assert storm[0]["evidence"]["classifications"] == ["training-crash"]
+
+
+def test_degraded_stream_rule_and_engine(tmp_path):
+    """Satellite: a workdir whose only file is a crashed run's torn
+    partial segment is parseable-but-degraded, never a crash."""
+    tdir = tmp_path / telemetry.TELEMETRY_DIRNAME
+    tdir.mkdir()
+    with open(tdir / "events-p0.jsonl", "w") as f:
+        f.write('{"ts": 1.0, "kind": "step_m')  # torn mid-record, no \n
+    eng = health.HealthEngine(tmp_path, damping=1, clock=FakeClock(5.0),
+                              write_alerts=False)
+    rep = eng.evaluate()
+    eng.close()
+    assert rep["worst_severity"] == "WARN"
+    assert rep["stream"]["degraded"] is True
+    assert [a["key"] for a in rep["alerts_active"]] == ["stream:degraded"]
+
+
+def test_engine_ignores_its_own_alerts_for_degradation(tmp_path):
+    """The engine's alert stream must not count as workdir liveness,
+    or a degraded workdir would raise->self-clear forever."""
+    tdir = tmp_path / telemetry.TELEMETRY_DIRNAME
+    tdir.mkdir()
+    with open(tdir / "events-p0.jsonl", "w") as f:
+        f.write("garbage, not json\n")
+    clock = FakeClock(5.0)
+    eng = health.HealthEngine(tmp_path, damping=1, clock=clock)
+    for _ in range(4):
+        clock.tick(1.0)
+        eng.evaluate()
+    eng.close()
+    alerts = _alert_events(tmp_path)
+    # one raise, held forever: its own edges never read as recovery
+    assert [(a["edge"], a["key"]) for a in alerts] == [
+        ("raise", "stream:degraded")]
+
+
+# -- schema stability: serving / SLO row contracts ----------------------------
+
+
+def test_serving_fleet_row_key_stability(tmp_path):
+    w, clock = _writer(tmp_path)
+    w.emit("request", outcome="ok", latency_s=0.1, engine="e0")
+    clock.tick(1.0)
+    w.emit("request", outcome="shed")
+    gauge = {k: 1 for k in fleet_lib.SERVE_GAUGE_KEYS}
+    w.emit("serve", **gauge)
+    w.close()
+    sf = fleet_lib.serving_fleet(telemetry.read_events(tmp_path))
+    row = sf["replicas"][0]
+    assert set(row) == (set(fleet_lib.SERVE_ROW_BASE_KEYS)
+                        | set(fleet_lib.SERVE_GAUGE_KEYS) | {"process"})
+    assert "queue_depth" in fleet_lib.SERVE_GAUGE_KEYS
+
+
+def test_slo_row_key_stability(tmp_path):
+    w, clock = _writer(tmp_path)
+    for i in range(10):
+        w.emit("request", outcome="ok", latency_s=0.01 if i else 2.0,
+               tenant="t0")
+        clock.tick(0.5)
+    w.close()
+    slo = fleet_lib.slo_report(telemetry.read_events(tmp_path),
+                               target_p99_s=0.5)
+    for row in slo["tenants"].values():
+        assert set(row) == set(fleet_lib.SLO_ROW_KEYS)
+    assert "burn_rate" in fleet_lib.SLO_ROW_KEYS
+
+
+# -- incident timeline --------------------------------------------------------
+
+
+def test_incident_timeline_orders_and_attributes(tmp_path):
+    events = [
+        {"ts": 1.0, "kind": "alert", "edge": "raise", "rule": "slo",
+         "key": "slo:t0", "severity": "CRIT", "summary": "burning",
+         "evidence": {"worst_replica": "p0"}},
+        {"ts": 2.0, "kind": "recovery", "event": "replica-restart",
+         "replica": "r0", "process": "router"},
+        {"ts": 3.0, "kind": "alert", "edge": "clear", "rule": "slo",
+         "key": "slo:t0", "severity": "OK", "cleared_from": "CRIT",
+         "summary": "cleared: burning"},
+        {"ts": 0.5, "kind": "attempt", "edge": "end", "ordinal": 0,
+         "classification": "training-crash", "returncodes": [1]},
+        {"ts": 0.6, "kind": "attempt", "edge": "end", "ordinal": 1,
+         "classification": "clean", "returncodes": [0]},
+        {"ts": 0.7, "kind": "step_metrics", "step": 1},  # not an incident
+    ]
+    rows = health.incident_timeline(events)
+    assert [r["type"] for r in rows] == [
+        "attempt-end", "alert-raise", "recovery", "alert-clear"]
+    assert rows[1]["who"] == "replica p0"
+    assert rows[2]["who"] == "replica r0"
+    assert rows[3]["cleared_from"] == "CRIT"
+    assert "training-crash" in rows[0]["summary"]
+
+
+# -- cluster view -------------------------------------------------------------
+
+
+def _train_workdir(root, name, tenant):
+    wd = os.path.join(root, name)
+    clock = FakeClock(0.0)
+    w = telemetry.EventWriter(wd, process="p0", clock=clock, tenant=tenant)
+    for step in range(1, 4):
+        w.step_metrics(step, steps=1, lap_s=1.0, metrics={})
+        clock.tick(1.0)
+    w.heartbeat(step=3)
+    w.close()
+    return wd
+
+
+def _serve_workdir(root, name, tenant):
+    wd = os.path.join(root, name)
+    clock = FakeClock(0.0)
+    w = telemetry.EventWriter(wd, process="p0", clock=clock)
+    for _ in range(10):
+        w.emit("request", outcome="ok", latency_s=0.01, tenant=tenant)
+        clock.tick(0.2)
+    w.emit("serve", kv_page_occupancy=0.5, queue_depth=1)
+    w.close()
+    return wd
+
+
+def test_cluster_report_folds_workdirs_and_tenants(tmp_path):
+    root = str(tmp_path)
+    wd_a = _train_workdir(root, "jobs/mnist", "teamA")
+    wd_b = _serve_workdir(root, "serve/llm", "teamB")
+    rep = health.cluster_report(root)
+    assert [r["workdir"] for r in rep["workdirs"]] == sorted([wd_a, wd_b])
+    by_wd = {r["workdir"]: r for r in rep["workdirs"]}
+    assert by_wd[wd_a]["kind"] == "train"
+    assert by_wd[wd_a]["tenants"] == ["teamA"]
+    assert by_wd[wd_a]["last_step"] == 3
+    assert by_wd[wd_b]["kind"] == "serve"
+    assert by_wd[wd_b]["tenants"] == ["teamB"]
+    assert by_wd[wd_b]["occupancy"] == 0.5
+    assert set(rep["tenants"]) == {"teamA", "teamB"}
+    assert rep["tenants"]["teamB"]["requests"] == 10
+    assert rep["tenants"]["teamB"]["serve_workdirs"] == 1
+    assert rep["tenants"]["teamA"]["train_workdirs"] == 1
+    assert rep["worst_severity"] in health.SEVERITIES
+
+
+def test_discover_workdirs_strips_telemetry_dir(tmp_path):
+    root = str(tmp_path)
+    wd = _train_workdir(root, "a/b/c", "t")
+    assert health.discover_workdirs(root) == [wd]
+    assert health.discover_workdirs(os.path.join(root, "empty-miss")) == []
+
+
+# -- dlstatus surfaces --------------------------------------------------------
+
+
+def test_dlstatus_health_and_incidents_json(tmp_path, capsys):
+    w, clock = _writer(tmp_path)
+    for _ in range(10):
+        w.emit("request", outcome="ok", latency_s=2.0)
+        clock.tick(0.5)
+    w.close()
+    rc = status.main([str(tmp_path), "--health", "--incidents", "--json",
+                      "--slo", "0.5"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["health"]["worst_severity"] == "CRIT"
+    assert "_verdicts" not in doc["health"]
+    assert set(doc["health"]) == HEALTH_KEYS
+    assert doc["incidents"] == []  # no edges were ever written
+
+
+def test_dlstatus_degraded_workdir_is_rc0(tmp_path, capsys):
+    """Satellite: a crashed run's partial segment must render a degraded
+    notice, not die. rc 1 is reserved for 'no telemetry files at all'."""
+    tdir = tmp_path / telemetry.TELEMETRY_DIRNAME
+    tdir.mkdir()
+    with open(tdir / "events-p0.jsonl", "w") as f:
+        f.write('{"ts": 1.0, "kind": "step_m')  # torn, nothing parses
+    assert status.main([str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["num_events"] == 0
+    empty = tmp_path / "no-telemetry-here"
+    empty.mkdir()
+    assert status.main([str(empty)]) == 1
+
+
+def test_dlstatus_cluster_json(tmp_path, capsys):
+    root = str(tmp_path)
+    _train_workdir(root, "job0", "teamA")
+    rc = status.main(["--cluster", root, "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["workdirs"]) == 1
+    assert doc["workdirs"][0]["tenants"] == ["teamA"]
+    # an empty root is an error: nothing to report on
+    empty = os.path.join(root, "job0", "nope")
+    os.makedirs(empty)
+    assert status.main(["--cluster", empty]) == 1
+
+
+# -- trace export -------------------------------------------------------------
+
+
+def test_chrome_trace_renders_alert_instants(tmp_path):
+    w, clock, eng = _gauge_engine(tmp_path, damping=1)
+    w.emit("serve", queue_depth=100)
+    w.close()
+    clock.tick(1.0)
+    eng.evaluate()
+    eng.close()
+    doc = trace_lib.chrome_trace(telemetry.read_events(tmp_path))
+    marks = [e for e in doc["traceEvents"] if e.get("cat") == "alert"]
+    assert len(marks) == 1
+    assert marks[0]["ph"] == "i"
+    assert marks[0]["name"] == "raise queue:p0"
+    assert marks[0]["args"]["severity"] == "CRIT"

@@ -1198,6 +1198,147 @@ PYEOF
   return $rc
 }
 
+# health smoke (ISSUE 17): the continuous health engine end-to-end on a
+# REAL fleet. A faulted 2-replica tinyllama run (sleep injected into
+# replica 0) must confirm a CRIT SLO alert NAMING the replica after the
+# damping hold; removing the fault (clean rerun with a rolling reload
+# appended to the SAME workdir) must emit the paired clear edge;
+# health.json must carry the exact schema key set at BOTH edges;
+# `dlstatus --incidents` must order raise -> recovery -> clear; and
+# `dlstatus --cluster` over a root holding this workdir plus a tenanted
+# train_mnist run must show both rows under the right tenants
+# (docs/OBSERVABILITY.md "Alerts, health.json, and the cluster view").
+run_health_smoke() {
+  local t0 rc root out
+  t0=$(date +%s)
+  rc=0
+  root=$(mktemp -d /tmp/dls_health_smoke.XXXXXX)
+  out=$(ROOT="$root" python - <<'PYEOF'
+import json, os, subprocess, sys
+
+from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.telemetry import health
+
+root = os.environ["ROOT"]
+wd = os.path.join(root, "serve")
+wdt = os.path.join(root, "train")
+
+SERVE = [sys.executable, "-m", "distributeddeeplearningspark_tpu.serve.cli",
+         "--model", "tinyllama", "--replicas", "2", "--clients", "4",
+         "--requests-per-client", "3", "--tenants", "2",
+         "--prefix-tokens", "32", "--suffix-tokens", "8",
+         "--max-new-tokens", "8", "--workdir", wd]
+
+HEALTH_KEYS = {
+    "schema", "generated_ts", "workdir", "worst_severity", "rules",
+    "goodput", "slo", "queue_depth", "tenants", "last_step",
+    "last_heartbeat_age_s", "stream", "evaluations", "alerts_active"}
+
+
+def run(cmd, log, env=None):
+    with open(log, "w") as f:
+        p = subprocess.run(cmd, stdout=f, stderr=subprocess.STDOUT, env=env)
+    assert p.returncode == 0, (cmd[-6:], open(log).read()[-800:])
+
+
+def dlstatus(*argv):
+    p = subprocess.run(
+        [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+         *argv], capture_output=True, text=True)
+    assert p.returncode == 0, (argv, p.stderr[-500:])
+    return json.loads(p.stdout)
+
+
+def last_ts():
+    return max(float(e["ts"]) for e in telemetry.read_events(wd))
+
+
+def health_doc():
+    with open(os.path.join(wd, health.HEALTH_FILENAME)) as f:
+        doc = json.load(f)
+    assert set(doc) == HEALTH_KEYS, sorted(set(doc) ^ HEALTH_KEYS)
+    assert doc["schema"] == health.HEALTH_SCHEMA
+    return doc
+
+# A) healthy baseline: the fleet's own p99 derives the SLO target, so the
+#    drill judges fault-vs-clean, not this machine's absolute speed
+run(SERVE, os.path.join(root, "serve-baseline.log"))
+lats = sorted(float(e["latency_s"]) for e in telemetry.read_events(wd)
+              if e.get("kind") == "request" and e.get("outcome") == "ok"
+              and e.get("latency_s") is not None)
+assert lats, "baseline served nothing"
+target = max(1.0, 1.5 * lats[int(0.99 * (len(lats) - 1))])
+boundary = last_ts()
+
+# B) fault injected into replica 0 -> CRIT raise edge naming it. The
+#    engine's event-time window is sized to hold exactly the events past
+#    the boundary, so the healthy baseline can't dilute the burn rate.
+run(SERVE + ["--fault-sleep-ms", "2000", "--fault-replica", "0"],
+    os.path.join(root, "serve-faulted.log"))
+eng = health.HealthEngine(wd, damping=2, slo_target_s=target,
+                          window_s=(last_ts() - boundary) * 0.9)
+rep = eng.evaluate()
+assert rep["worst_severity"] == "OK", ("raised before damping hold", rep)
+rep = eng.evaluate()
+slo_alerts = [a for a in rep["alerts_active"] if a["rule"] == "slo"]
+assert rep["worst_severity"] == "CRIT" and slo_alerts, rep["alerts_active"]
+assert slo_alerts[0]["evidence"]["worst_replica"] == "p0", slo_alerts
+crit_doc = health_doc()
+assert crit_doc["worst_severity"] == "CRIT", crit_doc["worst_severity"]
+
+# C) fault removed: a clean rerun (with a rolling reload, so a recovery
+#    event lands between the edges) appended to the SAME workdir must
+#    clear -- same damping hold, paired edge
+boundary = last_ts()
+run(SERVE + ["--rolling-reload"], os.path.join(root, "serve-rerun.log"))
+eng.window_s = (last_ts() - boundary) * 0.9
+eng.evaluate()
+rep = eng.evaluate()
+eng.close()
+assert rep["worst_severity"] == "OK", rep["alerts_active"]
+assert rep["alerts_active"] == [], rep["alerts_active"]
+ok_doc = health_doc()
+assert ok_doc["worst_severity"] == "OK", ok_doc["worst_severity"]
+
+# D) the incident timeline orders raise -> recovery -> clear
+rows = dlstatus(wd, "--incidents", "--json")["incidents"]
+raise_ts = min(r["ts"] for r in rows
+               if r["type"] == "alert-raise" and r["rule"] == "slo")
+clear_ts = max(r["ts"] for r in rows
+               if r["type"] == "alert-clear" and r["rule"] == "slo")
+reloads = [r["ts"] for r in rows
+           if r["type"] == "recovery" and r["key"] == "rolling-reload"]
+assert raise_ts < clear_ts, (raise_ts, clear_ts)
+assert any(raise_ts < t < clear_ts for t in reloads), (
+    raise_ts, reloads, clear_ts)
+
+# E) a second, tenanted train workdir under the same root: the cluster
+#    view folds both with the right kinds and tenants
+env = dict(os.environ, DLS_TELEMETRY_DIR=wdt, DLS_TENANT="research",
+           XLA_FLAGS="--xla_force_host_platform_device_count=8")
+run([sys.executable, "examples/train_mnist.py", "--master", "local[2]",
+     "--steps", "6", "--batch-size", "16"],
+    os.path.join(root, "train.log"), env=env)
+cl = dlstatus("--cluster", root, "--json")
+by_wd = {r["workdir"]: r for r in cl["workdirs"]}
+assert set(by_wd) == {wd, wdt}, sorted(by_wd)
+assert by_wd[wd]["kind"] == "serve" and by_wd[wdt]["kind"] == "train", by_wd
+assert by_wd[wdt]["tenants"] == ["research"], by_wd[wdt]["tenants"]
+assert {"tenant0", "tenant1"} <= set(by_wd[wd]["tenants"]), by_wd[wd]
+assert cl["tenants"]["research"]["train_workdirs"] == 1, cl["tenants"]
+assert cl["tenants"]["tenant0"]["requests"] > 0, cl["tenants"]
+
+print(f"target_p99={target:.2f}s raise=CRIT(worst=p0) clear=OK "
+      f"incidents={len(rows)} cluster_workdirs={len(by_wd)} "
+      f"tenants={sorted(cl['tenants'])}")
+PYEOF
+) || { rc=$?; tail -5 "$root"/*.log 2>/dev/null; }
+  log health "${out:-health smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[health] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$root"
+  return $rc
+}
+
 overall=0
 case "${1:-both}" in
   fast) run_tier fast "not slow" || overall=$? ;;
@@ -1210,6 +1351,7 @@ case "${1:-both}" in
         run_live_reshard_smoke || overall=$?
         run_mpmd_smoke || overall=$?
         run_plan_smoke || overall=$?
+        run_health_smoke || overall=$?
         run_perf_guard_smoke || overall=$? ;;
   # the recovery drills (kill-mid-finalize, poisoned restore, hang, NaN
   # spike) end-to-end — slow-marked, so the fast tier never pays for gangs
@@ -1267,10 +1409,15 @@ case "${1:-both}" in
   # regression sentinel: BENCH history passes, synthetic 20%-slower
   # record trips rc!=0 with the metric named (tools/perf_guard.py)
   perf-guard) run_perf_guard_smoke || overall=$? ;;
+  # continuous health engine: faulted fleet -> damped CRIT SLO alert
+  # naming the replica -> clean rerun -> paired clear edge, health.json
+  # schema at both edges, --incidents ordering, --cluster fold
+  # (docs/OBSERVABILITY.md "Alerts, health.json, and the cluster view")
+  health) run_health_smoke || overall=$? ;;
   # the executable pod-day scripts, logged with the same audit trail
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|live-reshard|mpmd|plan|perf-guard|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|live-reshard|mpmd|plan|perf-guard|health|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
