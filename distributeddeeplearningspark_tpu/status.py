@@ -59,6 +59,7 @@ from distributeddeeplearningspark_tpu import telemetry
 from distributeddeeplearningspark_tpu.telemetry import anatomy as anatomy_lib
 from distributeddeeplearningspark_tpu.telemetry import fleet as fleet_lib
 from distributeddeeplearningspark_tpu.telemetry import health as health_lib
+from distributeddeeplearningspark_tpu.telemetry import series as series_lib
 
 #: goodput components rendered in the breakdown table, in display order.
 _COMPONENTS = telemetry.GOODPUT_COMPONENTS
@@ -667,6 +668,52 @@ def render_incidents(rows: list[dict], first_ts: float | None) -> list[str]:
     return lines
 
 
+_TREND_ARROWS = {"rising": "↗", "falling": "↘", "flat": "→"}
+
+
+def _trend_arrow(t: dict | str | None) -> str:
+    """Cell for a trend verdict (or a workdir's trend dict; '-' when the
+    workdir has no series store)."""
+    if not t:
+        return "-"
+    verdict = t if isinstance(t, str) else t.get("trend")
+    return _TREND_ARROWS.get(verdict, "?")
+
+
+def _parse_duration(raw: str) -> float:
+    """``90s`` / ``10m`` / ``2h`` / ``1d`` / bare seconds -> seconds."""
+    raw = str(raw).strip()
+    mult = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}.get(
+        raw[-1:].lower())
+    if mult is not None:
+        return float(raw[:-1]) * mult
+    return float(raw)
+
+
+def _fmt_sig(v: float | None) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.4g}"
+
+
+def render_history(hist: dict) -> str:
+    """The ``--history`` view: one sparkline row per series with
+    min/mean/max/last and the fitted trend verdict."""
+    lines = [
+        f"history: {hist['workdir']}  resolution {hist['resolution_s']:g}s "
+        f"over last {hist['since_s']:g}s  ({len(hist['series'])} series)"]
+    for r in hist["series"]:
+        lines.append(
+            f"  {r['key']:<34} {r['spark']}  "
+            f"min {_fmt_sig(r['min'])}  mean {_fmt_sig(r['mean'])}  "
+            f"max {_fmt_sig(r['max'])}  last {_fmt_sig(r['last'])}  "
+            f"{_trend_arrow(r['trend'])} {r['trend']}")
+    if not hist["series"]:
+        lines.append("  (no buckets in range — is the health engine "
+                     "recording? try a longer --since)")
+    return "\n".join(lines)
+
+
 def render_cluster(c: dict) -> str:
     """The ``--cluster`` table: one row per discovered workdir + the
     per-tenant rollup."""
@@ -675,8 +722,8 @@ def render_cluster(c: dict) -> str:
         f"cluster: {len(c['workdirs'])} workdir(s) under {c['root']}  "
         f"worst={c['worst_severity']}")
     lines.append(
-        f"  {'workdir':<32} {'kind':<6} {'tenants':<16} {'goodput':>7}  "
-        f"{'occ':>5}  {'hb age':>7}  {'step':>7}  worst alert")
+        f"  {'workdir':<32} {'kind':<6} {'tenants':<16} {'goodput':>7} "
+        f"{'trend':>5}  {'occ':>5}  {'hb age':>7}  {'step':>7}  worst alert")
     for r in c["workdirs"]:
         wd = r["workdir"]
         if len(wd) > 32:
@@ -687,7 +734,8 @@ def render_cluster(c: dict) -> str:
             worst += " (degraded stream)"
         lines.append(
             f"  {wd:<32} {r['kind']:<6} {','.join(r['tenants']):<16} "
-            f"{r['goodput_frac']:>7.3f}  {_fmt_pct(r['occupancy']):>5}  "
+            f"{r['goodput_frac']:>7.3f} {_trend_arrow(r.get('trend')):>5}  "
+            f"{_fmt_pct(r['occupancy']):>5}  "
             f"{_fmt_s(r['last_heartbeat_age_s']):>7}  "
             f"{r['last_step'] if r['last_step'] is not None else '-':>7}  "
             f"{worst}")
@@ -959,6 +1007,29 @@ def main(argv: list[str] | None = None) -> int:
                          "cluster table: per-tenant goodput/occupancy, "
                          "worst alert, heartbeat age (composes with "
                          "--json/--watch; --slo arms the SLO rule)")
+    ap.add_argument("--history", nargs="?", const="*", metavar="KEY",
+                    default=None,
+                    help="render the downsampled series history as "
+                         "sparklines with min/mean/max/trend verdicts "
+                         "(all series, or one KEY like "
+                         "'queue_depth{replica=p0}' or a bare name); "
+                         "composes with --json (pinned schema) and "
+                         "--since")
+    ap.add_argument("--since", type=_parse_duration, default="1h",
+                    metavar="DUR",
+                    help="--history span: 90s / 10m / 2h / 1d or bare "
+                         "seconds (default 1h); picks the finest "
+                         "resolution whose ring covers it")
+    ap.add_argument("--resolution", type=float, default=None, metavar="S",
+                    help="--history: force a bucket width in seconds "
+                         "instead of auto-picking from --since")
+    ap.add_argument("--serve-metrics", type=int, metavar="PORT",
+                    default=None,
+                    help="serve an OpenMetrics/Prometheus text exposition "
+                         "of the newest series buckets + health.json "
+                         "verdicts on http://127.0.0.1:PORT/metrics "
+                         "(0 = ephemeral port, printed to stderr; "
+                         "--watch-count N answers N scrapes then exits)")
     ap.add_argument("--export-trace", metavar="OUT.json", default=None,
                     help="write the run's spans (serve requests + train "
                          "phases) as Chrome/Perfetto trace_event JSON")
@@ -979,6 +1050,10 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("a workdir is required (or --cluster ROOT)")
     if args.cluster is not None:
         return _cluster_main(args)
+    if args.serve_metrics is not None:
+        return _serve_metrics_main(args)
+    if args.history is not None:
+        return _history_main(args)
 
     # --health runs through ONE engine for the whole invocation: a watch's
     # successive evaluations share its incremental cursor and its flap-
@@ -1037,7 +1112,11 @@ def main(argv: list[str] | None = None) -> int:
             trace as trace_lib,
         )
 
-        data = trace_lib.chrome_trace(events)
+        ladder = series_lib.list_resolutions(args.workdir)
+        series_buckets = (
+            series_lib.read_buckets(args.workdir, ladder[0][0])
+            if ladder else None)
+        data = trace_lib.chrome_trace(events, series_buckets=series_buckets)
         with open(args.export_trace, "w") as f:
             json.dump(_json_safe(data), f)
         n = sum(e.get("ph") in ("X", "B") for e in data["traceEvents"])
@@ -1048,13 +1127,83 @@ def main(argv: list[str] | None = None) -> int:
     return 0
 
 
+def _history_main(args) -> int:
+    """``--history [KEY]``: the series-store view. Reads ONLY the
+    downsampled store (never the event stream) — answering "is it
+    getting worse?" costs the ring size, not the run length."""
+    hist = series_lib.history_report(
+        args.workdir, key=(None if args.history == "*" else args.history),
+        since_s=args.since, resolution_s=args.resolution)
+    if hist is None:
+        print(f"dlstatus: no series store under {args.workdir} — history "
+              f"is recorded by the health engine (run "
+              f"`dlstatus {args.workdir} --health` or a --watch daemon)",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(_json_safe(hist), default=str))
+    else:
+        print(render_history(hist))
+    return 0
+
+
+def _serve_metrics_main(args) -> int:
+    """``--serve-metrics PORT``: stdlib-http OpenMetrics exposition.
+
+    Every GET re-reads health.json + the newest series buckets from disk,
+    so the endpoint pairs with whatever is producing them (a ``--health
+    --watch`` daemon, a supervised run's engine) without sharing a
+    process. Binds loopback; PORT 0 picks an ephemeral port — the chosen
+    one is printed to stderr. ``--watch-count N`` answers N requests and
+    exits (tests/CI); default serves until ctrl-C."""
+    import http.server
+
+    workdir = args.workdir
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib handler contract)
+            if self.path.partition("?")[0] not in ("/", "/metrics"):
+                self.send_error(404)
+                return
+            body = series_lib.openmetrics_exposition(workdir).encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             series_lib.OPENMETRICS_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *fmt_args):
+            pass  # scrape logs belong to the scraper, not stderr
+
+    srv = http.server.HTTPServer(("127.0.0.1", args.serve_metrics), Handler)
+    host, port = srv.server_address[0], srv.server_address[1]
+    print(f"dlstatus: serving OpenMetrics on http://{host}:{port}/metrics "
+          f"for {workdir}", file=sys.stderr, flush=True)
+    try:
+        if args.watch_count:
+            for _ in range(args.watch_count):
+                srv.handle_request()
+        else:
+            srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+    return 0
+
+
 def _cluster_main(args) -> int:
     """``--cluster ROOT``: the multi-workdir fold, composing with
-    ``--json`` (one report per line) and ``--watch``."""
+    ``--json`` (one report per line) and ``--watch`` (which holds one
+    :class:`~.telemetry.EventCursor` per workdir, so each tick parses
+    only the fleet's appends, not every stream from byte 0)."""
+    cursors: dict | None = {} if args.watch else None
 
     def build() -> dict:
         return health_lib.cluster_report(
-            args.cluster, slo_target_s=args.slo, slo_budget=args.slo_budget)
+            args.cluster, slo_target_s=args.slo, slo_budget=args.slo_budget,
+            cursors=cursors)
 
     def emit_one(c: dict) -> None:
         if args.json:

@@ -600,11 +600,28 @@ class EventCursor:
         self._offsets: dict[str, int] = {}
         self.events: list[dict] = []
         self.skipped_lines = 0
+        #: total bytes consumed across every poll — the receipt that watch
+        #: cost is bounded by the append rate (ci.sh history asserts it).
+        self.bytes_read = 0
 
     @property
     def files(self) -> list[str]:
         """Every segment file seen so far (polled at least once)."""
         return sorted(self._offsets)
+
+    def lag_bytes(self) -> int:
+        """Bytes on disk the cursor has not consumed yet: appended-but-
+        unpolled data plus still-torn tails (files the glob hasn't seen
+        count in full). The health engine records this as its own
+        falling-behind gauge."""
+        lag = 0
+        for path in event_files(self.workdir):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            lag += max(0, size - self._offsets.get(path, 0))
+        return lag
 
     def poll(self) -> list[dict]:
         """Read appended lines from every segment; return the new events
@@ -627,6 +644,7 @@ class EventCursor:
             if end < 0:
                 continue  # only a torn fragment so far — retry next poll
             self._offsets[path] = off + end + 1
+            self.bytes_read += end + 1
             for raw in data[:end + 1].splitlines():
                 rec = _parse_event_line(raw.decode("utf-8", errors="replace"))
                 if rec is not None:

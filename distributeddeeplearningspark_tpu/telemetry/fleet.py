@@ -380,6 +380,27 @@ def _percentile(sorted_vals: list[float], q: float) -> float | None:
     return sorted_vals[i]
 
 
+def replica_p99(events: Iterable[dict]) -> dict[str, dict[str, Any]]:
+    """Per-replica p99 over ok requests: ``{process: {p99_s, requests}}``.
+
+    The ONE per-replica latency fold: the health engine's worst-replica
+    naming, its ``request_p99_s{replica=}`` series samples, and the SLO
+    rule's evidence all read this, so a windowed caller passes the same
+    window-filtered events everywhere."""
+    by_proc: dict[str, list[float]] = {}
+    for e in events:
+        if (e.get("kind") == "request" and e.get("outcome") == "ok"
+                and e.get("latency_s") is not None):
+            by_proc.setdefault(str(e.get("process")), []).append(
+                float(e["latency_s"]))
+    out: dict[str, dict[str, Any]] = {}
+    for proc, lats in sorted(by_proc.items()):
+        p99 = _percentile(sorted(lats), 0.99)
+        if p99 is not None:
+            out[proc] = {"p99_s": p99, "requests": len(lats)}
+    return out
+
+
 #: gauge keys a replica row copies from its newest ``serve`` gauge, when
 #: present. Part of the :func:`serving_fleet` row CONTRACT (below) — the
 #: health engine and the future autoscaler read ``queue_depth`` and

@@ -58,6 +58,7 @@ from typing import Any, Callable, Iterable
 from distributeddeeplearningspark_tpu import telemetry
 from distributeddeeplearningspark_tpu.telemetry import anatomy as anatomy_lib
 from distributeddeeplearningspark_tpu.telemetry import fleet as fleet_lib
+from distributeddeeplearningspark_tpu.telemetry import series as series_lib
 
 #: schema version stamped into every health.json — consumers MUST check it;
 #: any key removal/rename bumps it (additions don't).
@@ -81,6 +82,8 @@ QUEUE_CRIT_ENV = "DLS_HEALTH_QUEUE_CRIT"        # default 32
 SHED_WARN_ENV = "DLS_HEALTH_SHED_WARN"          # default 0.05
 SHED_CRIT_ENV = "DLS_HEALTH_SHED_CRIT"          # default 0.25
 GOODPUT_WARN_ENV = "DLS_HEALTH_GOODPUT_WARN"    # default 0.5 fraction
+TREND_N_ENV = "DLS_HEALTH_TREND_N"              # default 3 consecutive moves
+STEPS_DROP_ENV = "DLS_HEALTH_STEPS_DROP"        # default 0.15 below peak
 
 
 def _env_float(name: str, default: float) -> float:
@@ -391,8 +394,172 @@ def _rule_goodput(ctx: dict) -> list[dict]:
         overhead=overhead)]
 
 
+# -- predictive trend rules ---------------------------------------------------
+#
+# Level rules above fire when a threshold is ALREADY crossed; these fire
+# when the recorded history says it is ABOUT to be — a WARN with the
+# projection as evidence, strictly before the damped level CRIT. They read
+# ``ctx["trend"]``: per-series (ts, value) tails the engine seeds from its
+# :class:`~.series.SeriesStore` (history + this evaluation's sample). A
+# stateless caller (one-shot --health, the cluster fold) has no history,
+# so the trend rules simply return [] — prediction needs memory. Each rule
+# is hysteretic twice over: the movement must repeat ``DLS_HEALTH_TREND_N``
+# evaluations straight AND the projection must land inside the trailing
+# window; and each goes quiet once the level it predicts has arrived (the
+# level rule owns the incident from there).
+
+
+def _trend_tail(ctx: dict, key: str) -> list[tuple[float, float]]:
+    return list(ctx.get("trend", {}).get(key) or ())
+
+
+def _moves(points: list[tuple[float, float]], n: int, sign: int) -> bool:
+    """Did the series move strictly in ``sign`` direction for the last
+    ``n`` consecutive deltas (needs n+1 points)?"""
+    if n < 1 or len(points) < n + 1:
+        return False
+    vals = [v for _, v in points[-(n + 1):]]
+    return all(sign * (b - a) > 0 for a, b in zip(vals, vals[1:]))
+
+
+def _trend_n() -> int:
+    return max(1, int(_env_float(TREND_N_ENV, 3.0)))
+
+
+def _rule_trend_queue(ctx: dict) -> list[dict]:
+    """Queue depth growing N evaluations straight and projected to cross
+    the CRIT threshold within the window -> predictive WARN."""
+    n = _trend_n()
+    crit = _env_float(QUEUE_CRIT_ENV, 32.0)
+    out = []
+    for proc in sorted(ctx["queue_depth"]):
+        key = series_lib.series_key(series_lib.QUEUE_SERIES, replica=proc)
+        pts = _trend_tail(ctx, key)
+        if not _moves(pts, n, +1):
+            continue
+        cur = pts[-1][1]
+        if cur >= crit:
+            continue  # already there: the level rule owns it
+        fit = series_lib.linear_trend(pts[-(n + 1):])
+        if not fit or fit["slope_per_s"] <= 0:
+            continue
+        eta = (crit - cur) / fit["slope_per_s"]
+        if eta > ctx["window_s"]:
+            continue
+        out.append(_verdict(
+            "trend_queue", f"trend:queue:{proc}", "WARN",
+            f"replica {proc} queue depth rising {n} evaluations straight "
+            f"({cur:.0f} now, projected ≥{crit:.0f} in ~{eta:.0f}s)",
+            process=proc, queue_depth=cur,
+            slope_per_s=round(fit["slope_per_s"], 6),
+            projected_crit_in_s=round(eta, 1), crit=crit, consecutive=n))
+    return out
+
+
+def _rule_trend_slo(ctx: dict) -> list[dict]:
+    """Burn-rate slope projecting EXHAUSTED within the window -> WARN
+    before the level rule's damped CRIT."""
+    slo = ctx["slo"]
+    if not slo:
+        return []
+    n = _trend_n()
+    exhaust = fleet_lib.SLO_EXHAUST_BURN
+    out = []
+    for tenant, row in slo["tenants"].items():
+        if row["verdict"] == "EXHAUSTED":
+            continue  # already there: the level rule owns it
+        key = series_lib.series_key(series_lib.BURN_SERIES, tenant=tenant)
+        pts = _trend_tail(ctx, key)
+        if not _moves(pts, n, +1):
+            continue
+        cur = pts[-1][1]
+        fit = series_lib.linear_trend(pts[-(n + 1):])
+        if not fit or fit["slope_per_s"] <= 0:
+            continue
+        eta = max(0.0, (exhaust - cur) / fit["slope_per_s"])
+        if eta > ctx["window_s"]:
+            continue
+        out.append(_verdict(
+            "trend_slo", f"trend:slo:{tenant}", "WARN",
+            f"tenant {tenant} burn rate rising {n} evaluations straight "
+            f"({cur:.1f}x now, projecting EXHAUSTED ≥{exhaust:.0f}x "
+            f"in ~{eta:.0f}s)",
+            tenant=tenant, burn_rate=cur,
+            slope_per_s=round(fit["slope_per_s"], 6),
+            projected_exhausted_in_s=round(eta, 1),
+            exhaust_burn=exhaust, consecutive=n))
+    return out
+
+
+def _rule_trend_hbm(ctx: dict) -> list[dict]:
+    """HBM headroom trending to zero within the window -> WARN while the
+    level rule still reads it as survivable (≥5%)."""
+    n = _trend_n()
+    pts = _trend_tail(ctx, series_lib.HBM_SERIES)
+    if not _moves(pts, n, -1):
+        return []
+    cur = pts[-1][1]
+    if cur < 0.05:
+        return []  # already there: the level rule owns it
+    fit = series_lib.linear_trend(pts[-(n + 1):])
+    if not fit or fit["slope_per_s"] >= 0:
+        return []
+    eta = cur / -fit["slope_per_s"]
+    if eta > ctx["window_s"]:
+        return []
+    return [_verdict(
+        "trend_hbm", "trend:hbm", "WARN",
+        f"HBM headroom falling {n} evaluations straight "
+        f"({100.0 * cur:.1f}% now, projected exhausted in ~{eta:.0f}s)",
+        headroom_frac=round(cur, 4),
+        slope_per_s=round(fit["slope_per_s"], 8),
+        projected_zero_in_s=round(eta, 1), consecutive=n)]
+
+
+def _rule_trend_steps(ctx: dict) -> list[dict]:
+    """In-run steps/sec decline: N straight drops AND the current rate a
+    configurable fraction below the tail's peak (same judgment
+    perf_guard --series makes post-hoc, raised live here)."""
+    n = _trend_n()
+    drop = _env_float(STEPS_DROP_ENV, 0.15)
+    pts = _trend_tail(ctx, series_lib.STEPS_SERIES)
+    if not _moves(pts, n, -1):
+        return []
+    vals = [v for _, v in pts]
+    peak, cur = max(vals), vals[-1]
+    if peak <= 0 or cur > (1.0 - drop) * peak:
+        return []
+    return [_verdict(
+        "trend_steps", "trend:steps", "WARN",
+        f"steps/sec declining {n} evaluations straight "
+        f"({cur:.2f} now, {100.0 * (1.0 - cur / peak):.0f}% below "
+        f"peak {peak:.2f})",
+        steps_per_sec=round(cur, 4), peak_steps_per_sec=round(peak, 4),
+        drop_frac=round(1.0 - cur / peak, 4), floor_frac=drop,
+        consecutive=n)]
+
+
+def _rule_trend_engine(ctx: dict) -> list[dict]:
+    """The engine watching itself: unread backlog (cursor lag) growing N
+    evaluations straight means evaluations are falling behind the
+    writers' append rate — today a slow engine is invisible."""
+    n = _trend_n()
+    pts = _trend_tail(ctx, series_lib.ENGINE_LAG_SERIES)
+    if not _moves(pts, n, +1):
+        return []
+    cur = pts[-1][1]
+    if cur <= 0:
+        return []
+    return [_verdict(
+        "trend_engine", "trend:engine", "WARN",
+        f"health engine falling behind the append rate: unread backlog "
+        f"grew {n} evaluations straight to {cur:.0f} bytes",
+        lag_bytes=cur, consecutive=n)]
+
+
 #: the registry, evaluation order = display order. Names are part of the
-#: health.json contract (the ``rules`` map is keyed by them).
+#: health.json contract (the ``rules`` map is keyed by them; additions
+#: don't bump the schema).
 RULES: tuple[tuple[str, Callable[[dict], list[dict]]], ...] = (
     ("stream", _rule_stream),
     ("heartbeat", _rule_heartbeat),
@@ -407,6 +574,11 @@ RULES: tuple[tuple[str, Callable[[dict], list[dict]]], ...] = (
     ("restarts", _rule_restarts),
     ("shuffle", _rule_shuffle),
     ("goodput", _rule_goodput),
+    ("trend_queue", _rule_trend_queue),
+    ("trend_slo", _rule_trend_slo),
+    ("trend_hbm", _rule_trend_hbm),
+    ("trend_steps", _rule_trend_steps),
+    ("trend_engine", _rule_trend_engine),
 )
 
 
@@ -416,26 +588,25 @@ def _build_ctx(events: list[dict], *, now: float | None,
     """Compute every producer fold ONCE; rules read, never re-fold.
 
     ``now`` None anchors on the stream's end (the post-mortem-safe default
-    the whole reader side uses); the engine's own ``alert`` events are
-    excluded from the anchor and from rule inputs so the engine never
+    the whole reader side uses); an explicit ``now`` also BOUNDS the
+    stream to events at or before it, so an injected-clock engine
+    replaying history evaluates each tick exactly as a live engine would
+    have seen it (a live engine's poll can't return the future anyway —
+    the bound only bites on replays). The engine's own ``alert`` events
+    are excluded from the anchor and from rule inputs so the engine never
     reacts to itself."""
     events = [e for e in events if "ts" in e and e.get("kind") != "alert"]
+    if now is not None:
+        events = [e for e in events if float(e["ts"]) <= float(now)]
     anchor = (float(now) if now is not None
               else (float(events[-1]["ts"]) if events else 0.0))
     window_events = [e for e in events
                      if float(e["ts"]) >= anchor - window_s]
-    reqs_ok = [e for e in window_events if e.get("kind") == "request"
-               and e.get("outcome") == "ok"
-               and e.get("latency_s") is not None]
-    by_proc: dict[str, list[float]] = {}
-    for e in reqs_ok:
-        by_proc.setdefault(str(e.get("process")), []).append(
-            float(e["latency_s"]))
+    replica_p99 = fleet_lib.replica_p99(window_events)
     worst = None
-    for proc, lats in by_proc.items():
-        p99 = fleet_lib._percentile(sorted(lats), 0.99)
-        if p99 is not None and (worst is None or p99 > worst["p99_s"]):
-            worst = {"process": proc, "p99_s": p99, "requests": len(lats)}
+    for proc, row in replica_p99.items():
+        if worst is None or row["p99_s"] > worst["p99_s"]:
+            worst = {"process": proc, **row}
     serving = fleet_lib.serving_fleet(events)
     queue_depth: dict[str, Any] = {}
     if serving:
@@ -452,6 +623,7 @@ def _build_ctx(events: list[dict], *, now: float | None,
         "fleet": fleet_lib.fleet_report(events, now=now) if events else None,
         "serving": serving,
         "queue_depth": queue_depth,
+        "replica_p99": replica_p99,
         "worst_replica": worst,
         "slo": (fleet_lib.slo_report(window_events,
                                      target_p99_s=slo_target_s,
@@ -501,12 +673,69 @@ def _tenant_rows(ctx: dict) -> dict[str, dict]:
     return rows
 
 
+def _series_samples(ctx: dict) -> dict[str, float]:
+    """The per-evaluation sample batch the engine records into its
+    :class:`~.series.SeriesStore` — every value re-read from the folds
+    the rules already consumed, so history costs nothing extra. Keys are
+    the canonical series names (:mod:`.series`); a signal with no
+    evidence this evaluation is simply absent (no phantom zeros)."""
+    s: dict[str, float] = {}
+    if ctx["events"]:
+        s[series_lib.GOODPUT_SERIES] = ctx["goodput"]["goodput_frac"]
+    laps = [e for e in ctx["window_events"]
+            if e.get("kind") == "step_metrics" and e.get("lap_s")]
+    lap_s = sum(float(e["lap_s"]) for e in laps)
+    if lap_s > 0:
+        s[series_lib.STEPS_SERIES] = (
+            sum(int(e.get("steps", 0) or 0) for e in laps) / lap_s)
+    an = ctx["anatomy"]
+    if an:
+        mfu_doc = an.get("mfu") or {}
+        mfu = mfu_doc.get("mfu_last_lap")
+        if mfu is None:
+            mfu = mfu_doc.get("mfu")
+        if mfu is not None:
+            s[series_lib.MFU_SERIES] = float(mfu)
+        mem = an.get("memory")
+        if (mem and mem.get("source") == "memory_stats"
+                and mem.get("headroom_bytes") is not None
+                and mem.get("bytes_limit_min")):
+            s[series_lib.HBM_SERIES] = (
+                mem["headroom_bytes"] / float(mem["bytes_limit_min"]))
+    hbs = [e for e in ctx["events"] if e.get("kind") == "heartbeat"]
+    if hbs:
+        s[series_lib.HEARTBEAT_SERIES] = ctx["now"] - float(hbs[-1]["ts"])
+    reqs = [e for e in ctx["window_events"] if e.get("kind") == "request"]
+    if reqs:
+        s[series_lib.SHED_SERIES] = (
+            sum(e.get("outcome") == "shed" for e in reqs) / len(reqs))
+    if any(e.get("kind") == "shuffle" for e in ctx["events"]):
+        spills = sum(1 for e in ctx["window_events"]
+                     if e.get("kind") == "shuffle"
+                     and e.get("edge") == "spill")
+        s[series_lib.SPILL_SERIES] = spills / max(ctx["window_s"], 1e-9)
+    for proc, depth in ctx["queue_depth"].items():
+        if depth is not None:
+            s[series_lib.series_key(series_lib.QUEUE_SERIES,
+                                    replica=proc)] = float(depth)
+    for proc, row in ctx["replica_p99"].items():
+        s[series_lib.series_key(series_lib.P99_SERIES,
+                                replica=proc)] = row["p99_s"]
+    if ctx["slo"]:
+        for tenant, row in ctx["slo"]["tenants"].items():
+            s[series_lib.series_key(series_lib.BURN_SERIES,
+                                    tenant=tenant)] = row["burn_rate"]
+    return {k: float(v) for k, v in s.items()
+            if v is not None and math.isfinite(float(v))}
+
+
 def evaluate_health(events: list[dict], *, workdir: str | None = None,
                     now: float | None = None,
                     window_s: float | None = None,
                     slo_target_s: float | None = None,
                     slo_budget: float = 0.01,
-                    stream: dict | None = None) -> dict:
+                    stream: dict | None = None,
+                    trend_tails: dict[str, list] | None = None) -> dict:
     """One stateless evaluation: the raw (undamped) health report.
 
     Returns the health.json body MINUS the engine-state keys
@@ -515,7 +744,12 @@ def evaluate_health(events: list[dict], *, workdir: str | None = None,
     the cluster fold) use the raw verdicts directly. ``stream`` is the
     reader's file/skip accounting (``{files, events, skipped_lines}``)
     when the caller has it (the cursor tracks it; a bare events list
-    can't know how many files it came from)."""
+    can't know how many files it came from). ``trend_tails`` is the
+    engine's per-series history ({key: [(ts, value), ...]}); the current
+    evaluation's samples are appended before the predictive trend rules
+    read them, and the batch is returned under ``_series_samples`` for
+    the engine to record. None (the stateless default) disarms the trend
+    rules — prediction needs memory."""
     if window_s is None:
         window_s = _env_float(WINDOW_ENV, 300.0)
     if slo_target_s is None:
@@ -524,6 +758,13 @@ def evaluate_health(events: list[dict], *, workdir: str | None = None,
     ctx = _build_ctx(events, now=now, window_s=window_s,
                      slo_target_s=slo_target_s, slo_budget=slo_budget,
                      stream=stream)
+    samples = _series_samples(ctx)
+    trend: dict[str, list] = {}
+    if trend_tails is not None:
+        trend = {k: list(v) for k, v in trend_tails.items()}
+        for key, val in samples.items():
+            trend.setdefault(key, []).append((ctx["now"], val))
+    ctx["trend"] = trend
     rules: dict[str, dict] = {}
     verdicts: list[dict] = []
     for name, fn in RULES:
@@ -554,6 +795,7 @@ def evaluate_health(events: list[dict], *, workdir: str | None = None,
             round(ctx["now"] - float(hbs[-1]["ts"]), 1) if hbs else None),
         "stream": st,
         "_verdicts": verdicts,  # engine-internal; stripped before writing
+        "_series_samples": samples,  # engine-internal, recorded to series
     }
 
 
@@ -612,6 +854,10 @@ class HealthEngine:
         self._write_alerts = write_alerts
         self._health_path = health_path
         self._cursor = telemetry.EventCursor(workdir)
+        #: the history plane: one sample batch per evaluation, downsampled
+        #: into multi-resolution buckets. Tails double as the memory the
+        #: predictive trend rules fit their slope on.
+        self.series = series_lib.SeriesStore(workdir)
         self._writer: telemetry.EventWriter | None = None
         # key -> confirmed non-OK state {rule, severity, summary, evidence,
         #                                since_ts, held}
@@ -663,6 +909,7 @@ class HealthEngine:
         """One tick: poll appended events, run the rules, damp, emit edges,
         rewrite health.json. Returns the written report (plus the raw
         verdict list under ``_verdicts``)."""
+        t_tick0 = time.perf_counter()
         self._cursor.poll()
         now = self._clock() if self._clock is not None else None
         # the engine's own alert stream must not count as "the workdir has
@@ -675,7 +922,8 @@ class HealthEngine:
         report = evaluate_health(
             self._cursor.events, workdir=self.workdir, now=now,
             window_s=self.window_s, slo_target_s=self.slo_target_s,
-            slo_budget=self.slo_budget, stream=stream)
+            slo_budget=self.slo_budget, stream=stream,
+            trend_tails=self.series.tails)
         self.evaluations += 1
         anchor = report["generated_ts"]
         raw = {v["key"]: v for v in report["_verdicts"]}
@@ -701,6 +949,22 @@ class HealthEngine:
             s["severity"] for s in self._state.values())
         report["alerts_active"] = [
             {"key": key, **st} for key, st in sorted(self._state.items())]
+        # self-telemetry (tick wall time is always real — even an
+        # injected-clock drill wants the engine's actual cost), then the
+        # whole batch lands in the series store; record() no-ops when the
+        # anchor didn't advance, so a stalled stream records nothing twice
+        tick_s = time.perf_counter() - t_tick0
+        lag = self._cursor.lag_bytes()
+        report["engine"] = {
+            "tick_s": round(tick_s, 6), "lag_bytes": lag,
+            "rules_evaluated": len(RULES),
+            "bytes_read": self._cursor.bytes_read,
+        }
+        samples = dict(report.get("_series_samples") or {})
+        samples[series_lib.ENGINE_TICK_SERIES] = tick_s
+        samples[series_lib.ENGINE_LAG_SERIES] = float(lag)
+        samples[series_lib.ENGINE_RULES_SERIES] = float(len(RULES))
+        self.series.record(anchor, samples)
         write_health_json(report, self.workdir, self._health_path)
         return report
 
@@ -808,25 +1072,57 @@ def _workdir_kind(events: list[dict]) -> str:
     return "events" if events else "empty"
 
 
+def workdir_trend(wd: str | os.PathLike,
+                  key: str = series_lib.GOODPUT_SERIES) -> dict | None:
+    """The cluster view's per-workdir trend cell: the finest-resolution
+    series fitted over its whole ring. None when the workdir has no
+    series store (no engine ever ran there) or no such series."""
+    ladder = series_lib.list_resolutions(wd)
+    if not ladder:
+        return None
+    bs = series_lib.read_buckets(wd, ladder[0][0], keys=[key]).get(key)
+    if not bs:
+        return None
+    fit = series_lib.linear_trend([(b["t"], b["mean"]) for b in bs])
+    return {"key": key, "trend": series_lib.trend_verdict(fit),
+            "slope_per_s": (round(fit["slope_per_s"], 8) if fit else None),
+            "last": bs[-1]["last"]}
+
+
 def cluster_report(root: str | os.PathLike, *,
                    slo_target_s: float | None = None,
                    slo_budget: float = 0.01,
-                   window_s: float | None = None) -> dict:
+                   window_s: float | None = None,
+                   cursors: dict[str, Any] | None = None) -> dict:
     """The multi-workdir fold ``dlstatus --cluster`` renders: one health
     evaluation per discovered workdir (raw verdicts — the cluster view is
     a poll, damping lives in each workdir's own engine) plus the
-    per-tenant rollup across workdirs the scheduler item specifies."""
+    per-tenant rollup across workdirs the scheduler item specifies.
+
+    ``cursors`` (a caller-held ``{workdir: EventCursor}`` dict, mutated in
+    place) switches the fold to incremental reads: each tick parses only
+    what the fleet appended since the last one, so ``--cluster --watch``
+    cost is bounded by the append rate, not the stream length."""
     rows: list[dict] = []
     tenants: dict[str, dict] = {}
     for wd in discover_workdirs(root):
-        events = telemetry.read_events(wd)
+        if cursors is None:
+            events = telemetry.read_events(wd)
+            skipped = 0
+        else:
+            cur = cursors.get(wd)
+            if cur is None:
+                cur = cursors[wd] = telemetry.EventCursor(wd)
+            cur.poll()
+            events = cur.events
+            skipped = cur.skipped_lines
         files = len(telemetry.event_files(wd))
         rep = evaluate_health(
             events, workdir=wd, window_s=window_s,
             slo_target_s=slo_target_s, slo_budget=slo_budget,
             stream={"files": files,
                     "events": sum(e.get("kind") != "alert" for e in events),
-                    "skipped_lines": 0})
+                    "skipped_lines": skipped})
         serving = fleet_lib.serving_fleet(events)
         occupancy = (serving["totals"].get("kv_page_occupancy_max")
                      if serving else None)
@@ -849,6 +1145,7 @@ def cluster_report(root: str | os.PathLike, *,
             "worst_alert": worst_alert,
             "last_step": rep["last_step"],
             "last_heartbeat_age_s": rep["last_heartbeat_age_s"],
+            "trend": workdir_trend(wd),
         })
         for t, trow in rep["tenants"].items():
             agg = tenants.setdefault(t, {

@@ -332,14 +332,21 @@ def request_anatomy(events: Iterable[dict]) -> list[dict]:
 # -- Chrome trace_event export ------------------------------------------------
 
 
-def chrome_trace(events: Iterable[dict]) -> dict[str, Any]:
+def chrome_trace(events: Iterable[dict], *,
+                 series_buckets: dict[str, list[dict]] | None = None
+                 ) -> dict[str, Any]:
     """Both halves of a run — serve request spans and train phase spans —
     as Chrome/Perfetto ``trace_event`` JSON (the "JSON array format":
     ``{"traceEvents": [...]}``, complete ``"X"`` events with microsecond
     ``ts``/``dur``, open spans as lone ``"B"``s, plus ``"M"`` metadata
     naming processes and rows). ``pid`` is the writing process, ``tid``
     one row per trace within it, so a request's stages stack on their own
-    line and any run opens in a real trace viewer."""
+    line and any run opens in a real trace viewer.
+
+    ``series_buckets`` (a :func:`~.series.read_buckets` result) adds one
+    ``"C"`` counter track per series under a synthetic "series" process —
+    the goodput/queue-depth/headroom trendlines the history store
+    recorded, lined up against the spans and alert markers."""
     events = [e for e in events if "ts" in e]
     serve = spans_of(events)
     train = spans_from_phases(events)
@@ -352,11 +359,14 @@ def chrome_trace(events: Iterable[dict]) -> dict[str, Any]:
     # "alerts" row — the raise/clear markers lined up against the spans
     # that explain them
     alerts = [e for e in events if e.get("kind") == "alert"]
-    if not all_spans and not mems and not alerts:
+    series_buckets = {k: bs for k, bs in (series_buckets or {}).items()
+                      if bs}
+    if not all_spans and not mems and not alerts and not series_buckets:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     epoch = min([float(s["t0"]) for _, s in all_spans]
                 + [float(e["ts"]) for e in mems]
-                + [float(e["ts"]) for e in alerts])
+                + [float(e["ts"]) for e in alerts]
+                + [float(bs[0]["t"]) for bs in series_buckets.values()])
 
     pids: dict[str, int] = {}
     tids: dict[tuple[int, str], int] = {}
@@ -418,4 +428,12 @@ def chrome_trace(events: Iterable[dict]) -> dict[str, Any]:
             "args": {k: e[k] for k in ("rule", "key", "severity", "edge",
                                        "summary", "cleared_from", "held")
                      if e.get(k) is not None}})
+    for key in sorted(series_buckets):
+        pid = pid_of("series")
+        for b in series_buckets[key]:
+            trace_events.append({
+                "name": key, "cat": "series", "ph": "C",
+                "pid": pid, "tid": 0,
+                "ts": (float(b["t"]) - epoch) * 1e6,
+                "args": {"mean": b["mean"]}})
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}

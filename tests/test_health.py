@@ -220,6 +220,7 @@ HEALTH_KEYS = {
     "schema", "generated_ts", "workdir", "worst_severity", "rules",
     "goodput", "slo", "queue_depth", "tenants", "last_step",
     "last_heartbeat_age_s", "stream", "evaluations", "alerts_active",
+    "engine",
 }
 
 

@@ -1,0 +1,548 @@
+"""Metrics time-series plane: store durability, downsample arithmetic,
+trend rules, --history, OpenMetrics exposition, perf_guard --series.
+
+Everything on fake clocks — no sleeps. The live end-to-end drill (a real
+train run + a faulted serving fleet populating multi-resolution series,
+the predictive WARN beating the level CRIT, a real scrape tying out
+against health.json) is ``tools/ci.sh history``; this file pins the
+contracts it relies on.
+"""
+
+import json
+import math
+import os
+import re
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from distributeddeeplearningspark_tpu import status, telemetry
+from distributeddeeplearningspark_tpu.telemetry import health
+from distributeddeeplearningspark_tpu.telemetry import series
+from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
+from tools import perf_guard
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def _alert_events(workdir):
+    return [e for e in telemetry.read_events(workdir)
+            if e.get("kind") == "alert"]
+
+
+# -- keys ---------------------------------------------------------------------
+
+
+def test_series_key_roundtrip():
+    assert series.series_key("goodput_frac") == "goodput_frac"
+    k = series.series_key("queue_depth", replica="p0")
+    assert k == "queue_depth{replica=p0}"
+    assert series.parse_key(k) == ("queue_depth", {"replica": "p0"})
+    # labels encode sorted -> one identity per (name, labels)
+    a = series.series_key("x", b="2", a="1")
+    assert a == "x{a=1,b=2}" and series.parse_key(a)[1] == {"a": "1",
+                                                            "b": "2"}
+
+
+# -- downsample arithmetic ----------------------------------------------------
+
+
+def test_bucket_downsample_arithmetic_hand_computed(tmp_path):
+    store = series.SeriesStore(tmp_path, resolutions=((10.0, 8), (40.0, 4)))
+    # fake-clock sequence: ts 0,5 land in bucket 0; 12,15,18 in bucket 10;
+    # 41 in bucket 40
+    for ts, v in ((0.0, 4.0), (5.0, 2.0), (12.0, 10.0), (15.0, 7.0),
+                  (18.0, 1.0), (41.0, 5.0)):
+        assert store.record(ts, {"m": v}) is True
+    fine = series.read_buckets(tmp_path, 10.0)["m"]
+    assert [b["t"] for b in fine] == [0.0, 10.0, 40.0]
+    b0, b1, b2 = fine
+    assert (b0["count"], b0["min"], b0["max"], b0["mean"], b0["last"]) == (
+        2, 2.0, 4.0, 3.0, 2.0)
+    assert (b1["count"], b1["min"], b1["max"], b1["last"]) == (3, 1.0,
+                                                               10.0, 1.0)
+    assert b1["mean"] == pytest.approx(6.0)  # (10+7+1)/3
+    assert (b2["count"], b2["last"]) == (1, 5.0)
+    coarse = series.read_buckets(tmp_path, 40.0)["m"]
+    assert [b["t"] for b in coarse] == [0.0, 40.0]
+    assert coarse[0]["count"] == 5 and coarse[0]["mean"] == pytest.approx(
+        24.0 / 5)
+    assert coarse[0]["min"] == 1.0 and coarse[0]["max"] == 10.0
+
+
+def test_record_replay_is_idempotent_and_nonfinite_dropped(tmp_path):
+    store = series.SeriesStore(tmp_path, resolutions=((10.0, 8),))
+    assert store.record(5.0, {"m": 1.0}) is True
+    assert store.record(5.0, {"m": 99.0}) is False   # same ts: replay
+    assert store.record(4.0, {"m": 99.0}) is False   # past ts: replay
+    assert store.record(6.0, {"m": float("nan"),
+                              "x": float("inf")}) is False
+    assert series.read_buckets(tmp_path, 10.0)["m"][0]["last"] == 1.0
+
+
+def test_reopened_store_continues_and_seeds_tails(tmp_path):
+    a = series.SeriesStore(tmp_path, resolutions=((10.0, 8),))
+    for i in range(4):
+        a.record(float(i), {"m": float(i)})
+    b = series.SeriesStore(tmp_path)
+    assert b.resolutions == ((10.0, 8),)   # ladder read back from header
+    assert b.last_ts == 3.0
+    assert b.tails["m"]                     # history survives the restart
+    b.record(25.0, {"m": 9.0})
+    got = series.read_buckets(tmp_path, 10.0)["m"]
+    assert [bk["t"] for bk in got] == [0.0, 20.0]
+
+
+# -- crash tolerance ----------------------------------------------------------
+
+
+def test_torn_segment_line_skipped_and_writes_continue(tmp_path):
+    store = series.SeriesStore(tmp_path, resolutions=((1.0, 16),))
+    for i in range(4):
+        store.record(float(i), {"m": float(i)})   # finalizes buckets 0..2
+    path = os.path.join(series.series_dir(tmp_path),
+                        series.bucket_filename(1.0))
+    with open(path, "a") as f:
+        f.write('{"t": 99.0, "k": "m", "n": 1, "mi')  # torn mid-append
+    got = series.read_buckets(tmp_path, 1.0)["m"]
+    assert [b["t"] for b in got] == [0.0, 1.0, 2.0, 3.0]
+    # a new writer instance keeps going on the same segment
+    b = series.SeriesStore(tmp_path)
+    b.record(5.0, {"m": 5.0})
+    assert [x["t"] for x in series.read_buckets(tmp_path, 1.0)["m"]][-1] == 5.0
+
+
+def test_truncated_segment_recovers(tmp_path):
+    store = series.SeriesStore(tmp_path, resolutions=((1.0, 16),))
+    for i in range(6):
+        store.record(float(i), {"m": float(i)})
+    path = os.path.join(series.series_dir(tmp_path),
+                        series.bucket_filename(1.0))
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)   # half a segment, ends mid-line
+    got = series.read_buckets(tmp_path, 1.0).get("m", [])
+    assert all(math.isfinite(b["mean"]) for b in got)
+    # open bucket (ts=5) still served from the atomic header
+    assert any(b["t"] == 5.0 for b in got)
+
+
+def test_duplicate_bucket_lines_dedupe_last_wins(tmp_path):
+    """A crash between the bucket append and the header rewrite replays
+    the same (key, t) on restart — readers keep the newest line."""
+    store = series.SeriesStore(tmp_path, resolutions=((1.0, 16),))
+    store.record(0.0, {"m": 1.0})
+    store.record(1.5, {"m": 2.0})   # finalizes bucket t=0
+    path = os.path.join(series.series_dir(tmp_path),
+                        series.bucket_filename(1.0))
+    with open(path, "a") as f:      # the replayed duplicate, updated
+        f.write(json.dumps({"t": 0.0, "k": "m", "n": 2, "min": 1.0,
+                            "max": 3.0, "sum": 4.0, "last": 3.0}) + "\n")
+    b0 = series.read_buckets(tmp_path, 1.0)["m"][0]
+    assert (b0["count"], b0["last"], b0["max"]) == (2, 3.0, 3.0)
+
+
+def test_compaction_bounds_ring_mid_append(tmp_path):
+    """Rotation mid-append: the ring bound is enforced by temp+rename
+    compaction, stale temps are ignored, newest buckets survive."""
+    cap = 4
+    store = series.SeriesStore(tmp_path, resolutions=((1.0, cap),))
+    sdir = series.series_dir(tmp_path)
+    os.makedirs(sdir, exist_ok=True)
+    stale = os.path.join(sdir, series.bucket_filename(1.0) + ".tmp.999")
+    with open(stale, "w") as f:
+        f.write("leftover from a crashed compaction\n")
+    for i in range(40):
+        store.record(float(i), {"m": float(i)})
+    path = os.path.join(sdir, series.bucket_filename(1.0))
+    with open(path) as f:
+        lines = sum(1 for _ in f)
+    assert lines <= 2 * cap + 1   # bounded, not 39 finalized lines
+    got = series.read_buckets(tmp_path, 1.0)["m"]
+    assert got[-1]["t"] == 39.0   # newest survive
+    assert len(got) >= cap
+    assert os.path.exists(stale)  # ignored, never parsed
+
+
+# -- trend fitting / sparklines ----------------------------------------------
+
+
+def test_linear_trend_exact_and_degenerate():
+    fit = series.linear_trend([(0.0, 1.0), (10.0, 2.0), (20.0, 3.0)])
+    assert fit["slope_per_s"] == pytest.approx(0.1)
+    assert fit["level"] == pytest.approx(2.0)
+    assert series.linear_trend([(0.0, 1.0)]) is None
+    assert series.linear_trend([(5.0, 1.0), (5.0, 2.0)]) is None
+    assert series.trend_verdict(fit) == "rising"
+    flat = series.linear_trend([(0.0, 2.0), (10.0, 2.0)])
+    assert series.trend_verdict(flat) == "flat"
+    assert series.trend_verdict(None) == "flat"
+    down = series.linear_trend([(0.0, 3.0), (10.0, 1.0)])
+    assert series.trend_verdict(down) == "falling"
+
+
+def test_sparkline_finite_and_gaps():
+    s = series.sparkline([0.0, 1.0, 2.0, 3.0])
+    assert s[0] == "▁" and s[-1] == "█" and len(s) == 4
+    assert series.sparkline([5.0, 5.0, 5.0]) == "▄▄▄"
+    gap = series.sparkline([1.0, float("nan"), 2.0, None])
+    assert gap[1] == "·" and gap[3] == "·"
+    assert series.sparkline([]) == ""
+
+
+# -- history report (pinned schema) -------------------------------------------
+
+
+def _engine_workdir(tmp_path, evals=8):
+    clock = FakeClock(0.0)
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=clock)
+    eng = health.HealthEngine(tmp_path, damping=2, clock=clock,
+                              window_s=100.0)
+    for i in range(evals):
+        w.emit("serve", queue_depth=float(i))
+        w.emit("request", outcome="ok", latency_s=0.01)
+        clock.tick(5.0)
+        eng.evaluate()
+    eng.close()
+    w.close()
+    return clock
+
+
+def test_history_report_pinned_keys(tmp_path):
+    _engine_workdir(tmp_path)
+    hist = series.history_report(tmp_path, since_s=3600.0)
+    assert tuple(hist) == series.HISTORY_KEYS
+    assert hist["schema"] == series.HISTORY_SCHEMA
+    assert hist["series"]
+    for row in hist["series"]:
+        assert tuple(row) == series.HISTORY_ROW_KEYS
+        assert "nan" not in row["spark"].lower()
+    keys = [r["key"] for r in hist["series"]]
+    assert "queue_depth{replica=p0}" in keys
+    assert series.ENGINE_TICK_SERIES in keys  # engine self-telemetry
+    # KEY filter: exact key or bare series name
+    one = series.history_report(tmp_path, key="queue_depth",
+                                since_s=3600.0)
+    assert [r["key"] for r in one["series"]] == ["queue_depth{replica=p0}"]
+
+
+def test_dlstatus_history_json_and_filters(tmp_path, capsys):
+    _engine_workdir(tmp_path)
+    assert status.main([str(tmp_path), "--history", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert tuple(doc) == series.HISTORY_KEYS
+    assert all(tuple(r) == series.HISTORY_ROW_KEYS for r in doc["series"])
+    rc = status.main([str(tmp_path), "--history", "queue_depth",
+                      "--since", "10m"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "queue_depth{replica=p0}" in out
+    assert any(g in out for g in "▁▂▃▄▅▆▇█")
+    # an explicit resolution overrides the --since auto-pick
+    assert status.main([str(tmp_path), "--history", "--json",
+                        "--resolution", "120"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["resolution_s"] == 120.0
+
+
+def test_dlstatus_history_without_store_is_rc1(tmp_path, capsys):
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=FakeClock())
+    w.heartbeat(step=1)
+    w.close()
+    assert status.main([str(tmp_path), "--history"]) == 1
+    assert "no series store" in capsys.readouterr().err
+
+
+# -- predictive trend rules ---------------------------------------------------
+
+
+def test_predictive_warn_fires_before_level_crit(tmp_path):
+    """The tentpole ordering contract: the trend rule's projection WARN
+    raises strictly before the damped level CRIT on a growing queue."""
+    clock = FakeClock(0.0)
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=clock)
+    eng = health.HealthEngine(tmp_path, damping=2, clock=clock,
+                              window_s=100.0)
+    for i in range(12):
+        w.emit("serve", queue_depth=2 + 4 * i)
+        clock.tick(5.0)
+        eng.evaluate()
+    eng.close()
+    w.close()
+    edges = {(e["edge"], e["key"]): float(e["ts"])
+             for e in _alert_events(tmp_path)}
+    assert ("raise", "trend:queue:p0") in edges
+    assert ("raise", "queue:p0") in edges
+    crit = [e for e in _alert_events(tmp_path)
+            if e["key"] == "queue:p0" and e["severity"] == "CRIT"]
+    assert crit and edges[("raise", "trend:queue:p0")] < float(
+        crit[0]["ts"])
+    trend_raise = [e for e in _alert_events(tmp_path)
+                   if e["key"] == "trend:queue:p0"
+                   and e["edge"] == "raise"][0]
+    assert trend_raise["severity"] == "WARN"
+    assert trend_raise["evidence"]["projected_crit_in_s"] > 0
+    # once the level CRIT owns the incident the trend alert clears
+    assert ("clear", "trend:queue:p0") in edges
+
+
+def test_trend_slo_projects_exhausted(tmp_path):
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=FakeClock(30.0))
+    for i in range(100):
+        w.emit("request", outcome="ok", tenant="t0",
+               latency_s=(1.0 if i < 3 else 0.01))
+    w.close()
+    events = telemetry.read_events(tmp_path)
+    key = series.series_key(series.BURN_SERIES, tenant="t0")
+    tails = {key: [(0.0, 1.1), (10.0, 1.5), (20.0, 2.0)]}
+    rep = health.evaluate_health(events, slo_target_s=0.5, now=30.0,
+                                 window_s=300.0, trend_tails=tails)
+    slo_rows = rep["slo"]["tenants"]["t0"]
+    assert slo_rows["verdict"] == "BURNING"   # not yet EXHAUSTED
+    trend = [v for v in rep["_verdicts"] if v["rule"] == "trend_slo"]
+    assert len(trend) == 1 and trend[0]["severity"] == "WARN"
+    ev = trend[0]["evidence"]
+    assert ev["projected_exhausted_in_s"] <= 300.0
+    assert "EXHAUSTED" in trend[0]["summary"]
+    # without memory the same stream raises no prediction
+    bare = health.evaluate_health(events, slo_target_s=0.5, now=30.0,
+                                  window_s=300.0)
+    assert [v for v in bare["_verdicts"] if v["rule"].startswith(
+        "trend")] == []
+
+
+def test_trend_engine_rule_warns_on_growing_lag(tmp_path):
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=FakeClock(30.0))
+    w.heartbeat(step=1)
+    w.close()
+    tails = {series.ENGINE_LAG_SERIES: [(0.0, 100.0), (10.0, 2000.0),
+                                        (20.0, 5000.0), (30.0, 9000.0)]}
+    rep = health.evaluate_health(telemetry.read_events(tmp_path),
+                                 now=31.0, trend_tails=tails)
+    v = [v for v in rep["_verdicts"] if v["rule"] == "trend_engine"]
+    assert len(v) == 1 and v[0]["key"] == "trend:engine"
+    assert v[0]["evidence"]["lag_bytes"] == 9000.0
+
+
+def test_engine_self_telemetry_gauge_and_series(tmp_path):
+    _engine_workdir(tmp_path)
+    with open(os.path.join(str(tmp_path), health.HEALTH_FILENAME)) as f:
+        doc = json.load(f)
+    assert set(doc["engine"]) == {"tick_s", "lag_bytes", "rules_evaluated",
+                                  "bytes_read"}
+    assert doc["engine"]["rules_evaluated"] == len(health.RULES)
+    got = series.read_buckets(tmp_path, 10.0)
+    for key in (series.ENGINE_TICK_SERIES, series.ENGINE_LAG_SERIES,
+                series.ENGINE_RULES_SERIES):
+        assert key in got
+
+
+# -- cursor byte accounting ---------------------------------------------------
+
+
+def test_cursor_bytes_read_and_lag(tmp_path):
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=FakeClock())
+    for i in range(10):
+        w.heartbeat(step=i)
+    cur = telemetry.EventCursor(tmp_path)
+    assert cur.lag_bytes() > 0          # appended, unread
+    cur.poll()
+    assert cur.lag_bytes() == 0
+    first = cur.bytes_read
+    assert first > 0
+    cur.poll()                          # nothing new: no re-read
+    assert cur.bytes_read == first
+    w.heartbeat(step=10)
+    assert cur.lag_bytes() > 0
+    cur.poll()
+    w.close()
+    total = sum(os.path.getsize(p) for p in telemetry.event_files(tmp_path))
+    assert cur.bytes_read == total      # read-once, bounded by appends
+
+
+# -- cluster: trend column + cursor watch -------------------------------------
+
+
+def _train_workdir(root, name):
+    wd = os.path.join(root, name)
+    clock = FakeClock(0.0)
+    w = telemetry.EventWriter(wd, process="p0", clock=clock)
+    eng = health.HealthEngine(wd, damping=1, clock=clock,
+                              write_alerts=False)
+    for step in range(1, 5):
+        w.step_metrics(step, steps=1, lap_s=1.0, metrics={})
+        clock.tick(1.0)
+        eng.evaluate()
+    eng.close()
+    w.heartbeat(step=4)
+    w.close()
+    return wd
+
+
+def test_cluster_trend_column_and_cursor_reads(tmp_path):
+    root = str(tmp_path)
+    wd = _train_workdir(root, "jobs/a")
+    cursors = {}
+    rep = health.cluster_report(root, cursors=cursors)
+    row = rep["workdirs"][0]
+    assert row["trend"] is not None
+    assert row["trend"]["key"] == series.GOODPUT_SERIES
+    assert row["trend"]["trend"] in ("rising", "falling", "flat")
+    first = sum(c.bytes_read for c in cursors.values())
+    total = sum(os.path.getsize(p) for p in telemetry.event_files(wd))
+    assert first <= total
+    # a second tick with nothing appended re-reads nothing
+    health.cluster_report(root, cursors=cursors)
+    assert sum(c.bytes_read for c in cursors.values()) == first
+    # the human render gains the trend column
+    out = status.render_cluster(rep)
+    assert "trend" in out.splitlines()[1]
+
+
+# -- OpenMetrics exposition ---------------------------------------------------
+
+_OM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9+.eEnaIf-]+$")
+
+
+def test_openmetrics_schema_and_bitwise_tie(tmp_path):
+    _engine_workdir(tmp_path)
+    body = series.openmetrics_exposition(tmp_path)
+    lines = body.splitlines()
+    assert lines[-1] == "# EOF"
+    seen_types = set()
+    values = {}
+    for ln in lines[:-1]:
+        if ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+            assert fam not in seen_types    # one TYPE line per family
+            seen_types.add(fam)
+            assert ln.endswith(" gauge")
+            continue
+        assert _OM_LINE.match(ln), ln
+        name_labels, _, raw = ln.rpartition(" ")
+        assert name_labels.split("{", 1)[0] in seen_types
+        values[name_labels] = float(raw)
+    with open(os.path.join(str(tmp_path), health.HEALTH_FILENAME)) as f:
+        doc = json.load(f)
+    wd = os.fspath(tmp_path)
+    # gauge values bitwise-tie to the health.json they mirror
+    assert values[f'dls_goodput_frac{{workdir="{wd}"}}'] == (
+        doc["goodput"]["goodput_frac"])
+    assert values[
+        f'dls_queue_depth{{replica="p0",workdir="{wd}"}}'] == (
+        doc["queue_depth"]["p0"])
+    assert values[f'dls_health_alerts_active{{workdir="{wd}"}}'] == len(
+        doc["alerts_active"])
+    sev = {s: i for i, s in enumerate(health.SEVERITIES)}
+    assert values[f'dls_health_worst_severity{{workdir="{wd}"}}'] == (
+        sev[doc["worst_severity"]])
+    # series gauges expose the newest finest bucket per stat
+    assert any(k.startswith("dls_series_queue_depth{") for k in values)
+
+
+def test_openmetrics_label_escaping(tmp_path):
+    store = series.SeriesStore(tmp_path, resolutions=((10.0, 8),))
+    store.record(1.0, {series.series_key("m", host='a"b\\c'): 1.0})
+    body = series.openmetrics_exposition(tmp_path)
+    assert 'host="a\\"b\\\\c"' in body
+    assert body.endswith("# EOF\n")
+
+
+def test_serve_metrics_endpoint_scrape(tmp_path):
+    """--serve-metrics: a real scrape over HTTP returns the exposition
+    byte-for-byte with the OpenMetrics content type."""
+    _engine_workdir(tmp_path)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+         str(tmp_path), "--serve-metrics", "0", "--watch-count", "1"],
+        stderr=subprocess.PIPE, text=True)
+    try:
+        banner = proc.stderr.readline()
+        m = re.search(r"http://([\d.]+):(\d+)/metrics", banner)
+        assert m, banner
+        with urllib.request.urlopen(
+                f"http://{m.group(1)}:{m.group(2)}/metrics",
+                timeout=10) as resp:
+            ctype = resp.headers["Content-Type"]
+            got = resp.read().decode("utf-8")
+        assert ctype == series.OPENMETRICS_CONTENT_TYPE
+        assert got == series.openmetrics_exposition(tmp_path)
+        assert proc.wait(timeout=10) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+# -- chrome trace counter tracks ----------------------------------------------
+
+
+def test_chrome_trace_series_counter_tracks(tmp_path):
+    _engine_workdir(tmp_path)
+    events = telemetry.read_events(tmp_path)
+    buckets = series.read_buckets(tmp_path, 10.0)
+    data = trace_lib.chrome_trace(events, series_buckets=buckets)
+    counters = [e for e in data["traceEvents"]
+                if e.get("ph") == "C" and e.get("cat") == "series"]
+    assert counters
+    assert {"queue_depth{replica=p0}"} <= {c["name"] for c in counters}
+    assert all(math.isfinite(c["args"]["mean"]) for c in counters)
+    assert all(c["ts"] >= 0 for c in counters)
+
+
+# -- perf_guard --series ------------------------------------------------------
+
+
+def _mk_buckets(vals, t0=0.0, width=10.0):
+    return [{"t": t0 + i * width, "count": 1, "min": v, "max": v,
+             "mean": v, "last": v} for i, v in enumerate(vals)]
+
+
+def test_guard_series_flags_within_run_decline():
+    declining = _mk_buckets([10.0] * 6 + [6.0] * 6)   # last quartile -40%
+    steady = _mk_buckets([0.9] * 12)
+    rep = perf_guard.guard_series({
+        "steps_per_sec": declining, "goodput_frac": steady}, band=0.15)
+    assert rep["verdict"] == "REGRESSED"
+    assert rep["regressed"] == ["steps_per_sec"]
+    by = {c["check"]: c for c in rep["checks"]}
+    assert by["goodput_frac"]["status"] == "ok"
+    # lower-better series regress on GROWTH
+    rep2 = perf_guard.guard_series({
+        "queue_depth{replica=p0}": _mk_buckets([1.0] * 6 + [9.0] * 6)})
+    assert rep2["regressed"] == ["queue_depth{replica=p0}"]
+    # a decline inside the band is noise
+    ok = perf_guard.guard_series({
+        "steps_per_sec": _mk_buckets([10.0] * 6 + [9.0] * 6)})
+    assert ok["verdict"] == "OK"
+    # too few buckets -> refuses to guess; unknown series never judged
+    few = perf_guard.guard_series({"steps_per_sec": _mk_buckets([1.0] * 4),
+                                   "unguarded_series": _mk_buckets(
+                                       [1.0] * 12)})
+    assert few["verdict"] == "INSUFFICIENT_HISTORY"
+
+
+def test_perf_guard_series_cli(tmp_path, capsys):
+    missing = tmp_path / "nope"
+    assert perf_guard.main(["--series", str(missing)]) == 2
+    capsys.readouterr()
+    store = series.SeriesStore(tmp_path, resolutions=((10.0, 64),))
+    for i in range(16):
+        v = 10.0 if i < 8 else 5.0       # in-run 50% steps/sec collapse
+        store.record(i * 10.0 + 5.0, {"steps_per_sec": v})
+    store.flush()
+    assert perf_guard.main(["--series", str(tmp_path), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["verdict"] == "REGRESSED"
+    assert doc["regressed"] == ["steps_per_sec"]

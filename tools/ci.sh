@@ -1232,7 +1232,8 @@ SERVE = [sys.executable, "-m", "distributeddeeplearningspark_tpu.serve.cli",
 HEALTH_KEYS = {
     "schema", "generated_ts", "workdir", "worst_severity", "rules",
     "goodput", "slo", "queue_depth", "tenants", "last_step",
-    "last_heartbeat_age_s", "stream", "evaluations", "alerts_active"}
+    "last_heartbeat_age_s", "stream", "evaluations", "alerts_active",
+    "engine"}
 
 
 def run(cmd, log, env=None):
@@ -1339,6 +1340,266 @@ PYEOF
   return $rc
 }
 
+# history smoke (ISSUE 18): the metrics time-series plane end-to-end on
+# REAL runs. (a) a train_mnist run replayed through the HealthEngine
+# leaves populated series at >=2 resolutions with the engine's re-read
+# bytes bounded by the append rate (cursor accounting); `dlstatus
+# --history` renders finite sparklines and its --json matches the pinned
+# schema. (b) a healthy + faulted 2-replica tinyllama fleet: the engine
+# sweeps anchors across the fault's violation completions and the
+# predictive trend:slo WARN (burn-rate slope projecting EXHAUSTED) must
+# raise STRICTLY BEFORE the damped level CRIT. (c) an HTTP scrape of
+# `dlstatus --serve-metrics` parses as OpenMetrics and its gauge values
+# bitwise-tie to health.json (docs/OBSERVABILITY.md "History, trends,
+# and the metrics endpoint").
+run_history_smoke() {
+  local t0 rc root out
+  t0=$(date +%s)
+  rc=0
+  root=$(mktemp -d /tmp/dls_history_smoke.XXXXXX)
+  out=$(ROOT="$root" python - <<'PYEOF'
+import json, os, re, subprocess, sys, urllib.request
+
+from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.telemetry import fleet as fleet_lib
+from distributeddeeplearningspark_tpu.telemetry import health
+from distributeddeeplearningspark_tpu.telemetry import series
+
+root = os.environ["ROOT"]
+wdt = os.path.join(root, "train")
+wds = os.path.join(root, "serve")
+
+
+def run(cmd, log, env=None):
+    with open(log, "w") as f:
+        p = subprocess.run(cmd, stdout=f, stderr=subprocess.STDOUT, env=env)
+    assert p.returncode == 0, (cmd[-6:], open(log).read()[-800:])
+
+
+def dlstatus(*argv):
+    p = subprocess.run(
+        [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+         *argv], capture_output=True, text=True)
+    assert p.returncode == 0, (argv, p.stderr[-500:])
+    return p
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# -- (a) train run -> engine replay -> multi-resolution series ----------------
+env = dict(os.environ, DLS_TELEMETRY_DIR=wdt,
+           XLA_FLAGS="--xla_force_host_platform_device_count=8")
+run([sys.executable, "examples/train_mnist.py", "--master", "local[2]",
+     "--steps", "6", "--batch-size", "16"],
+    os.path.join(root, "train.log"), env=env)
+ev = telemetry.read_events(wdt)
+t_lo = min(float(e["ts"]) for e in ev)
+t_hi = max(float(e["ts"]) for e in ev)
+appended = sum(os.path.getsize(p) for p in telemetry.event_files(wdt))
+clock = Clock()
+eng = health.HealthEngine(wdt, damping=2, clock=clock, write_alerts=False)
+n_anchor = max(8, int((t_hi - t_lo) / 5.0))
+for i in range(1, n_anchor + 1):
+    clock.t = t_lo + (t_hi - t_lo) * i / n_anchor + 1e-3
+    eng.window_s = clock.t - t_lo + 60.0
+    rep = eng.evaluate()
+eng.close()
+# cursor accounting: N evaluations read each appended byte AT MOST once —
+# history costs the append rate, never an N x re-scan
+train_bytes = rep["engine"]["bytes_read"]
+assert 0 < train_bytes <= appended, (train_bytes, appended, n_anchor)
+
+ladder = series.list_resolutions(wdt)
+assert len(ladder) >= 2, ladder
+pops = sum(
+    1 for res, _cap in ladder
+    if series.GOODPUT_SERIES in series.read_buckets(wdt, res)
+    and series.STEPS_SERIES in series.read_buckets(wdt, res))
+assert pops >= 2, f"series populated at only {pops} resolutions: {ladder}"
+
+# --history: finite sparklines in the human render, pinned --json schema
+p = dlstatus(wdt, "--history", "--since", "1h")
+assert "nan" not in p.stdout.lower(), p.stdout
+assert any(g in p.stdout for g in "▁▂▃▄▅▆▇█"), p.stdout
+assert series.STEPS_SERIES in p.stdout, p.stdout
+doc = json.loads(dlstatus(wdt, "--history", "--json").stdout)
+assert tuple(doc) == series.HISTORY_KEYS, list(doc)
+assert doc["series"] and all(
+    tuple(r) == series.HISTORY_ROW_KEYS for r in doc["series"]), doc
+# the within-run decline sentinel reads the same store (verdict informative
+# here: a 6-step run rarely spans the 8-bucket minimum)
+g = subprocess.run([sys.executable, "tools/perf_guard.py", "--series", wdt,
+                    "--json"], capture_output=True, text=True)
+assert g.returncode in (0, 1), g.stderr[-300:]
+guard = json.loads(g.stdout)["verdict"]
+
+# -- (b) fault drill: predictive WARN strictly before the damped CRIT ---------
+SERVE = [sys.executable, "-m", "distributeddeeplearningspark_tpu.serve.cli",
+         "--model", "tinyllama", "--replicas", "2", "--clients", "8",
+         "--requests-per-client", "2", "--tenants", "2",
+         "--prefix-tokens", "32", "--suffix-tokens", "8",
+         "--max-new-tokens", "8", "--workdir", wds]
+run(SERVE, os.path.join(root, "serve-baseline.log"))
+lats = sorted(float(e["latency_s"]) for e in telemetry.read_events(wds)
+              if e.get("kind") == "request" and e.get("outcome") == "ok"
+              and e.get("latency_s") is not None)
+assert lats, "baseline served nothing"
+target = max(1.0, 1.5 * lats[int(0.99 * (len(lats) - 1))])
+run(SERVE + ["--requests-per-client", "3",
+             "--fault-sleep-ms", "2000", "--fault-replica", "0"],
+    os.path.join(root, "serve-faulted.log"))
+
+# rebuild the per-tenant violation trajectory exactly as slo_report
+# attributes it (root request spans + untraced sheds), keyed by each
+# event's ts — the same visibility order the engine's window filter sees
+ev = telemetry.read_events(wds)
+t0g = min(float(e["ts"]) for e in ev)
+rows = []  # (visibility ts, tenant, violates?)
+for e in ev:
+    if (e.get("kind") == "span" and e.get("name") == "request"
+            and not e.get("parent_id") and e.get("t1") is not None):
+        a = e.get("attrs") or {}
+        lat = max(0.0, float(e["t1"]) - float(e["t0"]))
+        bad = a.get("outcome") != "ok" or lat > target
+        rows.append((float(e["ts"]), str(a.get("tenant") or "default"), bad))
+    elif (e.get("kind") == "request" and e.get("outcome") == "shed"
+          and e.get("trace") is None):
+        rows.append((float(e["ts"]), str(e.get("tenant") or "default"), True))
+by_tenant = {}
+for ts, ten, bad in rows:
+    by_tenant.setdefault(ten, []).append((ts, bad))
+viol_counts = {t: sum(1 for _, b in r if b) for t, r in by_tenant.items()}
+assert any(viol_counts.values()), \
+    f"fault drill produced no violations vs {target:.2f}s target"
+tenant = max(viol_counts, key=lambda t: viol_counts[t])
+
+
+def frac_at(ts):
+    n = sum(1 for x, _ in by_tenant[tenant] if x <= ts)
+    v = sum(1 for x, b in by_tenant[tenant] if b and x <= ts)
+    return v / n if n else 0.0
+
+
+# anchor the engine where the tenant's violation frac strictly rises: the
+# greedy monotone subsequence of its violation completions (ok requests
+# completing in between can locally dilute the frac — skip those anchors)
+vts = sorted(x for x, b in by_tenant[tenant] if b)
+S, last_f = [], 0.0
+for t in vts:
+    f = frac_at(t + 1e-4)
+    if f > last_f:
+        S.append((t + 1e-4, f))
+        last_f = f
+assert len(S) >= 4, (
+    f"only {len(S)} monotone violation anchors for {tenant} "
+    f"(of {len(vts)} violations) — fault too weak vs {target:.2f}s target")
+final_frac = frac_at(vts[-1] + 60.0)
+assert S[-2][1] < min(S[-1][1], final_frac), (S, final_frac)
+
+# scale the error budget so burn crosses EXHAUSTED (10x) between the last
+# two monotone anchors: >=3 anchors sit in the band below CRIT for the
+# trend rule to see the rise, and the crossing + trailing anchors carry
+# the level rule to its damped CRIT
+thresh = (S[-2][1] + min(S[-1][1], final_frac)) / 2.0
+budget = thresh / fleet_lib.SLO_EXHAUST_BURN
+
+os.environ["DLS_HEALTH_TREND_N"] = "2"
+clock = Clock()
+eng = health.HealthEngine(wds, damping=2, clock=clock, slo_target_s=target,
+                          slo_budget=budget)
+anchors = ([S[0][0] - 2.0, S[0][0] - 1.0] + [t for t, _ in S]
+           + [vts[-1] + 60.0, vts[-1] + 61.0])
+for a in anchors:
+    clock.t = a
+    eng.window_s = a - t0g + 60.0
+    rep = eng.evaluate()
+eng.close()
+del os.environ["DLS_HEALTH_TREND_N"]
+serve_bytes = rep["engine"]["bytes_read"]
+disk = sum(os.path.getsize(p) for p in telemetry.event_files(wds))
+assert 0 < serve_bytes <= disk, (serve_bytes, disk)
+
+alerts = [e for e in telemetry.read_events(wds) if e.get("kind") == "alert"]
+trend_raises = [e for e in alerts if e.get("edge") == "raise"
+                and e.get("key") == f"trend:slo:{tenant}"]
+crit_raises = [e for e in alerts if e.get("edge") == "raise"
+               and e.get("key") == f"slo:{tenant}"
+               and e.get("severity") == "CRIT"]
+assert trend_raises, [(e.get("key"), e.get("severity")) for e in alerts]
+assert crit_raises, [(e.get("key"), e.get("severity")) for e in alerts]
+t_warn = min(float(e["ts"]) for e in trend_raises)
+t_crit = min(float(e["ts"]) for e in crit_raises)
+assert t_warn < t_crit, (t_warn, t_crit)
+proj = trend_raises[0]["evidence"]["projected_exhausted_in_s"]
+assert proj >= 0, trend_raises[0]["evidence"]
+pops_s = sum(1 for res, _cap in ladder
+             if series.read_buckets(wds, res))
+assert pops_s >= 2, f"serve series at only {pops_s} resolutions"
+
+# -- (c) OpenMetrics scrape bitwise-ties to health.json -----------------------
+srv = subprocess.Popen(
+    [sys.executable, "-m", "distributeddeeplearningspark_tpu.status", wds,
+     "--serve-metrics", "0", "--watch-count", "1"],
+    stderr=subprocess.PIPE, text=True)
+try:
+    banner = srv.stderr.readline()
+    m = re.search(r"http://([\d.]+):(\d+)/metrics", banner)
+    assert m, banner
+    with urllib.request.urlopen(
+            f"http://{m.group(1)}:{m.group(2)}/metrics", timeout=30) as r:
+        ctype = r.headers["Content-Type"]
+        body = r.read().decode("utf-8")
+    assert srv.wait(timeout=30) == 0
+finally:
+    if srv.poll() is None:
+        srv.kill()
+        srv.wait()
+assert ctype == series.OPENMETRICS_CONTENT_TYPE, ctype
+lines = body.splitlines()
+assert lines[-1] == "# EOF", lines[-1]
+LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9+.eEnaIf-]+$")
+fams, vals = set(), {}
+for ln in lines[:-1]:
+    if ln.startswith("# TYPE "):
+        assert ln.endswith(" gauge"), ln
+        fams.add(ln.split()[2])
+        continue
+    assert LINE.match(ln), ln
+    name_labels, _, raw = ln.rpartition(" ")
+    assert name_labels.split("{", 1)[0] in fams, ln
+    vals[name_labels] = float(raw)
+with open(os.path.join(wds, health.HEALTH_FILENAME)) as f:
+    hdoc = json.load(f)
+sev = {s: i for i, s in enumerate(health.SEVERITIES)}
+assert vals[f'dls_health_worst_severity{{workdir="{wds}"}}'] == (
+    sev[hdoc["worst_severity"]])
+assert vals[f'dls_health_alerts_active{{workdir="{wds}"}}'] == len(
+    hdoc["alerts_active"])
+assert vals[f'dls_queue_depth{{replica="p0",workdir="{wds}"}}'] == (
+    hdoc["queue_depth"]["p0"])
+burn_doc = hdoc["slo"]["tenants"][tenant]["burn_rate"]
+assert vals[
+    f'dls_slo_burn_rate{{tenant="{tenant}",workdir="{wds}"}}'] == burn_doc
+
+print(f"train_series={pops}res bytes={train_bytes}<= {appended} "
+      f"guard={guard} drill: tenant={tenant} viols={len(vts)}({len(S)}mono) "
+      f"warn@{t_warn - t0g:.1f}s < crit@{t_crit - t0g:.1f}s "
+      f"proj={proj:.0f}s burn={burn_doc}x scrape={len(vals)}gauges bitwise=ok")
+PYEOF
+) || { rc=$?; tail -5 "$root"/*.log 2>/dev/null; }
+  log history "${out:-history smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[history] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$root"
+  return $rc
+}
+
 overall=0
 case "${1:-both}" in
   fast) run_tier fast "not slow" || overall=$? ;;
@@ -1352,6 +1613,7 @@ case "${1:-both}" in
         run_mpmd_smoke || overall=$?
         run_plan_smoke || overall=$?
         run_health_smoke || overall=$?
+        run_history_smoke || overall=$?
         run_perf_guard_smoke || overall=$? ;;
   # the recovery drills (kill-mid-finalize, poisoned restore, hang, NaN
   # spike) end-to-end — slow-marked, so the fast tier never pays for gangs
@@ -1414,10 +1676,17 @@ case "${1:-both}" in
   # schema at both edges, --incidents ordering, --cluster fold
   # (docs/OBSERVABILITY.md "Alerts, health.json, and the cluster view")
   health) run_health_smoke || overall=$? ;;
+  # metrics time-series plane: real runs leave multi-resolution series
+  # (re-read bytes bounded by the append rate), predictive trend WARN
+  # strictly before the damped CRIT in the fault drill, --history pinned
+  # schema + finite sparklines, OpenMetrics scrape bitwise-ties to
+  # health.json (docs/OBSERVABILITY.md "History, trends, and the metrics
+  # endpoint")
+  history) run_history_smoke || overall=$? ;;
   # the executable pod-day scripts, logged with the same audit trail
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|live-reshard|mpmd|plan|perf-guard|health|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|live-reshard|mpmd|plan|perf-guard|health|history|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
