@@ -12,6 +12,16 @@ DLS_COORDINATOR env (set per host by the launcher).
 Usage::
 
     dlsubmit [--master local[2]] [--name app] [--conf k=v ...] script.py [args...]
+
+With ``--cluster ROOT`` the same surface submits to the shared-cluster
+scheduler instead of running in-process — the spark-submit-on-YARN shape.
+The script is enqueued in the ledger under the tenant/priority given and
+launched by the scheduler's control loop once gang-aware placement grants
+it hosts (``{workdir}``/``{ckpt}`` in the script args expand to the job's
+run directory at launch)::
+
+    dlsubmit --cluster /pool --tenant research --priority 10 --hosts 2 \\
+        --min-hosts 1 train.py --ckpt-dir '{ckpt}'
 """
 
 from __future__ import annotations
@@ -48,6 +58,38 @@ def build_parser() -> argparse.ArgumentParser:
              "on every telemetry record, and folded by `dlstatus --cluster` "
              "into the per-tenant goodput/occupancy rollup",
     )
+    p.add_argument(
+        "--priority", type=int, default=None,
+        help="scheduling priority (integer, higher wins): exported as "
+             "DLS_PRIORITY and stamped on every telemetry record like "
+             "--tenant; under --cluster it orders the queue and arms "
+             "preemption of lower-priority jobs",
+    )
+    p.add_argument(
+        "--cluster", metavar="ROOT", default=None,
+        help="submit to the shared-cluster scheduler's ledger under ROOT "
+             "instead of running in-process; the control loop launches the "
+             "job once placement grants it hosts",
+    )
+    p.add_argument(
+        "--hosts", type=int, default=1,
+        help="--cluster: hosts the job's gang needs (whole-or-not-at-all)",
+    )
+    p.add_argument(
+        "--gangs", default=None, metavar="N,M,...",
+        help="--cluster: multi-gang shape (e.g. MPMD stages '2,2'); "
+             "overrides --hosts; every gang places whole-or-not-at-all",
+    )
+    p.add_argument(
+        "--min-hosts", type=int, default=None,
+        help="--cluster: elastic floor — preemption may shrink the job "
+             "down to this many hosts (default: rigid, = total hosts)",
+    )
+    p.add_argument(
+        "--kind", default="train", choices=["train", "serve", "mpmd",
+                                            "shuffle"],
+        help="--cluster: workload kind recorded in the ledger",
+    )
     p.add_argument("script", help="driver script to run")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
@@ -82,6 +124,15 @@ def main(argv: list[str] | None = None) -> int:
     if args.num_executors is not None:
         conf["spark.executor.instances"] = str(args.num_executors)
 
+    if not os.path.exists(args.script):
+        raise SystemExit(f"dlsubmit: script not found: {args.script}")
+
+    if args.cluster:
+        # A cluster submission must not mutate the submitter's own process
+        # env: conf rides in the job env in the ledger, and the runner sets
+        # DLS_TENANT/DLS_PRIORITY/DLS_PREEMPT_NOTICE at launch.
+        return _cluster_submit(args, conf)
+
     # Hand conf to the driver script through the env so its plain
     # Session.builder.getOrCreate() sees the launch configuration.
     for k, v in conf.items():
@@ -96,12 +147,47 @@ def main(argv: list[str] | None = None) -> int:
         from distributeddeeplearningspark_tpu import telemetry
 
         os.environ[telemetry.TENANT_ENV] = args.tenant
+    if args.priority is not None:
+        from distributeddeeplearningspark_tpu import telemetry
 
-    if not os.path.exists(args.script):
-        raise SystemExit(f"dlsubmit: script not found: {args.script}")
+        os.environ[telemetry.PRIORITY_ENV] = str(args.priority)
 
     sys.argv = [args.script] + args.script_args
     runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+def _cluster_submit(args: argparse.Namespace, conf: dict[str, str]) -> int:
+    """Enqueue the script in the cluster ledger instead of running it.
+
+    The submitted command re-enters the script through the same driver
+    env contract (conf is carried as DLS_CONF_* entries in the job env),
+    so a script that works under plain ``dlsubmit`` works unchanged when
+    placed by the scheduler.
+    """
+    from distributeddeeplearningspark_tpu.scheduler import Scheduler
+
+    gangs: list[int] | int
+    if args.gangs:
+        gangs = [int(g) for g in args.gangs.split(",") if g.strip()]
+    else:
+        gangs = args.hosts
+    env = {CONF_ENV_PREFIX + k.replace(".", "__"): v for k, v in conf.items()}
+    sched = Scheduler(os.path.abspath(args.cluster))
+    try:
+        job_id = sched.submit(
+            [sys.executable, os.path.abspath(args.script)] + args.script_args,
+            tenant=args.tenant or "default",
+            priority=args.priority or 0,
+            gangs=gangs,
+            min_hosts=args.min_hosts,
+            name=args.name or os.path.basename(args.script),
+            kind=args.kind,
+            env=env,
+        )
+    finally:
+        sched.close()
+    print(job_id)
     return 0
 
 

@@ -44,6 +44,15 @@ through every failure mode by the supervisor tests::
                                 # returns None for it — only the trainer's
                                 # drain path consults sigterm_fault().
 
+Beside the env-declared drills lives one *runtime* channel: the
+scheduler's preemption notice (``DLS_PREEMPT_NOTICE`` names a file path;
+:func:`deliver_preempt_notice` / :func:`read_preempt_notice`). It reuses
+the ``sigterm`` drain machinery but is delivered mid-run by the cluster
+scheduler (scheduler/core.py) instead of being declared at launch — the
+notice carries a step floor so every rank of a gang agrees on one drain
+step, and the supervisor retires it (:func:`consume_preempt_notice`) when
+it acts on the drain so the shrunk relaunch runs clean.
+
 Determinism rules:
 
 - A fault fires on **attempt 0 only** (``DLS_RESTART`` != "0" disables it),
@@ -223,6 +232,79 @@ def shuffle_fault(role: str, wid: int, attempt: int) -> int | None:
     if role not in roles or wid != victim:
         return None
     return fault.step
+
+
+#: Env var carrying the path of a run's preemption-notice file. The
+#: scheduler (scheduler/core.py) exports it when launching a placed job;
+#: unset (the default) keeps the trainer's per-step notice poll at zero
+#: cost — env-driven ``DLS_FAULT=sigterm@N`` drills are unaffected.
+PREEMPT_NOTICE_ENV = "DLS_PREEMPT_NOTICE"
+
+
+@dataclasses.dataclass(frozen=True)
+class PreemptNotice:
+    """A delivered (runtime) preemption notice: drain host ``host`` once
+    training reaches step ``step``. Unlike the env fault, the notice is
+    *delivered mid-run* — the step floor is how every rank of a gang
+    agrees on ONE drain step even though they observe the file at
+    slightly different times (the scheduler stamps it a margin ahead of
+    the victim's last observed step)."""
+
+    host: int
+    step: int
+
+
+def preempt_notice_path() -> str | None:
+    """Where this run's preemption notice would land (``None`` when not
+    scheduler-launched — the common case, and the zero-cost one)."""
+    return os.environ.get(PREEMPT_NOTICE_ENV) or None
+
+
+def deliver_preempt_notice(path: str, *, host: int, step: int) -> str:
+    """Atomically deliver a preemption notice (the scheduler's side of the
+    channel). Same tmp+rename discipline as the DRAIN evidence: a reader
+    sees the whole notice or no notice, never a torn one."""
+    import json as _json
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        _json.dump({"host": int(host), "step": int(step),
+                    "ts": time.time()}, f)
+    os.replace(tmp, path)
+    logger.warning("preemption notice delivered: drain host %d at step "
+                   ">= %d (%s)", host, step, path)
+    return path
+
+
+def read_preempt_notice(path: str | None = None) -> PreemptNotice | None:
+    """The pending runtime preemption notice, or None (absent env, absent
+    file, or a malformed/torn file — never raises: the notice channel is
+    advisory and a bad read must not kill a healthy step)."""
+    import json as _json
+
+    path = path if path is not None else preempt_notice_path()
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            doc = _json.load(f)
+        return PreemptNotice(host=int(doc["host"]), step=int(doc["step"]))
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def consume_preempt_notice(path: str | None, *, ordinal: int) -> None:
+    """Retire a delivered notice once the drain it asked for has been acted
+    on (kept beside it as ``<path>.consumed-<ordinal>`` for forensics, the
+    DRAIN-evidence discipline) so the shrunk relaunch does not re-drain on
+    the stale file. No-op when there is nothing to consume."""
+    if not path:
+        return
+    try:
+        os.replace(path, f"{path}.consumed-{ordinal}")
+    except OSError:
+        pass
 
 
 def sigterm_fault() -> Fault | None:

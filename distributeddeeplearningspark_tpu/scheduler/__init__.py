@@ -1,0 +1,60 @@
+"""Multi-tenant cluster scheduler — the resource manager above the job.
+
+The reference's Spark heritage implies a Borg/YARN-shaped layer the rebuild
+never had: many tenants submitting training gangs, serving fleets, MPMD
+stage pipelines, and shuffle pools against ONE shared host fleet. This
+package is that control plane, built on the planes that already exist
+instead of beside them:
+
+- **Durable state** (:mod:`.ledger`): an append-only JSONL ledger + an
+  atomic ``cluster.json`` host/quota inventory under ``<root>/sched`` —
+  crash-recoverable by the same fold-the-stream discipline as the
+  telemetry and checkpoint planes. Current cluster state is a pure fold
+  over the ledger; a restarted scheduler resumes from the fold.
+- **Gang-aware placement** (:mod:`.core`): a job declares its gangs (a
+  mesh, each MPMD stage, a shuffle pool) and every gang places
+  whole-or-not-at-all — the 2412.14374 model where a gang is the
+  indivisible scheduling unit. Per-tenant host quotas bound admission;
+  integer priorities order the queue.
+- **Checkpoint-preemption on the elastic machinery**: a high-priority job
+  short of hosts preempts the lowest-priority victim — preferring a
+  *graceful shrink* (the PR 16 drain: a runtime preemption notice file,
+  :func:`~..faults.deliver_preempt_notice`, makes the victim checkpoint/
+  hand off live state and give one host back NOW, resuming the rest
+  without walk-back) and falling back to *eviction* (stop + requeue; the
+  victim later resumes from its checkpoint on whatever frees up, through
+  reshard-on-restore).
+- **Reconciliation** (:meth:`.core.Scheduler.tick`): each tick consumes
+  every running job's workdir — its ``health.json`` (worst severity,
+  heartbeat age) and telemetry stream (geometry changes, runner
+  liveness) — to absorb completed shrinks, free hosts, and requeue dead
+  or wedged jobs.
+
+Everything here is jax-free: the scheduler is an operator-side control
+loop, cheap enough for a CLI. Jobs are launched through the existing
+supervisor machinery with the ``DLS_*`` env contract (see :mod:`.runner`),
+and every lifecycle edge is also emitted as a ``sched`` telemetry event,
+so ``dlstatus --cluster`` / ``--incidents`` / ``--export-trace`` see the
+scheduler's decisions in the same streams as everything else.
+"""
+
+from distributeddeeplearningspark_tpu.scheduler.core import (  # noqa: F401
+    Placement,
+    Preemption,
+    Scheduler,
+    plan,
+)
+from distributeddeeplearningspark_tpu.scheduler.ledger import (  # noqa: F401
+    ACTIVE_STATUSES,
+    EDGES,
+    ClusterState,
+    Job,
+    append,
+    init_cluster,
+    job_workdir,
+    ledger_path,
+    load_config,
+    load_state,
+    read_ledger,
+    sched_dir,
+)

@@ -1,0 +1,77 @@
+"""``python -m distributeddeeplearningspark_tpu.scheduler`` — operate a
+cluster state dir: init the inventory, run the control loop, inspect the
+queue. Submission goes through ``dlsubmit --cluster`` (cli.py); this is
+the operator side.
+
+    python -m distributeddeeplearningspark_tpu.scheduler init ROOT --hosts 4 \\
+        --quota research=2 --quota prod=4
+    python -m distributeddeeplearningspark_tpu.scheduler tick ROOT
+    python -m distributeddeeplearningspark_tpu.scheduler run ROOT --interval 2
+    python -m distributeddeeplearningspark_tpu.scheduler status ROOT
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from distributeddeeplearningspark_tpu.scheduler import core, ledger
+
+
+def _parse_quota(entries: list[str]) -> dict[str, int]:
+    quotas: dict[str, int] = {}
+    for e in entries:
+        tenant, sep, n = e.partition("=")
+        if not sep:
+            raise SystemExit(f"--quota expects TENANT=HOSTS, got {e!r}")
+        quotas[tenant] = int(n)
+    return quotas
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributeddeeplearningspark_tpu.scheduler",
+        description="Operate a multi-tenant cluster scheduler state dir.")
+    sub = ap.add_subparsers(dest="op", required=True)
+    p_init = sub.add_parser("init", help="create the host/quota inventory")
+    p_init.add_argument("root")
+    p_init.add_argument("--hosts", type=int, required=True)
+    p_init.add_argument("--quota", action="append", default=[],
+                        metavar="TENANT=HOSTS")
+    p_tick = sub.add_parser("tick", help="one reconcile+plan+act pass")
+    p_tick.add_argument("root")
+    p_tick.add_argument("--no-launch", action="store_true",
+                        help="record placements without spawning runners")
+    p_run = sub.add_parser("run", help="the control loop")
+    p_run.add_argument("root")
+    p_run.add_argument("--interval", type=float, default=2.0)
+    p_run.add_argument("--max-ticks", type=int, default=None)
+    p_run.add_argument("--until-idle", action="store_true",
+                       help="exit once every submitted job is terminal")
+    p_status = sub.add_parser("status", help="queue + accounting (JSON)")
+    p_status.add_argument("root")
+    args = ap.parse_args(argv)
+
+    if args.op == "init":
+        cfg = ledger.init_cluster(args.root, hosts=args.hosts,
+                                  quotas=_parse_quota(args.quota))
+        print(json.dumps(cfg))
+        return 0
+    if args.op == "status":
+        print(json.dumps(ledger.load_state(args.root).to_report()))
+        return 0
+    sched = core.Scheduler(args.root)
+    try:
+        if args.op == "tick":
+            print(json.dumps(sched.tick(launch=not args.no_launch)))
+            return 0
+        sched.run(interval=args.interval, max_ticks=args.max_ticks,
+                  until_idle=args.until_idle)
+        return 0
+    finally:
+        sched.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

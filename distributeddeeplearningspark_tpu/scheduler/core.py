@@ -1,0 +1,525 @@
+"""Gang-aware placement, quotas/priorities, and the reconcile loop.
+
+:func:`plan` is the pure packing decision (state in, actions out — unit
+testable with no processes); :class:`Scheduler` is the control loop that
+folds the ledger, reconciles it against reality (runner liveness, each
+workdir's ``health.json``, observed drains), executes the plan, and
+launches placed jobs through :mod:`.runner`.
+
+Preemption ladder (highest-priority pending job first):
+
+1. **Free hosts** — place on them when all gangs fit; no victim needed.
+2. **Graceful shrink** — an elastic, single-gang, lower-priority victim
+   above its ``min_hosts`` floor gives back its highest gang ordinal: a
+   preemption notice file (:func:`~..faults.deliver_preempt_notice`) makes
+   the trainer drain in-flight work, commit the live handoff, and exit
+   clean; the victim's own supervisor shrinks and resumes WITHOUT
+   walk-back, and the freed host joins the pool next tick (when the
+   drain's ``geometry_change`` lands in the victim's stream).
+3. **Eviction** — when shrinking can't cover the deficit, the whole
+   lowest-priority victim is stopped (SIGTERM its process group, escalate
+   to SIGKILL) and requeued; it resumes later from its checkpoint on
+   whatever is free, through reshard-on-restore.
+4. **Blocked** — equal-or-higher-priority holders are never preempted;
+   the job waits in the queue with its reason recorded.
+
+A preempting tick does NOT place the beneficiary — hosts freed by a drain
+or eviction only exist once the ledger says so, and the next tick places
+against the real inventory (no optimistic double-booking).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from distributeddeeplearningspark_tpu import faults
+from distributeddeeplearningspark_tpu import telemetry as telemetry_lib
+from distributeddeeplearningspark_tpu.scheduler import ledger as ledger_lib
+
+logger = logging.getLogger("distributeddeeplearningspark_tpu.scheduler")
+
+#: the runtime preemption notice file, under the victim's workdir
+PREEMPT_NOTICE_NAME = "PREEMPT"
+#: checkpoint subdir convention for scheduler-launched jobs ({ckpt} in
+#: a submitted command expands to it; the DRAIN evidence lands there)
+CKPT_DIRNAME = "ckpt"
+
+#: steps of margin between a victim's last observed step and the notice's
+#: drain-step floor — the window in which every rank must observe the
+#: notice file so the gang drains at ONE agreed step
+DRAIN_MARGIN_ENV = "DLS_SCHED_DRAIN_MARGIN_STEPS"
+#: heartbeat age (seconds) past which a CRIT job is declared wedged and
+#: requeued (its runner killed first)
+WEDGE_ENV = "DLS_SCHED_WEDGE_S"
+#: requeues after which a job is declared failed instead of relaunched —
+#: a job whose runner dies every attempt must not spin the cluster forever
+MAX_REQUEUES_ENV = "DLS_SCHED_MAX_REQUEUES"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def notice_path(workdir: str) -> str:
+    return os.path.join(workdir, PREEMPT_NOTICE_NAME)
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """Place ``job_id`` on ``assignment`` (gang ordinal -> host slot)."""
+
+    job_id: str
+    assignment: dict[int, str]
+
+
+@dataclasses.dataclass(frozen=True)
+class Preemption:
+    """Reclaim hosts from ``victim``: ``mode`` "shrink" drains gang
+    ordinal ``ordinal`` (one host back, job keeps running); "evict"
+    stops and requeues the whole job."""
+
+    victim: str
+    mode: str  # "shrink" | "evict"
+    for_job: str
+    ordinal: int | None = None
+
+
+def plan(state: ledger_lib.ClusterState) -> dict:
+    """The packing decision: placements for pending jobs that fit (whole
+    gangs, within quota), preemptions where a higher-priority job is
+    short, and the blocked remainder with reasons. Pure — no clocks, no
+    filesystem, deterministic given the state."""
+    placements: list[Placement] = []
+    preemptions: list[Preemption] = []
+    blocked: list[dict] = []
+    free = list(state.free_hosts())
+    used = state.used_by_tenant()
+    # victims a preemption was already planned against this tick (or whose
+    # drain is still in flight from an earlier tick) are off the table
+    claimed_victims = {j.job_id for j in state.jobs.values()
+                       if j.draining is not None}
+    for job in state.pending():
+        quota = state.quota_of(job.tenant)
+        if quota is not None and used.get(job.tenant, 0) + job.min_hosts > quota:
+            blocked.append({"job": job.job_id, "reason": "quota",
+                            "detail": f"used {used.get(job.tenant, 0)} + "
+                                      f"min {job.min_hosts} > quota {quota}"})
+            continue
+        want = job.total_hosts
+        if quota is not None:
+            want = min(want, quota - used.get(job.tenant, 0))
+        if want >= job.total_hosts and len(free) >= job.total_hosts:
+            take = job.total_hosts
+        elif (len(job.gangs) == 1 and job.min_hosts < job.total_hosts
+              and min(want, len(free)) >= job.min_hosts):
+            # elastic partial placement: run now on what's free (a
+            # requeued preemptee resuming on fewer hosts lands here —
+            # reshard-on-restore makes the geometry change safe)
+            take = min(want, len(free))
+        else:
+            take = 0
+        if take:
+            assignment = {o: free[o] for o in range(take)}
+            placements.append(Placement(job.job_id, assignment))
+            free = free[take:]
+            used[job.tenant] = used.get(job.tenant, 0) + take
+            continue
+        # can't place: try to free hosts from strictly-lower-priority
+        # holders (never peers — priority ties don't churn each other).
+        # The preemption goal is the job's FLOOR, not its full size:
+        # minimal disruption now, elastic growth later when hosts free up
+        deficit = job.min_hosts - len(free)
+        victims = sorted(
+            (v for v in state.jobs.values()
+             if v.status in ledger_lib.ACTIVE_STATUSES
+             and v.priority < job.priority
+             and v.job_id not in claimed_victims),
+            key=lambda v: (v.priority, -(v.started_ts or 0.0)))
+        planned: list[Preemption] = []
+        for v in victims:
+            if deficit <= 0:
+                break
+            shrinkable = (len(v.assignment) - v.min_hosts
+                          if len(v.gangs) == 1 else 0)
+            if v.status == "RUNNING" and shrinkable >= 1:
+                # one drained host per victim per tick: the graceful
+                # machinery re-gathers ONE doomed host's shards at a time
+                ordinal = max(v.assignment)
+                planned.append(Preemption(v.job_id, "shrink", job.job_id,
+                                          ordinal=ordinal))
+                deficit -= 1
+            else:
+                planned.append(Preemption(v.job_id, "evict", job.job_id))
+                deficit -= len(v.assignment)
+        if deficit <= 0 and planned:
+            preemptions.extend(planned)
+            claimed_victims.update(p.victim for p in planned)
+            blocked.append({"job": job.job_id,
+                            "reason": "awaiting-preemption",
+                            "detail": f"{len(planned)} victim(s) preempted"})
+        else:
+            blocked.append({"job": job.job_id, "reason": "capacity",
+                            "detail": f"needs {job.min_hosts}+, "
+                                      f"{len(free)} free, no lower-priority "
+                                      f"victim covers the deficit"})
+    return {"place": placements, "preempt": preemptions, "blocked": blocked}
+
+
+class Scheduler:
+    """The cluster control loop over one state dir.
+
+    Crash-recoverable by construction: every decision is a ledger append
+    before it is an action, and a fresh Scheduler on the same root folds
+    itself back to the identical view. ``clock`` is injectable so the
+    accounting tests run on a fake clock."""
+
+    def __init__(self, root: str | os.PathLike, *, clock=time.time):
+        self.root = os.path.abspath(os.fspath(root))
+        self._clock = clock
+        self._tele: telemetry_lib.EventWriter | None = None
+        #: Popen handles for runners THIS process launched (liveness via
+        #: poll(); a recovered scheduler falls back to kill(pid, 0))
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._engines: dict[str, object] = {}
+
+    # -- telemetry ------------------------------------------------------------
+
+    def _telemetry(self) -> telemetry_lib.EventWriter:
+        if self._tele is None:
+            self._tele = telemetry_lib.EventWriter(
+                ledger_lib.sched_dir(self.root), process="sched", host=None,
+                clock=self._clock)
+        return self._tele
+
+    def _emit(self, edge: str, job: ledger_lib.Job, *, mirror: bool = False,
+              **fields) -> None:
+        """One ``sched`` event into the scheduler's own stream, mirrored
+        into the job's workdir stream for the edges that concern it (so
+        the job's incident timeline shows its own preemption)."""
+        rec = {"edge": edge, "job": job.job_id, "tenant": job.tenant,
+               "priority": job.priority, **fields}
+        self._telemetry().emit("sched", **rec)
+        if mirror and job.workdir:
+            w = telemetry_lib.EventWriter(
+                job.workdir, process="sched", host=None, clock=self._clock,
+                tenant=job.tenant, priority=job.priority)
+            try:
+                w.emit("sched", **rec)
+            finally:
+                w.close()
+
+    def close(self) -> None:
+        if self._tele is not None:
+            self._tele.close()
+            self._tele = None
+        for eng in self._engines.values():
+            try:
+                eng.close()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+        self._engines.clear()
+
+    # -- submission -----------------------------------------------------------
+
+    def submit(self, cmd: list[str], *, tenant: str, priority: int = 0,
+               gangs: list[int] | int = 1, min_hosts: int | None = None,
+               name: str | None = None, kind: str = "train",
+               env: dict[str, str] | None = None) -> str:
+        """Append a job to the queue; returns its ledger id. ``cmd`` may
+        reference ``{workdir}`` / ``{ckpt}``, expanded at launch to the
+        job's run directory / checkpoint root."""
+        gangs = [gangs] if isinstance(gangs, int) else list(gangs)
+        if not gangs or any(g < 1 for g in gangs):
+            raise ValueError(f"bad gang shape {gangs}: every gang needs "
+                             f">= 1 host")
+        total = sum(gangs)
+        min_hosts = total if min_hosts is None else int(min_hosts)
+        if not 1 <= min_hosts <= total:
+            raise ValueError(
+                f"min_hosts {min_hosts} outside [1, {total}]")
+        if len(gangs) > 1 and min_hosts != total:
+            raise ValueError(
+                "multi-gang jobs are rigid: every gang places whole-or-"
+                "not-at-all, so min_hosts must equal the total "
+                f"({total}); only single-gang jobs shrink elastically")
+        ledger_lib.load_config(self.root)  # init_cluster must have run
+        job_id = ledger_lib.next_job_id(self.root)
+        spec = {"name": name or job_id, "tenant": tenant,
+                "priority": int(priority), "gangs": gangs,
+                "min_hosts": min_hosts, "cmd": list(cmd), "kind": kind,
+                "env": dict(env or {}),
+                "workdir": ledger_lib.job_workdir(self.root, job_id)}
+        rec = ledger_lib.append(self.root, "submit", job_id,
+                                ts=self._clock(), spec=spec)
+        state = ledger_lib.ClusterState(self.root, [], {})
+        state.apply(rec)
+        self._emit("submit", state.jobs[job_id], gangs=gangs,
+                   min_hosts=min_hosts)
+        logger.info("submitted %s: tenant=%s priority=%d gangs=%s",
+                    job_id, tenant, priority, gangs)
+        return job_id
+
+    def cancel(self, job_id: str) -> None:
+        state = ledger_lib.load_state(self.root)
+        job = state.jobs[job_id]
+        if job.status == "RUNNING":
+            self._stop_runner(job)
+        if job.status not in ledger_lib.TERMINAL_STATUSES:
+            ledger_lib.append(self.root, "cancel", job_id, ts=self._clock())
+            self._emit("cancel", job)
+
+    # -- reconciliation -------------------------------------------------------
+
+    def _runner_alive(self, job: ledger_lib.Job) -> bool:
+        if job.pid is None:
+            return False
+        proc = self._procs.get(job.job_id)
+        if proc is not None and proc.pid == job.pid:
+            return proc.poll() is None
+        try:
+            os.kill(job.pid, 0)
+            return True
+        except OSError:
+            return False
+
+    def _stop_runner(self, job: ledger_lib.Job,
+                     *, grace_s: float = 5.0) -> None:
+        """SIGTERM the runner's whole process group (runner + supervisor
+        + gang — the runner is a session leader), escalate to SIGKILL.
+        Zero orphans is the contract the CI drill asserts."""
+        if job.pid is None:
+            return
+        for sig in (signal.SIGTERM, signal.SIGKILL):
+            try:
+                os.killpg(job.pid, sig)
+            except OSError:
+                break  # group already gone
+            deadline = time.time() + grace_s
+            while time.time() < deadline:
+                if not self._runner_alive(job):
+                    break
+                time.sleep(0.05)
+            if not self._runner_alive(job):
+                break
+        proc = self._procs.pop(job.job_id, None)
+        if proc is not None:
+            try:
+                proc.wait(timeout=grace_s)
+            except Exception:  # noqa: BLE001 — reaping is best-effort
+                pass
+
+    def _health_of(self, job: ledger_lib.Job) -> dict | None:
+        """Evaluate (and rewrite) the job workdir's ``health.json`` —
+        the scheduler doubles as the fleet's health daemon, and its
+        requeue decisions read the same machine contract operators do."""
+        if not job.workdir or not os.path.isdir(
+                telemetry_lib.telemetry_dir(job.workdir)):
+            return None
+        from distributeddeeplearningspark_tpu.telemetry import health
+
+        eng = self._engines.get(job.workdir)
+        if eng is None:
+            # write_alerts=False: the scheduler inspects the job's
+            # stream, it must not append alert edges to it
+            eng = self._engines[job.workdir] = health.HealthEngine(
+                job.workdir, damping=1, write_alerts=False)
+        try:
+            return eng.evaluate()
+        except Exception:  # noqa: BLE001 — health is advisory
+            logger.debug("health evaluation failed for %s", job.workdir,
+                         exc_info=True)
+            return None
+
+    def _observed_drain(self, job: ledger_lib.Job) -> str | None:
+        """The host slot a delivered shrink notice has finished freeing
+        (the victim's own stream carries the ``geometry_change``), or
+        None while the drain is still in flight."""
+        if job.draining is None or not job.workdir:
+            return None
+        since = job.draining_since or 0.0
+        for e in telemetry_lib.read_events(job.workdir):
+            if (e.get("kind") == "recovery"
+                    and e.get("event") == "geometry_change"
+                    and e.get("dead_host") == job.draining
+                    and e.get("resume") == "live-handoff"
+                    and float(e.get("ts", 0.0)) >= since):
+                return job.assignment.get(job.draining)
+        return None
+
+    def _reconcile(self, state: ledger_lib.ClusterState) -> dict:
+        """Absorb reality into the ledger: completed drains free their
+        hosts, dead runners and wedged jobs requeue."""
+        out = {"shrunk": [], "requeued": []}
+        wedge_s = _env_int(WEDGE_ENV, 300)
+        for job in list(state.running()):
+            freed = self._observed_drain(job)
+            if freed is not None:
+                rec = ledger_lib.append(
+                    self.root, "shrink", job.job_id, ts=self._clock(),
+                    ordinal=job.draining, host=freed)
+                self._emit("shrink", job, mirror=True,
+                           ordinal=job.draining, host=freed)
+                state.apply(rec)
+                out["shrunk"].append(job.job_id)
+            if not self._runner_alive(job):
+                # the runner appends complete/fail itself; a RUNNING job
+                # with a dead runner died without a verdict — requeue it
+                # (its checkpoint survives; placement is elastic)
+                self._requeue_or_fail(state, out, job, "runner-died")
+                continue
+            rep = self._health_of(job)
+            hb_age = rep.get("last_heartbeat_age_s") if rep else None
+            if (rep is not None and rep.get("worst_severity") == "CRIT"
+                    and hb_age is not None and hb_age > wedge_s):
+                self._stop_runner(job)
+                self._requeue_or_fail(state, out, job, "wedged",
+                                      heartbeat_age_s=round(float(hb_age), 1))
+        return out
+
+    def _requeue_or_fail(self, state: ledger_lib.ClusterState, out: dict,
+                         job: ledger_lib.Job, reason: str, **fields) -> None:
+        """Requeue the job for replacement, or — past the requeue budget —
+        declare it FAILED so a crash-looping runner cannot hold the queue
+        hostage."""
+        if job.requeues >= _env_int(MAX_REQUEUES_ENV, 5):
+            rec = ledger_lib.append(
+                self.root, "fail", job.job_id, ts=self._clock(), rc=None,
+                classification=f"requeue-limit:{reason}")
+            self._emit("fail", job, mirror=True,
+                       classification=f"requeue-limit:{reason}", **fields)
+        else:
+            rec = ledger_lib.append(self.root, "requeue", job.job_id,
+                                    ts=self._clock(), reason=reason, **fields)
+            self._emit("requeue", job, mirror=True, reason=reason, **fields)
+            out["requeued"].append(job.job_id)
+        state.apply(rec)
+
+    # -- acting on the plan ---------------------------------------------------
+
+    def _last_step(self, job: ledger_lib.Job) -> int:
+        last = 0
+        if job.workdir:
+            for e in telemetry_lib.read_events(job.workdir):
+                s = e.get("step")
+                if (e.get("kind") in ("step_metrics", "heartbeat")
+                        and isinstance(s, (int, float))):
+                    last = max(last, int(s))
+        return last
+
+    def _deliver_shrink(self, state: ledger_lib.ClusterState,
+                        p: Preemption) -> None:
+        victim = state.jobs[p.victim]
+        floor = self._last_step(victim) + _env_int(DRAIN_MARGIN_ENV, 2)
+        faults.deliver_preempt_notice(
+            notice_path(victim.workdir), host=p.ordinal, step=floor)
+        rec = ledger_lib.append(
+            self.root, "preempt", p.victim, ts=self._clock(), mode="shrink",
+            ordinal=p.ordinal, victim_of=p.for_job, step=floor)
+        self._emit("preempt", victim, mirror=True, mode="shrink",
+                   ordinal=p.ordinal, victim_of=p.for_job, step=floor)
+        state.apply(rec)
+        logger.warning("preempting %s (shrink ordinal %d) for %s",
+                       p.victim, p.ordinal, p.for_job)
+
+    def _evict(self, state: ledger_lib.ClusterState, p: Preemption) -> None:
+        victim = state.jobs[p.victim]
+        self._stop_runner(victim)
+        for edge, fields in (("preempt", {"mode": "evict",
+                                          "victim_of": p.for_job}),
+                             ("requeue", {"reason":
+                                          f"evicted-for-{p.for_job}"})):
+            rec = ledger_lib.append(self.root, edge, p.victim,
+                                    ts=self._clock(), **fields)
+            self._emit(edge, victim, mirror=True, **fields)
+            state.apply(rec)
+        logger.warning("preempting %s (evict) for %s", p.victim, p.for_job)
+
+    def _launch(self, state: ledger_lib.ClusterState,
+                pl: Placement) -> None:
+        job = state.jobs[pl.job_id]
+        os.makedirs(job.workdir, exist_ok=True)
+        log_path = os.path.join(job.workdir, "runner.log")
+        # the detached runner must resolve this package regardless of the
+        # scheduler's cwd / install mode
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (pkg_parent + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else pkg_parent)
+        with open(log_path, "ab") as log:
+            proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "distributeddeeplearningspark_tpu.scheduler.runner",
+                 "--root", self.root, "--job", job.job_id],
+                stdout=log, stderr=subprocess.STDOUT,
+                env=env, start_new_session=True)
+        self._procs[job.job_id] = proc
+        rec = ledger_lib.append(self.root, "launch", job.job_id,
+                                ts=self._clock(), pid=proc.pid,
+                                workdir=job.workdir)
+        self._emit("launch", job, pid=proc.pid)
+        state.apply(rec)
+        logger.info("launched %s (pid %d) on %s", job.job_id, proc.pid,
+                    job.held_hosts)
+
+    def tick(self, *, launch: bool = True) -> dict:
+        """One reconcile + plan + act pass. ``launch=False`` records
+        placements in the ledger without spawning runners (planning /
+        test mode). Returns a summary of everything this tick did."""
+        state = ledger_lib.load_state(self.root)
+        summary = self._reconcile(state)
+        actions = plan(state)
+        for p in actions["preempt"]:
+            if p.mode == "shrink":
+                self._deliver_shrink(state, p)
+            else:
+                self._evict(state, p)
+        placed, launched = [], []
+        for pl in actions["place"]:
+            job = state.jobs[pl.job_id]
+            rec = ledger_lib.append(
+                self.root, "place", pl.job_id, ts=self._clock(),
+                assignment=sorted([o, h] for o, h in pl.assignment.items()))
+            state.apply(rec)
+            self._emit("place", state.jobs[pl.job_id], mirror=True,
+                       assignment=sorted(
+                           [o, h] for o, h in pl.assignment.items()))
+            placed.append(pl.job_id)
+            if launch:
+                self._launch(state, pl)
+                launched.append(pl.job_id)
+        summary.update({
+            "placed": placed, "launched": launched,
+            "preempted": [(p.victim, p.mode) for p in actions["preempt"]],
+            "blocked": actions["blocked"],
+            "free_hosts": state.free_hosts(),
+        })
+        return summary
+
+    def run(self, *, interval: float = 2.0, max_ticks: int | None = None,
+            until_idle: bool = False) -> int:
+        """The daemon loop: tick forever (or ``max_ticks``), or with
+        ``until_idle`` until every submitted job is terminal. Returns the
+        number of ticks run."""
+        ticks = 0
+        while True:
+            self.tick()
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                return ticks
+            if until_idle:
+                state = ledger_lib.load_state(self.root)
+                if state.jobs and all(
+                        j.status in ledger_lib.TERMINAL_STATUSES
+                        for j in state.jobs.values()):
+                    return ticks
+            time.sleep(max(0.05, interval))

@@ -1,0 +1,359 @@
+"""Durable scheduler state: the JSONL job ledger + host/quota inventory.
+
+The cluster state dir (``<root>/sched``) holds exactly two artifacts:
+
+- ``cluster.json`` — the host inventory and per-tenant quotas, rewritten
+  atomically (tmp + rename, the ``health.json`` discipline). Hosts are
+  named slots (``h0``..``hN-1`` when initialized from a count); one slot
+  is one gang member, the 1-process-per-host model everywhere else in
+  the codebase.
+- ``ledger.jsonl`` — the append-only job lifecycle ledger, one JSON
+  object per line (``ts`` + ``edge`` + ``job`` always present). Current
+  cluster state is a PURE FOLD over the ledger (:func:`load_state`): a
+  scheduler that crashes mid-tick loses nothing, and a torn final line
+  (SIGKILL mid-append) is skipped by the reader like any telemetry
+  stream's.
+
+Ledger edges::
+
+    submit   {job, spec: {name, tenant, priority, gangs, min_hosts,
+              cmd, env, kind}}           -> PENDING
+    place    {job, assignment: [[ordinal, host], ...]}  -> PLACED
+    launch   {job, pid, workdir}                        -> RUNNING
+    preempt  {job, mode: shrink|evict, ordinal?, victim_of} (shrink: the
+              notice is delivered; the job keeps RUNNING with
+              ``draining`` set until the drain is observed)
+    shrink   {job, ordinal, host}        -> host freed, drain retired
+    requeue  {job, reason}               -> PENDING again (hosts freed)
+    complete {job, rc}                   -> COMPLETED
+    fail     {job, rc, classification?}  -> FAILED
+    cancel   {job}                       -> CANCELLED
+
+Per-tenant accounting (``used`` hosts vs quota) is derived from the same
+fold — the tie-out target the cluster view asserts against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+SCHEMA = 1
+
+#: subdir of the cluster root holding the scheduler's own state
+SCHED_DIRNAME = "sched"
+LEDGER_NAME = "ledger.jsonl"
+CONFIG_NAME = "cluster.json"
+#: subdir of the cluster root the scheduler creates job workdirs under
+JOBS_DIRNAME = "jobs"
+
+EDGES = ("submit", "place", "launch", "preempt", "shrink", "requeue",
+         "complete", "fail", "cancel")
+
+#: statuses that hold hosts
+ACTIVE_STATUSES = ("PLACED", "RUNNING")
+TERMINAL_STATUSES = ("COMPLETED", "FAILED", "CANCELLED")
+
+
+def sched_dir(root: str | os.PathLike) -> str:
+    return os.path.join(os.fspath(root), SCHED_DIRNAME)
+
+
+def ledger_path(root: str | os.PathLike) -> str:
+    return os.path.join(sched_dir(root), LEDGER_NAME)
+
+
+def config_path(root: str | os.PathLike) -> str:
+    return os.path.join(sched_dir(root), CONFIG_NAME)
+
+
+def job_workdir(root: str | os.PathLike, job_id: str) -> str:
+    """Where a job's run lives: telemetry, checkpoints, health.json — the
+    workdir ``dlstatus --cluster <root>`` discovers."""
+    return os.path.join(os.fspath(root), JOBS_DIRNAME, job_id)
+
+
+def init_cluster(root: str | os.PathLike, *,
+                 hosts: int | list[str],
+                 quotas: dict[str, int] | None = None) -> dict:
+    """Create (or rewrite) the cluster inventory. ``hosts`` is a count
+    (named ``h0..hN-1``) or an explicit slot-name list; ``quotas`` maps
+    tenant -> max concurrently-held hosts (absent tenant = unlimited)."""
+    if isinstance(hosts, int):
+        if hosts < 1:
+            raise ValueError(f"a cluster needs >= 1 host, got {hosts}")
+        hosts = [f"h{i}" for i in range(hosts)]
+    if len(set(hosts)) != len(hosts):
+        raise ValueError(f"duplicate host names in {hosts}")
+    cfg = {"schema": SCHEMA, "hosts": list(hosts),
+           "quotas": {str(t): int(q) for t, q in (quotas or {}).items()}}
+    os.makedirs(sched_dir(root), exist_ok=True)
+    path = config_path(root)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(cfg, f, indent=1)
+    os.replace(tmp, path)
+    return cfg
+
+
+def load_config(root: str | os.PathLike) -> dict:
+    with open(config_path(root)) as f:
+        cfg = json.load(f)
+    if cfg.get("schema") != SCHEMA:
+        raise ValueError(
+            f"cluster.json schema {cfg.get('schema')!r} != {SCHEMA} "
+            f"(re-run init_cluster on {os.fspath(root)})")
+    return cfg
+
+
+def append(root: str | os.PathLike, edge: str, job: str,
+           *, ts: float | None = None, **fields) -> dict:
+    """Append one ledger record (atomic at line granularity: one write of
+    one newline-terminated line on an O_APPEND fd — readers see whole
+    records or nothing)."""
+    if edge not in EDGES:
+        raise ValueError(f"bad ledger edge {edge!r}: expected one of {EDGES}")
+    rec = {"ts": float(ts) if ts is not None else time.time(),
+           "edge": edge, "job": job, **fields}
+    os.makedirs(sched_dir(root), exist_ok=True)
+    line = json.dumps(rec, sort_keys=True) + "\n"
+    fd = os.open(ledger_path(root), os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                 0o644)
+    try:
+        os.write(fd, line.encode("utf-8"))
+    finally:
+        os.close(fd)
+    return rec
+
+
+def read_ledger(root: str | os.PathLike) -> list[dict]:
+    """Every parseable ledger record, in append order. A torn final line
+    (writer SIGKILLed mid-append) is skipped, same as the telemetry
+    readers — the fold works on a crashed scheduler's ledger as-is."""
+    path = ledger_path(root)
+    records: list[dict] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "ts" in rec and "edge" in rec:
+                    records.append(rec)
+    except OSError:
+        pass
+    return records
+
+
+@dataclasses.dataclass
+class Job:
+    """One job's folded state."""
+
+    job_id: str
+    name: str
+    tenant: str
+    priority: int
+    #: host count per gang — every gang places whole-or-not-at-all and a
+    #: job only places when ALL its gangs do
+    gangs: tuple[int, ...]
+    #: elastic floor: preemption may shrink the job down to this many
+    #: hosts (total); at the floor only eviction can reclaim its hosts
+    min_hosts: int
+    cmd: tuple[str, ...]
+    env: dict[str, str]
+    kind: str = "train"
+    submitted_ts: float = 0.0
+    status: str = "PENDING"
+    #: gang ordinal -> host slot, for every host the job currently holds
+    assignment: dict[int, str] = dataclasses.field(default_factory=dict)
+    workdir: str | None = None
+    pid: int | None = None
+    started_ts: float | None = None
+    finished_ts: float | None = None
+    rc: int | None = None
+    #: ordinal a delivered shrink notice is draining (None = not draining)
+    draining: int | None = None
+    #: ledger ts of the delivered notice — the reconcile loop only trusts
+    #: geometry changes AT OR AFTER it (a requeued job's earlier life may
+    #: have drained the same ordinal; its old events must not free hosts)
+    draining_since: float | None = None
+    requeues: int = 0
+    reason: str | None = None
+
+    @property
+    def total_hosts(self) -> int:
+        return sum(self.gangs)
+
+    @property
+    def held_hosts(self) -> list[str]:
+        return [self.assignment[o] for o in sorted(self.assignment)]
+
+
+@dataclasses.dataclass
+class ClusterState:
+    """The fold of ``cluster.json`` + the ledger: what the planner packs
+    against and what the cluster view renders."""
+
+    root: str
+    hosts: list[str]
+    quotas: dict[str, int]
+    jobs: dict[str, Job] = dataclasses.field(default_factory=dict)
+
+    def free_hosts(self) -> list[str]:
+        held = {h for j in self.jobs.values() for h in j.assignment.values()}
+        return [h for h in self.hosts if h not in held]
+
+    def used_by_tenant(self) -> dict[str, int]:
+        """Hosts currently held, per tenant — the ledger-side accounting
+        the cluster_report rollup must tie out against."""
+        used: dict[str, int] = {}
+        for j in self.jobs.values():
+            if j.assignment:
+                used[j.tenant] = used.get(j.tenant, 0) + len(j.assignment)
+        return used
+
+    def quota_of(self, tenant: str) -> int | None:
+        return self.quotas.get(tenant)
+
+    def pending(self) -> list[Job]:
+        """The queue, scheduling order: priority desc, then FIFO."""
+        return sorted(
+            (j for j in self.jobs.values() if j.status == "PENDING"),
+            key=lambda j: (-j.priority, j.submitted_ts, j.job_id))
+
+    def running(self) -> list[Job]:
+        return [j for j in self.jobs.values() if j.status == "RUNNING"]
+
+    def apply(self, rec: dict) -> None:
+        """Fold ONE ledger record into the state (load_state = apply over
+        the whole ledger; the live scheduler applies each record it
+        appends so its in-memory view never diverges from disk)."""
+        edge, jid = rec.get("edge"), rec.get("job")
+        if edge == "submit":
+            spec = rec.get("spec") or {}
+            self.jobs[jid] = Job(
+                job_id=jid,
+                name=str(spec.get("name") or jid),
+                tenant=str(spec.get("tenant") or "default"),
+                priority=int(spec.get("priority") or 0),
+                gangs=tuple(int(g) for g in (spec.get("gangs") or (1,))),
+                min_hosts=int(spec.get("min_hosts")
+                              or sum(spec.get("gangs") or (1,))),
+                cmd=tuple(spec.get("cmd") or ()),
+                env={str(k): str(v)
+                     for k, v in (spec.get("env") or {}).items()},
+                kind=str(spec.get("kind") or "train"),
+                submitted_ts=float(rec.get("ts", 0.0)),
+                workdir=spec.get("workdir") or job_workdir(self.root, jid),
+            )
+            return
+        job = self.jobs.get(jid)
+        if job is None:
+            return  # an edge for a job whose submit line was torn away
+        if edge == "place":
+            job.assignment = {int(o): str(h)
+                              for o, h in (rec.get("assignment") or [])}
+            job.status = "PLACED"
+            job.reason = None
+        elif edge == "launch":
+            job.status = "RUNNING"
+            job.pid = rec.get("pid")
+            job.started_ts = float(rec.get("ts", 0.0))
+            if rec.get("workdir"):
+                job.workdir = rec["workdir"]
+        elif edge == "preempt":
+            if rec.get("mode") == "shrink":
+                job.draining = int(rec["ordinal"])
+                job.draining_since = float(rec.get("ts", 0.0))
+            # evict is always followed by its own requeue edge
+        elif edge == "shrink":
+            job.assignment.pop(int(rec["ordinal"]), None)
+            job.draining = None
+            job.draining_since = None
+        elif edge == "requeue":
+            if job.status in TERMINAL_STATUSES:
+                # lost race: the runner's own complete/fail landed between
+                # the scheduler's state fold and its liveness check — the
+                # verdict wins, the spurious requeue is a no-op
+                return
+            job.status = "PENDING"
+            job.assignment = {}
+            job.pid = None
+            job.draining = None
+            job.draining_since = None
+            job.requeues += 1
+            job.reason = rec.get("reason")
+        elif edge in ("complete", "fail", "cancel"):
+            job.status = {"complete": "COMPLETED", "fail": "FAILED",
+                          "cancel": "CANCELLED"}[edge]
+            job.assignment = {}
+            job.pid = None
+            job.draining = None
+            job.draining_since = None
+            job.finished_ts = float(rec.get("ts", 0.0))
+            job.rc = rec.get("rc")
+
+    def _pending_reason(self, j: "Job", used: dict[str, int]) -> str | None:
+        """Annotate a PENDING row with the quota gate when it applies —
+        the same pure check ``plan`` runs, so the queue view explains why
+        a job is waiting without re-running the planner."""
+        if j.status == "PENDING":
+            quota = self.quotas.get(j.tenant)
+            if quota is not None and used.get(j.tenant, 0) + j.min_hosts > quota:
+                return "quota"
+        return j.reason
+
+    def to_report(self) -> dict:
+        """The JSON-safe block ``cluster_report`` embeds as ``sched`` —
+        queue + accounting, pinned shape for ``dlstatus --cluster
+        --json`` consumers."""
+        used = self.used_by_tenant()
+        tenants = sorted(set(self.quotas) | set(used)
+                         | {j.tenant for j in self.jobs.values()})
+        return {
+            "root": self.root,
+            "hosts": {"total": len(self.hosts),
+                      "free": len(self.free_hosts())},
+            "tenants": {t: {"used": used.get(t, 0),
+                            "quota": self.quotas.get(t)} for t in tenants},
+            "jobs": [{
+                "job": j.job_id, "name": j.name, "tenant": j.tenant,
+                "priority": j.priority, "kind": j.kind,
+                "status": j.status, "gangs": list(j.gangs),
+                "hosts": j.held_hosts, "min_hosts": j.min_hosts,
+                "draining": j.draining, "requeues": j.requeues,
+                "reason": self._pending_reason(j, used),
+                "workdir": j.workdir, "rc": j.rc,
+            } for j in sorted(self.jobs.values(),
+                              key=lambda j: (j.submitted_ts, j.job_id))],
+        }
+
+
+def has_ledger(root: str | os.PathLike) -> bool:
+    """Is ``root`` a cluster state dir? True from ``init_cluster`` on —
+    an initialized-but-empty cluster still renders its inventory."""
+    return (os.path.exists(config_path(root))
+            or os.path.exists(ledger_path(root)))
+
+
+def load_state(root: str | os.PathLike) -> ClusterState:
+    """cluster.json + the full ledger fold. Raises if the cluster was
+    never initialized (a scheduler must not invent an inventory)."""
+    cfg = load_config(root)
+    state = ClusterState(root=os.path.abspath(os.fspath(root)),
+                         hosts=list(cfg["hosts"]),
+                         quotas={str(t): int(q)
+                                 for t, q in (cfg.get("quotas") or {}).items()})
+    for rec in read_ledger(root):
+        state.apply(rec)
+    return state
+
+
+def next_job_id(root: str | os.PathLike) -> str:
+    """Deterministic from the ledger: one id per submit edge ever
+    appended (terminal jobs keep their ids — the ledger is history)."""
+    n = sum(r.get("edge") == "submit" for r in read_ledger(root))
+    return f"j{n:03d}"

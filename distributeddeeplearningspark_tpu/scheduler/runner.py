@@ -1,0 +1,149 @@
+"""Job runner: one placed ledger job, launched through the supervisor.
+
+The scheduler spawns ``python -m ...scheduler.runner --root R --job J``
+as a detached session leader; the runner builds the job's env from the
+``DLS_*`` contract (tenant, priority, telemetry workdir, the preemption-
+notice path) and runs one :class:`~..supervisor.Supervisor` per gang —
+so a scheduler-launched job gets the WHOLE elastic machinery for free:
+restart classification, backoff, shrink-to-survive, graceful-drain
+handling, and the merged telemetry stream ``dlstatus`` reads.
+
+The runner's last act is the job's verdict: a ``complete`` or ``fail``
+ledger edge. A runner that dies without one (SIGKILL, node loss) is what
+the scheduler's reconcile loop detects and requeues.
+
+Command/env templating: ``{workdir}``, ``{ckpt}`` and ``{root}`` in a
+submitted command or env value expand at launch — a submitter does not
+know the job's run directory (it is derived from the ledger id), so the
+template is how a training script finds its own checkpoint root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+from distributeddeeplearningspark_tpu import telemetry as telemetry_lib
+from distributeddeeplearningspark_tpu.scheduler import core as core_lib
+from distributeddeeplearningspark_tpu.scheduler import ledger as ledger_lib
+
+
+def _expand(value: str, job: "ledger_lib.Job", root: str) -> str:
+    return (value
+            .replace("{workdir}", job.workdir)
+            .replace("{ckpt}", os.path.join(job.workdir,
+                                            core_lib.CKPT_DIRNAME))
+            .replace("{root}", root))
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def run_job(root: str, job_id: str) -> int:
+    from distributeddeeplearningspark_tpu.supervisor import Supervisor
+
+    state = ledger_lib.load_state(root)
+    job = state.jobs.get(job_id)
+    if job is None:
+        print(f"runner: no such job {job_id} in {root}", file=sys.stderr)
+        return 2
+    if not job.assignment:
+        print(f"runner: {job_id} holds no hosts (status {job.status})",
+              file=sys.stderr)
+        return 2
+    os.makedirs(job.workdir, exist_ok=True)
+    ckpt_dir = os.path.join(job.workdir, core_lib.CKPT_DIRNAME)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    cmd = [_expand(c, job, root) for c in job.cmd]
+    env = {
+        telemetry_lib.TENANT_ENV: job.tenant,
+        telemetry_lib.PRIORITY_ENV: str(job.priority),
+        # the runtime preemption channel: the trainer polls this path at
+        # step boundaries, the scheduler writes it, the supervisor
+        # retires it once the drain is acted on
+        "DLS_PREEMPT_NOTICE": core_lib.notice_path(job.workdir),
+        **{k: _expand(v, job, root) for k, v in job.env.items()},
+    }
+    ordinals = sorted(job.assignment)
+    width = len(ordinals)
+
+    def build(num: int, min_procs: int) -> Supervisor:
+        return Supervisor(
+            cmd, num_processes=num,
+            max_restarts=int(_env_float("DLS_SCHED_MAX_RESTARTS", 4)),
+            restart_backoff_s=_env_float("DLS_SCHED_BACKOFF_S", 0.25),
+            backoff_jitter=0.0,
+            shrink_after=2, min_processes=min_procs,
+            env=env, progress_path=ckpt_dir, ckpt_dir=ckpt_dir,
+            telemetry_dir=job.workdir)
+
+    if len(job.gangs) == 1:
+        # elastic single gang: width is whatever placement granted (a
+        # requeued job resuming on fewer hosts restores through
+        # reshard-on-restore), floor is the job's declared minimum
+        results = [build(width, max(1, min(job.min_hosts, width))).run()]
+    else:
+        # MPMD-shaped: one supervisor per gang, run concurrently; gangs
+        # are rigid (placement guaranteed all-or-nothing)
+        sups = [build(g, g) for g in job.gangs]
+        results = [None] * len(sups)
+
+        def drive(i: int) -> None:
+            results[i] = sups[i].run()
+
+        threads = [threading.Thread(target=drive, args=(i,), daemon=True)
+                   for i in range(len(sups))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    ok = all(r is not None and r.ok for r in results)
+    if ok:
+        ledger_lib.append(root, "complete", job_id, rc=0)
+        _emit_verdict(root, job, "complete", rc=0)
+        return 0
+    classification = None
+    for r in results:
+        if r is not None and r.attempts and not r.ok:
+            classification = r.attempts[-1].classification
+            break
+    ledger_lib.append(root, "fail", job_id, rc=1,
+                      classification=classification)
+    _emit_verdict(root, job, "fail", rc=1, classification=classification)
+    return 1
+
+
+def _emit_verdict(root: str, job: "ledger_lib.Job", edge: str,
+                  **fields) -> None:
+    """The job's terminal ``sched`` event, in both the scheduler's stream
+    and the job's own (so each timeline is complete on its own)."""
+    for wd, process in ((ledger_lib.sched_dir(root), f"run-{job.job_id}"),
+                        (job.workdir, "runner")):
+        w = telemetry_lib.EventWriter(wd, process=process, host=None,
+                                      tenant=job.tenant,
+                                      priority=job.priority)
+        try:
+            w.emit("sched", edge=edge, job=job.job_id, tenant=job.tenant,
+                   priority=job.priority, **fields)
+        finally:
+            w.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributeddeeplearningspark_tpu.scheduler.runner",
+        description="Run one placed scheduler job under supervision.")
+    ap.add_argument("--root", required=True, help="cluster state dir")
+    ap.add_argument("--job", required=True, help="ledger job id")
+    args = ap.parse_args(argv)
+    return run_job(os.path.abspath(args.root), args.job)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -739,18 +739,74 @@ def render_cluster(c: dict) -> str:
             f"{_fmt_s(r['last_heartbeat_age_s']):>7}  "
             f"{r['last_step'] if r['last_step'] is not None else '-':>7}  "
             f"{worst}")
+    sched = c.get("sched")
     if c["tenants"]:
         lines.append("  per-tenant rollup:")
+        sched_tenants = (sched or {}).get("tenants") or {}
         for t, agg in sorted(c["tenants"].items()):
             good = (f"{agg['goodput_frac']:.3f}"
                     if agg.get("goodput_frac") is not None else "-")
+            quota = ""
+            if t in sched_tenants:
+                st = sched_tenants[t]
+                cap = st["quota"] if st.get("quota") is not None else "∞"
+                quota = f"  hosts={st['used']}/{cap}"
             lines.append(
                 f"    {t:<14} workdirs={agg['workdirs']} "
                 f"(train {agg['train_workdirs']}, serve "
                 f"{agg['serve_workdirs']})  goodput={good}  "
                 f"requests={agg['requests']} shed={agg['shed']}  "
-                f"worst={agg['worst_severity']}")
+                f"worst={agg['worst_severity']}{quota}")
+    if sched:
+        lines.extend(render_sched(sched))
     return "\n".join(lines)
+
+
+def render_sched(sched: dict) -> list[str]:
+    """The scheduler section of ``--cluster``: queue + inventory from the
+    ledger fold (``cluster_report``'s ``sched`` block)."""
+    if sched.get("error"):
+        return [f"  scheduler: (unreadable: {sched['error']})"]
+    hosts = sched.get("hosts") or {}
+    lines = [f"  scheduler: hosts {hosts.get('free', '?')}/"
+             f"{hosts.get('total', '?')} free"]
+    tenants = sched.get("tenants") or {}
+    if tenants:
+        used = ", ".join(
+            f"{t}={row['used']}/{row['quota'] if row.get('quota') is not None else '∞'}"
+            for t, row in sorted(tenants.items()))
+        lines.append(f"    quota (used/limit): {used}")
+    jobs = sched.get("jobs") or []
+    live = [j for j in jobs if j["status"] not in
+            ("COMPLETED", "FAILED", "CANCELLED")]
+    done = len(jobs) - len(live)
+    if not jobs:
+        lines.append("    (no jobs submitted)")
+        return lines
+    lines.append(
+        f"    {'job':<6} {'name':<18} {'tenant':<12} {'pri':>4} "
+        f"{'status':<8} {'hosts':<14} {'min':>4}  note")
+    for j in live:
+        name = j["name"] or "-"
+        if len(name) > 18:
+            name = name[:17] + "…"
+        held = ",".join(j["hosts"]) if j["hosts"] else "-"
+        if len(held) > 14:
+            held = held[:13] + "…"
+        note_bits = []
+        if j.get("draining") is not None:
+            note_bits.append(f"draining g{j['draining']}")
+        if j.get("requeues"):
+            note_bits.append(f"requeues={j['requeues']}")
+        if j.get("reason"):
+            note_bits.append(str(j["reason"]))
+        lines.append(
+            f"    {j['job']:<6} {name:<18} {j['tenant']:<12} "
+            f"{j['priority']:>4} {j['status']:<8} {held:<14} "
+            f"{j['min_hosts']:>4}  {' '.join(note_bits) or '-'}")
+    if done:
+        lines.append(f"    (+{done} terminal job(s))")
+    return lines
 
 
 def render(rep: dict) -> str:

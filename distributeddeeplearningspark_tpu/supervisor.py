@@ -34,6 +34,7 @@ import subprocess
 import sys
 import time
 
+from distributeddeeplearningspark_tpu import faults
 from distributeddeeplearningspark_tpu import telemetry as telemetry_lib
 
 logger = logging.getLogger("distributeddeeplearningspark_tpu.supervisor")
@@ -647,6 +648,15 @@ class Supervisor:
                                         else (attempt.dead_host, None))
                     if self.ckpt_dir:
                         consume_drain_evidence(self.ckpt_dir, ordinal=ordinal)
+                    # a scheduler-delivered runtime notice is retired the
+                    # same way: the shrunk relaunch must not re-drain on
+                    # the stale file (the .consumed-<ordinal> rename keeps
+                    # it beside the stream for forensics)
+                    faults.consume_preempt_notice(
+                        self.env.get(
+                            faults.PREEMPT_NOTICE_ENV,
+                            os.environ.get(faults.PREEMPT_NOTICE_ENV)),
+                        ordinal=ordinal)
                     tele = self._telemetry()
                     if tele is not None:
                         tele.recovery(

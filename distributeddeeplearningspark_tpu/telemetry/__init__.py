@@ -120,6 +120,16 @@ present):
   — the flap-damping receipt). Alert edges + ``recovery`` events are
   the incident timeline ``dlstatus --incidents`` renders; the Chrome
   exporter draws them as instant events on an ``alerts`` row.
+- ``sched`` — one cluster-scheduler lifecycle edge (:mod:`..scheduler`):
+  ``edge`` ("submit"/"place"/"launch"/"preempt"/"shrink"/"requeue"/
+  "complete"/"fail"/"cancel"), ``job`` (the ledger job id), ``tenant``/
+  ``priority``, and per-edge evidence (``assignment`` host map on place,
+  ``mode``/``victim_of``/``ordinal`` on preempt, ``reason`` on requeue,
+  ``rc`` on complete/fail). The scheduler writes its own stream under
+  ``<root>/sched`` and mirrors the edges that concern a job (place,
+  preempt, requeue) into that job's workdir stream — so ``dlstatus
+  <workdir> --incidents`` folds them into the job's timeline and the
+  Chrome exporter draws them beside alert edges on the ``alerts`` row.
 
 Worker-side events additionally carry ``host`` (the process index from the
 ``DLS_*`` env contract via :func:`~..utils.env.process_identity`, plus
@@ -167,6 +177,25 @@ MAX_MB_ENV = "DLS_TELEMETRY_MAX_MB"
 #: explicit per-record ``tenant`` field (router tenant sheds, per-client
 #: serving tenants) always wins over the env-level stamp.
 TENANT_ENV = "DLS_TENANT"
+
+#: Env var naming the run's scheduling priority (an integer; higher wins).
+#: ``dlsubmit --priority`` exports it and the scheduler stamps it on every
+#: job it launches; like the tenant stamp, every writer then carries
+#: ``priority`` on its records so cluster views can attribute preemption
+#: decisions without joining back to the ledger. An explicit per-record
+#: ``priority`` always wins over the env-level stamp.
+PRIORITY_ENV = "DLS_PRIORITY"
+
+
+def _priority_from_env() -> int | None:
+    raw = os.environ.get(PRIORITY_ENV)
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r", PRIORITY_ENV, raw)
+        return None
 
 
 def _max_bytes_from_env() -> int | None:
@@ -236,11 +265,13 @@ class EventWriter:
     def __init__(self, workdir: str | os.PathLike, *, process: str | None = None,
                  clock=time.time, host: int | None | object = _HOST_FROM_ENV,
                  hosts: int | None = None, max_mb: float | None = None,
-                 tenant: str | None = None):
+                 tenant: str | None = None, priority: int | None = None):
         self.workdir = os.path.abspath(os.fspath(workdir))
         self.process = process or _default_process()
         self.tenant = tenant if tenant is not None else (
             os.environ.get(TENANT_ENV) or None)
+        self.priority = (priority if priority is not None
+                         else _priority_from_env())
         # size-capped segment rotation (long-lived serving fleets must not
         # grow one unbounded file per process): segment 0 is the classic
         # ``events-<process>.jsonl``, later ones ``events-<process>.<n>.jsonl``
@@ -294,6 +325,10 @@ class EventWriter:
             # setdefault: a record-level tenant (a router shed naming the
             # tenant it throttled) is evidence; the env stamp is attribution
             rec.setdefault("tenant", self.tenant)
+        if self.priority is not None:
+            # same discipline as the tenant stamp: a record-level priority
+            # (a sched edge describing another job) wins over attribution
+            rec.setdefault("priority", self.priority)
         return rec
 
     def _resume_segment(self) -> None:

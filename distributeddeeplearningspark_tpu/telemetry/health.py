@@ -1030,6 +1030,25 @@ def incident_timeline(events: list[dict]) -> list[dict]:
                     " " + json.dumps(extra, default=str) if extra else ""),
                 "step": e.get("step"), "cleared_from": None,
             })
+        elif kind == "sched":
+            edge = e.get("edge", "?")
+            bits = [e.get("job") or "?"]
+            if e.get("mode"):
+                bits.append(e["mode"])
+            if e.get("victim_of"):
+                bits.append(f"for {e['victim_of']}")
+            if e.get("reason"):
+                bits.append(e["reason"])
+            if e.get("hosts") is not None:
+                bits.append(f"hosts={e['hosts']}")
+            rows.append({
+                "ts": float(ts), "type": f"sched-{edge}",
+                "severity": ("WARN" if edge in ("preempt", "requeue", "fail")
+                             else None),
+                "rule": None, "key": e.get("job"),
+                "who": _who(e), "summary": " ".join(str(b) for b in bits),
+                "step": e.get("step"), "cleared_from": None,
+            })
         elif (kind == "attempt" and e.get("edge") == "end"
               and e.get("classification") not in (None, "clean")):
             rows.append({
@@ -1069,6 +1088,8 @@ def _workdir_kind(events: list[dict]) -> str:
         return "serve"
     if "step_metrics" in kinds or "attempt" in kinds or "phase" in kinds:
         return "train"
+    if "sched" in kinds:
+        return "sched"
     return "events" if events else "empty"
 
 
@@ -1169,6 +1190,21 @@ def cluster_report(root: str | os.PathLike, *,
         "root": os.fspath(root),
         "workdirs": rows,
         "tenants": tenants,
+        "sched": _sched_report(root),
         "worst_severity": worst_severity(
             r["worst_severity"] for r in rows),
     }
+
+
+def _sched_report(root: str | os.PathLike) -> dict | None:
+    """The scheduler's queue + per-tenant used/quota accounting, when
+    ``root`` is (or contains) a cluster state dir. None when no ledger
+    exists — a plain fleet of workdirs renders exactly as before."""
+    from distributeddeeplearningspark_tpu.scheduler import ledger as ledger_lib
+
+    if not ledger_lib.has_ledger(root):
+        return None
+    try:
+        return ledger_lib.load_state(root).to_report()
+    except Exception as e:  # torn config / mid-write races: degrade, not die
+        return {"error": f"{type(e).__name__}: {e}"}

@@ -359,13 +359,18 @@ def chrome_trace(events: Iterable[dict], *,
     # "alerts" row — the raise/clear markers lined up against the spans
     # that explain them
     alerts = [e for e in events if e.get("kind") == "alert"]
+    # scheduler edges (scheduler/core.py) share the alerts row: a
+    # preemption marker lands right where the victim's spans stop
+    sched = [e for e in events if e.get("kind") == "sched"]
     series_buckets = {k: bs for k, bs in (series_buckets or {}).items()
                       if bs}
-    if not all_spans and not mems and not alerts and not series_buckets:
+    if (not all_spans and not mems and not alerts and not sched
+            and not series_buckets):
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     epoch = min([float(s["t0"]) for _, s in all_spans]
                 + [float(e["ts"]) for e in mems]
                 + [float(e["ts"]) for e in alerts]
+                + [float(e["ts"]) for e in sched]
                 + [float(bs[0]["t"]) for bs in series_buckets.values()])
 
     pids: dict[str, int] = {}
@@ -427,6 +432,17 @@ def chrome_trace(events: Iterable[dict], *,
             "ts": (float(e["ts"]) - epoch) * 1e6,
             "args": {k: e[k] for k in ("rule", "key", "severity", "edge",
                                        "summary", "cleared_from", "held")
+                     if e.get(k) is not None}})
+    for e in sched:
+        pid = pid_of(str(e.get("process") or "sched"))
+        trace_events.append({
+            "name": f"sched-{e.get('edge', '?')} {e.get('job', '?')}",
+            "cat": "sched", "ph": "i", "s": "g",
+            "pid": pid, "tid": tid_of(pid, "alerts"),
+            "ts": (float(e["ts"]) - epoch) * 1e6,
+            "args": {k: e[k] for k in ("edge", "job", "tenant", "priority",
+                                       "mode", "victim_of", "reason",
+                                       "hosts", "step")
                      if e.get(k) is not None}})
     for key in sorted(series_buckets):
         pid = pid_of("series")

@@ -487,8 +487,9 @@ class Trainer:
         return stats
 
     def _graceful_drain(self, step: int, *, examples_seen: int,
-                        batch_size: int) -> None:
-        """Honor a preemption notice (``DLS_FAULT=sigterm@N``): the
+                        batch_size: int, doomed: int | None = None) -> None:
+        """Honor a preemption notice (``DLS_FAULT=sigterm@N``, or a
+        scheduler-delivered runtime notice naming ``doomed``): the
         in-flight step is drained, the doomed host's live shards are
         re-gathered onto the survivors-hold-everything layout (every leaf
         replicated) by the bounded engine, the state is committed as a
@@ -506,7 +507,7 @@ class Trainer:
                 "graceful preemption drain needs a checkpointer: its "
                 "directory carries the live handoff the shrunk gang "
                 "resumes from")
-        doomed = faults.fault_host()
+        doomed = faults.fault_host() if doomed is None else doomed
         jax.block_until_ready(self.state.params)  # drain the in-flight step
         targets = jax.tree.map(
             lambda _: NamedSharding(self.mesh, PartitionSpec()),
@@ -733,6 +734,12 @@ class Trainer:
         # consults it (the trainer coordinates the drain no matter which
         # host is doomed — survivors are the ones re-gathering shards)
         preempt = faults.sigterm_fault()
+        # the scheduler's runtime notice channel: a file path in the env
+        # (scheduler-launched jobs only — unset keeps the poll at zero
+        # cost). Polled at step boundaries; the notice's step floor is how
+        # every rank lands on the same drain step despite observing the
+        # file at slightly different wall-clock times.
+        notice_path = faults.preempt_notice_path()
         skipped_dev = None  # device-side cumulative skip count (stays async)
         n_skipped = 0
         rollbacks = 0
@@ -905,7 +912,14 @@ class Trainer:
                     sanitize.assert_replicas_in_sync(self.state.params)
                 for cb in callbacks:
                     cb(step_i, last_metrics)
+                doomed_now: int | None = None
                 if preempt is not None and step_i >= preempt.step:
+                    doomed_now = faults.fault_host()
+                elif notice_path is not None:
+                    notice = faults.read_preempt_notice(notice_path)
+                    if notice is not None and step_i >= notice.step:
+                        doomed_now = notice.host
+                if doomed_now is not None:
                     # preemption notice: drain (the step above completed),
                     # hand off live state, exit BEFORE any further
                     # checkpoint write — the resume point is THIS step
@@ -913,7 +927,7 @@ class Trainer:
                         step_i,
                         examples_seen=(step_i + rolled_back_batches)
                         * batch_size,
-                        batch_size=batch_size)
+                        batch_size=batch_size, doomed=doomed_now)
                     break
                 if checkpoint_every and self.checkpointer and step_i % checkpoint_every == 0:
                     self.checkpointer.save(

@@ -1,0 +1,277 @@
+"""Scheduler surfaces: dlsubmit --cluster/--priority, the preemption-
+notice channel, sched edges in the incident timeline / chrome trace /
+``dlstatus --cluster``, and a real end-to-end launch of a trivial job.
+"""
+
+import json
+import os
+import sys
+
+from distributeddeeplearningspark_tpu import cli, faults, status, telemetry
+from distributeddeeplearningspark_tpu.scheduler import core, ledger
+from distributeddeeplearningspark_tpu.scheduler import __main__ as sched_cli
+from distributeddeeplearningspark_tpu.telemetry import health
+from distributeddeeplearningspark_tpu.telemetry import trace as trace_lib
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+# -- the preemption-notice channel (faults.py) --------------------------------
+
+
+def test_preempt_notice_roundtrip(tmp_path):
+    path = str(tmp_path / "PREEMPT")
+    assert faults.read_preempt_notice(path) is None
+    faults.deliver_preempt_notice(path, host=2, step=17)
+    n = faults.read_preempt_notice(path)
+    assert n == faults.PreemptNotice(host=2, step=17)
+    # consumption retires it (rename, crash-safe) so a relaunch after the
+    # drain does not re-drain on a stale notice
+    faults.consume_preempt_notice(path, ordinal=3)
+    assert faults.read_preempt_notice(path) is None
+    assert os.path.exists(path + ".consumed-3")
+    # consuming a missing/None path is a no-op, never a raise
+    faults.consume_preempt_notice(path, ordinal=4)
+    faults.consume_preempt_notice(None, ordinal=4)
+
+
+def test_preempt_notice_env_lookup(tmp_path, monkeypatch):
+    monkeypatch.delenv(faults.PREEMPT_NOTICE_ENV, raising=False)
+    assert faults.preempt_notice_path() is None
+    path = str(tmp_path / "PREEMPT")
+    monkeypatch.setenv(faults.PREEMPT_NOTICE_ENV, path)
+    assert faults.preempt_notice_path() == path
+    faults.deliver_preempt_notice(path, host=0, step=5)
+    assert faults.read_preempt_notice() == faults.PreemptNotice(0, 5)
+
+
+def test_read_preempt_notice_never_raises_on_garbage(tmp_path):
+    path = str(tmp_path / "PREEMPT")
+    with open(path, "w") as f:
+        f.write('{"host": "nope')
+    assert faults.read_preempt_notice(path) is None
+
+
+# -- dlsubmit: --priority stamping + --cluster submission ---------------------
+
+
+def test_dlsubmit_priority_exported_and_stamped(tmp_path, monkeypatch):
+    # setenv (not delenv) so monkeypatch records an undo and the exports
+    # cli.main makes below cannot leak past this test; the placeholder
+    # values prove cli.main overwrites rather than inherits them
+    monkeypatch.setenv(telemetry.TENANT_ENV, "placeholder")
+    monkeypatch.setenv(telemetry.PRIORITY_ENV, "0")
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os\n"
+        "assert os.environ['DLS_PRIORITY'] == '7'\n"
+        "assert os.environ['DLS_TENANT'] == 'research'\n")
+    rc = cli.main(["--tenant", "research", "--priority", "7", str(script)])
+    assert rc == 0
+    # ...and the env var is what EventWriter stamps on every record
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=FakeClock())
+    w.heartbeat(step=1)
+    w.close()
+    [e] = [e for e in telemetry.read_events(tmp_path)
+           if e["kind"] == "heartbeat"]
+    assert e["priority"] == 7 and e["tenant"] == "research"
+
+
+def test_event_writer_priority_param_overrides_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(telemetry.PRIORITY_ENV, "3")
+    w = telemetry.EventWriter(tmp_path, process="p0", clock=FakeClock(),
+                              priority=9)
+    w.heartbeat(step=1)
+    w.close()
+    [e] = [e for e in telemetry.read_events(tmp_path)
+           if e["kind"] == "heartbeat"]
+    assert e["priority"] == 9
+
+
+def test_dlsubmit_cluster_enqueues_instead_of_running(
+        tmp_path, capsys, monkeypatch):
+    monkeypatch.delenv(telemetry.TENANT_ENV, raising=False)
+    monkeypatch.delenv(telemetry.PRIORITY_ENV, raising=False)
+    root = str(tmp_path / "pool")
+    ledger.init_cluster(root, hosts=2, quotas={"research": 2})
+    script = tmp_path / "train.py"
+    script.write_text("raise SystemExit('must not run at submit time')\n")
+    rc = cli.main([
+        "--cluster", root, "--tenant", "research", "--priority", "10",
+        "--hosts", "2", "--min-hosts", "1", "--name", "mnist",
+        "--conf", "spark.executor.instances=2",
+        str(script), "--ckpt-dir", "{ckpt}"])
+    assert rc == 0
+    job_id = capsys.readouterr().out.strip()
+    st = ledger.load_state(root)
+    j = st.jobs[job_id]
+    assert j.status == "PENDING"
+    assert j.tenant == "research" and j.priority == 10
+    assert j.gangs == (2,) and j.min_hosts == 1
+    assert j.name == "mnist"
+    # the command re-enters the script through the interpreter, args kept
+    assert j.cmd[0] == sys.executable
+    assert j.cmd[1] == str(script)
+    assert j.cmd[2:] == ("--ckpt-dir", "{ckpt}")
+    # conf rides along as the same DLS_CONF_* contract direct mode uses
+    assert j.env[cli.CONF_ENV_PREFIX + "spark__executor__instances"] == "2"
+    # ...in the JOB's env only: a cluster submit must not leak conf or
+    # tenant/priority exports into the submitting process (a later
+    # Session.builder in this process would silently pick them up)
+    assert cli.CONF_ENV_PREFIX + "spark__executor__instances" not in os.environ
+    assert telemetry.TENANT_ENV not in os.environ
+    assert telemetry.PRIORITY_ENV not in os.environ
+
+
+def test_dlsubmit_cluster_gangs_flag(tmp_path, capsys):
+    root = str(tmp_path / "pool")
+    ledger.init_cluster(root, hosts=4)
+    script = tmp_path / "mpmd.py"
+    script.write_text("pass\n")
+    assert cli.main(["--cluster", root, "--tenant", "t", "--gangs", "2,2",
+                     "--kind", "mpmd", str(script)]) == 0
+    job_id = capsys.readouterr().out.strip()
+    j = ledger.load_state(root).jobs[job_id]
+    assert j.gangs == (2, 2) and j.min_hosts == 4 and j.kind == "mpmd"
+
+
+# -- operator CLI (python -m ...scheduler) ------------------------------------
+
+
+def test_scheduler_cli_init_tick_status(tmp_path, capsys):
+    root = str(tmp_path / "pool")
+    assert sched_cli.main(["init", root, "--hosts", "2",
+                           "--quota", "a=1"]) == 0
+    cfg = json.loads(capsys.readouterr().out)
+    assert cfg["hosts"] == ["h0", "h1"] and cfg["quotas"] == {"a": 1}
+    s = core.Scheduler(root, clock=FakeClock())
+    s.submit(["true"], tenant="a", priority=0, gangs=1, name="x")
+    s.close()
+    assert sched_cli.main(["tick", root, "--no-launch"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["placed"] == ["j000"]
+    assert sched_cli.main(["status", root]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["tenants"]["a"] == {"used": 1, "quota": 1}
+
+
+# -- sched edges in the observability surfaces --------------------------------
+
+
+def _preempted_cluster(tmp_path):
+    """A state dir where j000 was shrink-preempted for j001."""
+    root = str(tmp_path / "pool")
+    ledger.init_cluster(root, hosts=2)
+    s = core.Scheduler(root, clock=FakeClock())
+    lo = s.submit(["true"], tenant="research", priority=0, gangs=2,
+                  min_hosts=1, name="train-lo")
+    s.tick(launch=False)
+    ledger.append(root, "launch", lo, pid=os.getpid())
+    hi = s.submit(["true"], tenant="prod", priority=5, gangs=1,
+                  name="serve-hi")
+    s.tick(launch=False)  # delivers the shrink preemption
+    s.close()
+    return root, lo, hi
+
+
+def test_incident_timeline_folds_sched_edges(tmp_path):
+    root, lo, hi = _preempted_cluster(tmp_path)
+    # the scheduler's own stream carries every edge
+    rows = health.incident_timeline(
+        telemetry.read_events(ledger.sched_dir(root)))
+    types = [r["type"] for r in rows]
+    assert "sched-submit" in types and "sched-place" in types
+    [pre] = [r for r in rows if r["type"] == "sched-preempt"]
+    assert pre["key"] == lo
+    assert pre["severity"] == "WARN"
+    assert pre["who"] == "tenant research"
+    assert "shrink" in pre["summary"] and f"for {hi}" in pre["summary"]
+    # the victim's own workdir got the mirror: its timeline shows its
+    # preemption without reading the scheduler's stream
+    wd = ledger.load_state(root).jobs[lo].workdir
+    mine = health.incident_timeline(telemetry.read_events(wd))
+    assert [r["type"] for r in mine if r["type"].startswith("sched")] \
+        == ["sched-place", "sched-preempt"]
+
+
+def test_chrome_trace_renders_sched_instants(tmp_path):
+    root, lo, hi = _preempted_cluster(tmp_path)
+    doc = trace_lib.chrome_trace(
+        telemetry.read_events(ledger.sched_dir(root)))
+    instants = [e for e in doc["traceEvents"] if e.get("cat") == "sched"]
+    assert instants, "sched edges must land on the trace"
+    assert all(e["ph"] == "i" and e["s"] == "g" for e in instants)
+    names = {e["name"] for e in instants}
+    assert f"sched-preempt {lo}" in names
+    [pre] = [e for e in instants if e["name"] == f"sched-preempt {lo}"]
+    assert pre["args"]["mode"] == "shrink"
+    assert pre["args"]["victim_of"] == hi
+    # they share the alerts row: markers line up against the spans
+    rows = [e for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "thread_name"]
+    assert any(e["args"]["name"] == "alerts" for e in rows)
+
+
+def test_dlstatus_cluster_renders_scheduler_section(tmp_path, capsys):
+    root, lo, hi = _preempted_cluster(tmp_path)
+    assert status.main(["--cluster", root]) == 0
+    out = capsys.readouterr().out
+    assert "scheduler: hosts 0/2 free" in out
+    assert "train-lo" in out and "serve-hi" in out
+    assert "draining g1" in out        # the victim's in-flight drain
+    assert "PENDING" in out            # the beneficiary still queued
+    # --json carries the sched block verbatim for machine consumers
+    assert status.main(["--cluster", root, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["sched"] == ledger.load_state(root).to_report()
+    by_id = {j["job"]: j for j in doc["sched"]["jobs"]}
+    assert by_id[lo]["draining"] == 1
+    assert by_id[hi]["status"] == "PENDING"
+
+
+def test_workdir_kind_sched(tmp_path):
+    root, lo, hi = _preempted_cluster(tmp_path)
+    events = telemetry.read_events(ledger.sched_dir(root))
+    assert health._workdir_kind(events) == "sched"
+
+
+# -- end to end: a real launch through the runner -----------------------------
+
+
+def test_scheduler_launches_trivial_job_to_completion(tmp_path):
+    root = str(tmp_path / "pool")
+    ledger.init_cluster(root, hosts=1)
+    script = tmp_path / "hello.py"
+    script.write_text(
+        "import os, sys\n"
+        "assert os.environ['DLS_TENANT'] == 't1'\n"
+        "assert os.environ['DLS_PRIORITY'] == '2'\n"
+        "assert os.environ['DLS_PREEMPT_NOTICE']\n"
+        "ckpt = sys.argv[sys.argv.index('--ckpt-dir') + 1]\n"
+        "assert os.path.isdir(ckpt), ckpt\n"
+        "print('hello from', os.environ.get('DLS_PROCESS_ID'))\n")
+    s = core.Scheduler(root)
+    try:
+        jid = s.submit(
+            [sys.executable, str(script), "--ckpt-dir", "{ckpt}"],
+            tenant="t1", priority=2, gangs=1, name="hello")
+        s.run(interval=0.2, max_ticks=100, until_idle=True)
+    finally:
+        s.close()
+    st = ledger.load_state(root)
+    j = st.jobs[jid]
+    assert j.status == "COMPLETED" and j.rc == 0, \
+        open(os.path.join(j.workdir, "runner.log")).read()
+    assert "hello from 0" in open(
+        os.path.join(j.workdir, "runner.log")).read()
+    # the runner's verdict landed in the job's own stream too
+    kinds = [(e["kind"], e.get("edge")) for e in
+             telemetry.read_events(j.workdir)]
+    assert ("sched", "complete") in kinds

@@ -1600,6 +1600,182 @@ PYEOF
   return $rc
 }
 
+# sched smoke (ISSUE 19): the multi-tenant scheduler end to end — two
+# tenants oversubscribe a fixed 2-host inventory: a low-priority elastic
+# train gang fills the cluster, a high-priority serve submission forces a
+# graceful shrink preemption (notice file -> in-flight drain -> live
+# handoff -> supervisor shrink), the freed host runs the serve job, the
+# train job completes on fewer hosts with a loss trajectory matching an
+# unpreempted control run, quota is never exceeded at any ledger prefix,
+# the accounting ties out across `dlstatus --cluster --json`, and zero
+# processes outlive the drill (docs/CLUSTER.md).
+run_sched_smoke() {
+  local t0 rc wd out
+  t0=$(date +%s)
+  rc=0
+  wd=$(mktemp -d /tmp/dls_sched.XXXXXX)
+  out=$(WD="$wd" python - <<'PYEOF'
+import glob, json, os, subprocess, sys, time
+
+import numpy as np
+
+wd = os.environ["WD"]
+root = os.path.join(wd, "pool")
+worker = os.path.abspath(os.path.join("tests", "workers", "worker.py"))
+
+from distributeddeeplearningspark_tpu import telemetry
+from distributeddeeplearningspark_tpu.scheduler import core, ledger
+from distributeddeeplearningspark_tpu.supervisor import Supervisor
+
+# -- two tenants oversubscribe 2 hosts ----------------------------------------
+ledger.init_cluster(root, hosts=2, quotas={"research": 2, "prod": 1})
+s = core.Scheduler(root)
+lo = s.submit(
+    [sys.executable, worker, "elastic", "--ckpt-dir", "{ckpt}",
+     "--steps", "28", "--checkpoint-every", "6"],
+    tenant="research", priority=0, gangs=2, min_hosts=1, name="train-lo",
+    env={"XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"})
+s.tick()
+lo_wd = ledger.load_state(root).jobs[lo].workdir
+
+def last_step():
+    best = 0
+    for e in telemetry.read_events(lo_wd):
+        st = e.get("step")
+        if (e.get("kind") in ("step_metrics", "heartbeat")
+                and isinstance(st, (int, float))):
+            best = max(best, int(st))
+    return best
+
+deadline = time.time() + 240
+while last_step() < 4 and time.time() < deadline:
+    s.tick()
+    time.sleep(0.5)
+assert last_step() >= 4, "train job never made progress"
+
+# -- the high-priority serve submission forces a shrink preemption ------------
+serve_script = os.path.join(wd, "serve.py")
+with open(serve_script, "w") as f:
+    f.write("import time\ntime.sleep(3)\nprint('served')\n")
+hi = s.submit([sys.executable, serve_script], tenant="prod", priority=10,
+              gangs=1, name="serve-hi", kind="serve")
+s.run(interval=0.4, max_ticks=450, until_idle=True)
+s.close()
+
+st = ledger.load_state(root)
+jlo, jhi = st.jobs[lo], st.jobs[hi]
+runner_log = os.path.join(lo_wd, "runner.log")
+tail = open(runner_log).read()[-2000:] if os.path.exists(runner_log) else ""
+assert jlo.status == "COMPLETED" and jlo.rc == 0, (jlo.status, jlo.rc, tail)
+assert jhi.status == "COMPLETED" and jhi.rc == 0, (jhi.status, jhi.rc)
+
+recs = ledger.read_ledger(root)
+pre = [r for r in recs if r["edge"] == "preempt"]
+assert pre and pre[0]["job"] == lo and pre[0]["mode"] == "shrink" \
+    and pre[0]["victim_of"] == hi, pre
+assert any(r["edge"] == "shrink" and r["job"] == lo for r in recs), \
+    [r["edge"] for r in recs]
+
+# the gang finished all 28 steps at width 1 after the drain
+step, attempt, width = open(
+    os.path.join(lo_wd, "ckpt", "DONE")).read().split()
+assert (int(step), int(width)) == (28, 1), (step, attempt, width)
+
+# -- graceful drain + live handoff, visible in the victim's own stream --------
+p = subprocess.run(
+    [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+     lo_wd, "--json", "--incidents"], capture_output=True, text=True)
+assert p.returncode == 0, p.stderr[-500:]
+doc = json.loads(p.stdout)
+ev = doc["recovery_events"]
+geo = [e for e in ev if e.get("event") == "geometry_change"]
+assert geo and geo[-1].get("resume") == "live-handoff", geo
+gs = [e for e in ev if e.get("event") == "graceful_shutdown"]
+assert gs and gs[-1].get("dead_host") == 1, gs
+drain_step = int(gs[-1]["step"])
+moves = [e for e in ev if e.get("event") == "reshard"]
+assert not any(e.get("walk_back") for e in moves), moves
+itypes = [r["type"] for r in doc["incidents"]]
+assert "sched-preempt" in itypes and "sched-shrink" in itypes, itypes
+
+# -- quota is never exceeded at ANY prefix of the ledger ----------------------
+cfg = ledger.load_config(root)
+replay = ledger.ClusterState(root=os.path.abspath(root),
+                             hosts=list(cfg["hosts"]),
+                             quotas=dict(cfg["quotas"]))
+for rec in recs:
+    replay.apply(rec)
+    for t, u in replay.used_by_tenant().items():
+        q = replay.quotas.get(t)
+        assert q is None or u <= q, (rec, t, u, q)
+
+# -- accounting ties out across dlstatus --cluster ----------------------------
+p = subprocess.run(
+    [sys.executable, "-m", "distributeddeeplearningspark_tpu.status",
+     "--cluster", root, "--json"], capture_output=True, text=True)
+assert p.returncode == 0, p.stderr[-500:]
+cdoc = json.loads(p.stdout)
+assert cdoc["sched"] == ledger.load_state(root).to_report()
+assert cdoc["sched"]["hosts"] == {"total": 2, "free": 2}
+assert all(row["used"] == 0 for row in cdoc["sched"]["tenants"].values())
+assert {j["status"] for j in cdoc["sched"]["jobs"]} == {"COMPLETED"}
+
+# -- zero orphaned processes --------------------------------------------------
+orphans = []
+for path in glob.glob("/proc/[0-9]*/cmdline"):
+    try:
+        with open(path, "rb") as f:
+            cmd = f.read().decode(errors="replace").replace("\0", " ")
+    except OSError:
+        continue
+    if wd in cmd and str(os.getpid()) != path.split("/")[2]:
+        orphans.append(cmd)
+assert not orphans, orphans
+
+# -- the preempted trajectory matches an unpreempted control run --------------
+ctl = os.path.join(wd, "ctl")
+os.makedirs(ctl)
+sup = Supervisor(
+    [sys.executable, worker, "elastic", "--ckpt-dir", ctl,
+     "--steps", "28", "--checkpoint-every", "6"],
+    num_processes=1, max_restarts=1, restart_backoff_s=0.05,
+    backoff_jitter=0.0,
+    env={"XLA_FLAGS": "", "JAX_PLATFORMS": "cpu"},
+    progress_path=ctl, telemetry_dir=ctl)
+result = sup.run()
+assert result.ok, [(a.ordinal, a.returncodes, a.classification)
+                   for a in result.attempts]
+
+def losses(d):
+    out = {}
+    for e in telemetry.read_events(d):
+        if e.get("kind") == "step_metrics":
+            loss = (e.get("metrics") or {}).get("loss")
+            if loss is not None:
+                out[int(e["step"])] = float(loss)
+    return out
+
+lo_losses, ctl_losses = losses(lo_wd), losses(ctl)
+common = sorted(set(lo_losses) & set(ctl_losses))
+post = [c for c in common if c >= drain_step]
+assert post, (sorted(lo_losses), sorted(ctl_losses), drain_step)
+assert np.allclose([lo_losses[c] for c in common],
+                   [ctl_losses[c] for c in common], rtol=0, atol=1e-6), [
+    (c, lo_losses[c], ctl_losses[c]) for c in common
+    if abs(lo_losses[c] - ctl_losses[c]) > 1e-6]
+
+print(f"sched: preempt=shrink@{drain_step} victim={lo} for={hi} "
+      f"done=28@width1 resume=live-handoff quota=never-exceeded "
+      f"tieout=ok orphans=0 loss-match={len(common)}steps"
+      f"({len(post)}post-drain)")
+PYEOF
+) || rc=$?
+  log sched "${out:-sched smoke failed}" "${rc}" $(( $(date +%s) - t0 ))
+  echo "[sched] ${out:-FAILED} (rc=${rc})"
+  rm -rf "$wd"
+  return $rc
+}
+
 overall=0
 case "${1:-both}" in
   fast) run_tier fast "not slow" || overall=$? ;;
@@ -1614,6 +1790,7 @@ case "${1:-both}" in
         run_plan_smoke || overall=$?
         run_health_smoke || overall=$?
         run_history_smoke || overall=$?
+        run_sched_smoke || overall=$?
         run_perf_guard_smoke || overall=$? ;;
   # the recovery drills (kill-mid-finalize, poisoned restore, hang, NaN
   # spike) end-to-end — slow-marked, so the fast tier never pays for gangs
@@ -1683,10 +1860,16 @@ case "${1:-both}" in
   # health.json (docs/OBSERVABILITY.md "History, trends, and the metrics
   # endpoint")
   history) run_history_smoke || overall=$? ;;
+  # multi-tenant scheduler: two tenants oversubscribe 2 hosts, the
+  # high-priority serve submission shrink-preempts the elastic train
+  # gang (notice -> drain -> live handoff), both complete, loss
+  # trajectory matches an unpreempted control, quota never exceeded,
+  # accounting ties out, zero orphans (docs/CLUSTER.md)
+  sched) run_sched_smoke || overall=$? ;;
   # the executable pod-day scripts, logged with the same audit trail
   # (VERDICT r4 next-#9's done-condition: rehearsal green in CI)
   smoke)     run_script_tier smoke tools/smoke.sh || overall=$? ;;
   rehearsal) run_script_tier rehearsal tools/pod_rehearsal.sh || overall=$? ;;
-  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|live-reshard|mpmd|plan|perf-guard|health|history|smoke|rehearsal]"; exit 2 ;;
+  *) echo "usage: tools/ci.sh [fast|slow|both|chaos|dlstatus|hosts|serve|fleet-serve|trace|input|shuffle|shuffle-chaos|anatomy|elastic|live-reshard|mpmd|plan|perf-guard|health|history|sched|smoke|rehearsal]"; exit 2 ;;
 esac
 exit $overall
